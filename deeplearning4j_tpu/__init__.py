@@ -1,0 +1,15 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of the
+Deeplearning4j stack (reference: erkapilmehta/deeplearning4j): the config-DSL →
+network API (`Sequential` ≈ MultiLayerNetwork, `Graph` ≈ ComputationGraph), the
+layer zoo, SameDiff-style graph autodiff, TF/Keras import, distributed training
+over `jax.sharding` meshes, and the evaluation/checkpoint/listener periphery —
+all architected TPU-first rather than translated (see SURVEY.md §7).
+"""
+
+__version__ = "0.1.0"
+
+from . import core
+
+__all__ = ["core", "__version__"]

@@ -1,0 +1,44 @@
+from .config import (
+    from_dict,
+    from_json,
+    register_config,
+    replace,
+    to_dict,
+    to_json,
+)
+from .dtypes import DataType, default_float_dtype
+from .env import Environment, get_environment
+from .listeners import (
+    CollectScoresListener,
+    ListenerBus,
+    PerformanceListener,
+    ScoreIterationListener,
+    TrainingListener,
+)
+from .registry import OpDef, OpRegistry, get_op, register_op
+from .rng import RngState, get_default_rng, set_default_seed
+
+__all__ = [
+    "DataType",
+    "Environment",
+    "ListenerBus",
+    "OpDef",
+    "OpRegistry",
+    "RngState",
+    "TrainingListener",
+    "ScoreIterationListener",
+    "PerformanceListener",
+    "CollectScoresListener",
+    "default_float_dtype",
+    "from_dict",
+    "from_json",
+    "get_default_rng",
+    "get_environment",
+    "get_op",
+    "register_config",
+    "register_op",
+    "replace",
+    "set_default_seed",
+    "to_dict",
+    "to_json",
+]

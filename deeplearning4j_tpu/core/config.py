@@ -1,0 +1,146 @@
+"""Config-DSL infrastructure.
+
+The reference's config objects (``NeuralNetConfiguration`` et al., canonical:
+org.deeplearning4j.nn.conf.*) are immutable, polymorphic and JSON-round-trip
+serializable — "config IS the serialization format" is load-bearing for
+checkpoints, Keras import and transfer learning (SURVEY.md §2.2, §5.4/§5.6).
+This module provides the same property for plain dataclasses:
+
+* ``@register_config`` — registers a dataclass under a stable type name so
+  polymorphic fields (layers, schedules, updaters, losses...) round-trip.
+* ``to_json`` / ``from_json`` — recursive (de)serialization with an ``@class``
+  discriminator, tolerant of nested configs, enums, tuples and None.
+
+Nothing here touches jax; configs are pure data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+from typing import Any, Dict, Type, TypeVar
+
+_CONFIG_REGISTRY: Dict[str, type] = {}
+
+T = TypeVar("T")
+
+_TYPE_KEY = "@class"
+
+
+def register_config(cls: Type[T]) -> Type[T]:
+    """Class decorator: register a dataclass for polymorphic JSON round-trip."""
+    if not dataclasses.is_dataclass(cls):
+        raise TypeError(f"@register_config requires a dataclass, got {cls}")
+    name = cls.__name__
+    existing = _CONFIG_REGISTRY.get(name)
+    if existing is not None and existing is not cls:
+        raise ValueError(f"Config name collision: {name}")
+    _CONFIG_REGISTRY[name] = cls
+    return cls
+
+
+def config_class(name: str) -> type:
+    try:
+        return _CONFIG_REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"Unknown config class {name!r}. Known: {sorted(_CONFIG_REGISTRY)}"
+        ) from None
+
+
+def _encode(obj: Any) -> Any:
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, enum.Enum):
+        return {_TYPE_KEY: "@enum", "enum": type(obj).__name__, "value": obj.name}
+    if isinstance(obj, (list, tuple)):
+        enc = [_encode(v) for v in obj]
+        if isinstance(obj, tuple):
+            return {_TYPE_KEY: "@tuple", "items": enc}
+        return enc
+    if isinstance(obj, dict):
+        return {str(k): _encode(v) for k, v in obj.items()}
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        name = type(obj).__name__
+        if name not in _CONFIG_REGISTRY:
+            raise ValueError(
+                f"{name} is not @register_config'd; cannot serialize polymorphically"
+            )
+        out: Dict[str, Any] = {_TYPE_KEY: name}
+        for f in dataclasses.fields(obj):
+            out[f.name] = _encode(getattr(obj, f.name))
+        return out
+    # numpy / jax scalars
+    try:
+        import numpy as np
+
+        if isinstance(obj, np.generic):
+            return obj.item()
+        if isinstance(obj, np.ndarray):
+            return {_TYPE_KEY: "@ndarray", "data": obj.tolist(), "dtype": str(obj.dtype)}
+    except ImportError:  # pragma: no cover
+        pass
+    raise TypeError(f"Cannot serialize {type(obj)} to config JSON")
+
+
+def _enum_class(name: str) -> type:
+    # Enums used inside configs register lazily on first encode via their module;
+    # search registered config modules' enums by walking known enum subclasses.
+    for sub in _all_enum_subclasses(enum.Enum):
+        if sub.__name__ == name:
+            return sub
+    raise KeyError(f"Unknown enum class {name!r}")
+
+
+def _all_enum_subclasses(cls: type) -> list:
+    out = []
+    for sub in cls.__subclasses__():
+        out.append(sub)
+        out.extend(_all_enum_subclasses(sub))
+    return out
+
+
+def _decode(obj: Any) -> Any:
+    if isinstance(obj, list):
+        return [_decode(v) for v in obj]
+    if isinstance(obj, dict):
+        tname = obj.get(_TYPE_KEY)
+        if tname == "@enum":
+            return _enum_class(obj["enum"])[obj["value"]]
+        if tname == "@tuple":
+            return tuple(_decode(v) for v in obj["items"])
+        if tname == "@ndarray":
+            import numpy as np
+
+            return np.array(obj["data"], dtype=obj["dtype"])
+        if tname is not None:
+            cls = config_class(tname)
+            kwargs = {k: _decode(v) for k, v in obj.items() if k != _TYPE_KEY}
+            field_names = {f.name for f in dataclasses.fields(cls)}
+            # Tolerate forward-compatible extra keys.
+            kwargs = {k: v for k, v in kwargs.items() if k in field_names}
+            return cls(**kwargs)
+        return {k: _decode(v) for k, v in obj.items()}
+    return obj
+
+
+def to_dict(cfg: Any) -> Any:
+    return _encode(cfg)
+
+
+def from_dict(d: Any) -> Any:
+    return _decode(d)
+
+
+def to_json(cfg: Any, indent: int = 2) -> str:
+    return json.dumps(_encode(cfg), indent=indent)
+
+
+def from_json(s: str) -> Any:
+    return _decode(json.loads(s))
+
+
+def replace(cfg: T, **changes: Any) -> T:
+    """Immutable update, mirroring dataclasses.replace (configs are frozen)."""
+    return dataclasses.replace(cfg, **changes)

@@ -1,0 +1,74 @@
+"""Data types.
+
+Capability parity with the reference's ``org.nd4j.linalg.api.buffer.DataType``
+(canonical: nd4j-api) — the same named vocabulary, mapped onto jnp dtypes. On
+TPU the compute-relevant set is smaller (bf16/f32 on the MXU); the rest exist
+for IO/serde fidelity.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Union
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType(enum.Enum):
+    DOUBLE = "float64"
+    FLOAT = "float32"
+    HALF = "float16"
+    BFLOAT16 = "bfloat16"
+    LONG = "int64"
+    INT = "int32"
+    SHORT = "int16"
+    BYTE = "int8"
+    UBYTE = "uint8"
+    UINT16 = "uint16"
+    UINT32 = "uint32"
+    UINT64 = "uint64"
+    BOOL = "bool"
+    UTF8 = "object"  # host-only; never reaches the device
+
+    @property
+    def np(self) -> np.dtype:
+        if self is DataType.BFLOAT16:
+            return jnp.bfloat16  # numpy has no native bf16; use ml_dtypes via jnp
+        return np.dtype(self.value)
+
+    @property
+    def jnp(self):
+        if self is DataType.UTF8:
+            raise ValueError("UTF8 is a host-only dtype")
+        return jnp.dtype(self.value)
+
+    @property
+    def is_floating(self) -> bool:
+        return self in (DataType.DOUBLE, DataType.FLOAT, DataType.HALF, DataType.BFLOAT16)
+
+    @property
+    def is_integer(self) -> bool:
+        return self in (
+            DataType.LONG, DataType.INT, DataType.SHORT, DataType.BYTE,
+            DataType.UBYTE, DataType.UINT16, DataType.UINT32, DataType.UINT64,
+        )
+
+    @classmethod
+    def from_any(cls, d: Union["DataType", str, np.dtype, type]) -> "DataType":
+        if isinstance(d, DataType):
+            return d
+        name = jnp.dtype(d).name if not isinstance(d, str) else d
+        by_name = {"float64": cls.DOUBLE, "float32": cls.FLOAT, "float16": cls.HALF}
+        if name in by_name:
+            return by_name[name]
+        for m in cls:
+            if m.value == name or m.name == name.upper():
+                return m
+        raise ValueError(f"Unknown dtype: {d!r}")
+
+
+def default_float_dtype():
+    from .env import get_environment
+
+    return jnp.dtype(get_environment().default_dtype)

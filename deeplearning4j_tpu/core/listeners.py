@@ -1,0 +1,119 @@
+"""Listener bus — the framework's metrics/observability spine.
+
+Mirrors the reference's ``TrainingListener`` SPI (canonical:
+org.deeplearning4j.optimize.api.TrainingListener) which is DL4J's single
+metrics bus: ScoreIterationListener, PerformanceListener, EvaluativeListener,
+CheckpointListener and StatsListener all hang off it (SURVEY.md §5.5).
+
+Listeners are host-side: they observe per-iteration scalars/pytrees after the
+jitted step returns. Anything that would force a device sync (histograms over
+params) only materializes when a listener that needs it is attached.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class TrainingListener:
+    """Base class; override any subset of hooks."""
+
+    # model is the Sequential/Graph (or SameDiff equivalent) driving training.
+    def on_epoch_start(self, model: Any) -> None: ...
+    def on_epoch_end(self, model: Any) -> None: ...
+    def on_forward_pass(self, model: Any, activations: Any) -> None: ...
+    def on_gradient_calculation(self, model: Any, gradients: Any) -> None: ...
+    def iteration_done(self, model: Any, iteration: int, epoch: int, score: float) -> None: ...
+
+    # Whether this listener needs per-iteration access to params/grads pytrees
+    # (forces them to be fetched; keep False for scalar-only listeners).
+    requires_arrays: bool = False
+
+
+class ListenerBus:
+    def __init__(self, listeners: Optional[Sequence[TrainingListener]] = None) -> None:
+        self.listeners: List[TrainingListener] = list(listeners or [])
+
+    def add(self, listener: TrainingListener) -> None:
+        self.listeners.append(listener)
+
+    def remove(self, listener: TrainingListener) -> None:
+        self.listeners.remove(listener)
+
+    def clear(self) -> None:
+        self.listeners.clear()
+
+    @property
+    def requires_arrays(self) -> bool:
+        return any(l.requires_arrays for l in self.listeners)
+
+    def epoch_start(self, model: Any) -> None:
+        for l in self.listeners:
+            l.on_epoch_start(model)
+
+    def epoch_end(self, model: Any) -> None:
+        for l in self.listeners:
+            l.on_epoch_end(model)
+
+    def iteration_done(self, model: Any, iteration: int, epoch: int, score: float) -> None:
+        for l in self.listeners:
+            l.iteration_done(model, iteration, epoch, score)
+
+    def gradient_calculation(self, model: Any, gradients: Any) -> None:
+        for l in self.listeners:
+            l.on_gradient_calculation(model, gradients)
+
+
+class ScoreIterationListener(TrainingListener):
+    """Logs the loss every N iterations (reference: ScoreIterationListener)."""
+
+    def __init__(self, print_every: int = 10, log_fn=print) -> None:
+        self.print_every = max(1, print_every)
+        self.log_fn = log_fn
+
+    def iteration_done(self, model: Any, iteration: int, epoch: int, score: float) -> None:
+        if iteration % self.print_every == 0:
+            self.log_fn(f"Score at iteration {iteration} is {score}")
+
+
+class PerformanceListener(TrainingListener):
+    """Samples/sec + batches/sec per iteration (reference: PerformanceListener)."""
+
+    def __init__(self, frequency: int = 10, log_fn=print) -> None:
+        self.frequency = max(1, frequency)
+        self.log_fn = log_fn
+        self._last_time: Optional[float] = None
+        self._last_iter: Optional[int] = None
+        self.history: List[Dict[str, float]] = []
+
+    def iteration_done(self, model: Any, iteration: int, epoch: int, score: float) -> None:
+        now = time.perf_counter()
+        if self._last_time is not None and iteration > self._last_iter:
+            dt = now - self._last_time
+            iters = iteration - self._last_iter
+            batch = getattr(model, "last_batch_size", None)
+            rec = {
+                "iteration": iteration,
+                "batches_per_sec": iters / dt if dt > 0 else float("inf"),
+            }
+            if batch:
+                rec["samples_per_sec"] = iters * batch / dt if dt > 0 else float("inf")
+            self.history.append(rec)
+            if iteration % self.frequency == 0:
+                msg = ", ".join(f"{k}={v:.2f}" for k, v in rec.items() if k != "iteration")
+                self.log_fn(f"iteration {iteration}: {msg}, score={score:.5f}")
+        self._last_time = now
+        self._last_iter = iteration
+
+
+class CollectScoresListener(TrainingListener):
+    """Accumulates (iteration, score) pairs in memory (reference: CollectScoresIterationListener)."""
+
+    def __init__(self) -> None:
+        self.scores: List[float] = []
+        self.iterations: List[int] = []
+
+    def iteration_done(self, model: Any, iteration: int, epoch: int, score: float) -> None:
+        self.iterations.append(iteration)
+        self.scores.append(float(score))

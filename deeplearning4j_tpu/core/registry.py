@@ -1,0 +1,105 @@
+"""Typed op registry — the framework's custom-op extension point.
+
+The reference's libnd4j keeps ~500 "declarable ops" in an ``OpRegistrator``
+(canonical: libnd4j/include/ops/declarable/OpRegistrator.h), each with a name,
+an execution kernel and a shape function, discovered by name from the JVM's
+``DynamicCustomOp``. On TPU the kernels themselves are jax functions lowered by
+XLA, so the registry's job shrinks to what still matters:
+
+* a stable *name -> implementation* mapping (used by the SameDiff equivalent,
+  the TF importer, and serialization),
+* abstract shape/dtype inference without running the op (``jax.eval_shape``
+  by default, overridable),
+* an optional custom VJP and an optional accelerated ("helper") variant —
+  the seam where a Pallas kernel replaces the XLA default, mirroring the
+  cuDNN/oneDNN platform-helper mechanism (SURVEY.md §2.1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+
+
+@dataclasses.dataclass
+class OpDef:
+    name: str
+    fn: Callable[..., Any]
+    shape_fn: Optional[Callable[..., Any]] = None
+    vjp: Optional[Callable[..., Any]] = None
+    helper: Optional[Callable[..., Any]] = None  # accelerated (pallas) variant
+    doc: str = ""
+    namespace: str = "ops"
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        from .env import get_environment
+
+        impl = self.fn
+        if self.helper is not None and get_environment().allow_helpers:
+            impl = self.helper
+        return impl(*args, **kwargs)
+
+    def abstract_eval(self, *args: Any, **kwargs: Any):
+        """Shape/dtype inference without execution (reference: calculateOutputShape)."""
+        if self.shape_fn is not None:
+            return self.shape_fn(*args, **kwargs)
+        return jax.eval_shape(self.fn, *args, **kwargs)
+
+
+class OpRegistry:
+    _instance: Optional["OpRegistry"] = None
+
+    def __init__(self) -> None:
+        self._ops: Dict[str, OpDef] = {}
+
+    @classmethod
+    def instance(cls) -> "OpRegistry":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def register(self, op: OpDef) -> OpDef:
+        if op.name in self._ops:
+            raise ValueError(f"Op already registered: {op.name}")
+        self._ops[op.name] = op
+        return op
+
+    def get(self, name: str) -> OpDef:
+        try:
+            return self._ops[name]
+        except KeyError:
+            raise KeyError(f"Unknown op {name!r}") from None
+
+    def has(self, name: str) -> bool:
+        return name in self._ops
+
+    def names(self, namespace: Optional[str] = None) -> Sequence[str]:
+        if namespace is None:
+            return sorted(self._ops)
+        return sorted(n for n, o in self._ops.items() if o.namespace == namespace)
+
+
+def register_op(
+    name: str,
+    *,
+    shape_fn: Optional[Callable[..., Any]] = None,
+    vjp: Optional[Callable[..., Any]] = None,
+    helper: Optional[Callable[..., Any]] = None,
+    namespace: str = "ops",
+) -> Callable[[Callable[..., Any]], OpDef]:
+    """Decorator: register ``fn`` under ``name`` and return the OpDef wrapper."""
+
+    def deco(fn: Callable[..., Any]) -> OpDef:
+        op = OpDef(
+            name=name, fn=fn, shape_fn=shape_fn, vjp=vjp, helper=helper,
+            doc=fn.__doc__ or "", namespace=namespace,
+        )
+        return OpRegistry.instance().register(op)
+
+    return deco
+
+
+def get_op(name: str) -> OpDef:
+    return OpRegistry.instance().get(name)

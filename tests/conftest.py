@@ -1,0 +1,33 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual devices so sharding/mesh tests exercise real
+multi-device paths without TPU hardware (SURVEY.md §4 "distributed without a
+cluster"). The real-TPU path is exercised by bench.py / __graft_entry__.py.
+
+This must run before jax initializes its backends, hence env vars set at
+import time (conftest imports before test modules).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # override the session's axon (TPU) default
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    from deeplearning4j_tpu.core import RngState
+
+    return RngState(12345)
+
+
+@pytest.fixture(autouse=True)
+def _reset_environment():
+    yield
+    from deeplearning4j_tpu.core import get_environment
+
+    get_environment().reset()

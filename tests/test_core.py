@@ -1,0 +1,173 @@
+"""Core infrastructure tests: config round-trip, registry, rng, env, listeners."""
+
+import dataclasses
+import enum
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.core import (
+    DataType,
+    ListenerBus,
+    OpRegistry,
+    RngState,
+    ScoreIterationListener,
+    from_json,
+    get_environment,
+    get_op,
+    register_config,
+    register_op,
+    to_json,
+)
+
+
+class Activation(enum.Enum):
+    RELU = "relu"
+    TANH = "tanh"
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class _InnerCfg:
+    units: int = 8
+    act: Activation = Activation.RELU
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class _OuterCfg:
+    name: str = "net"
+    layers: tuple = ()
+    lr: float = 1e-3
+    extra: dict = dataclasses.field(default_factory=dict)
+
+
+class TestConfig:
+    def test_round_trip_nested_polymorphic(self):
+        cfg = _OuterCfg(
+            name="m",
+            layers=(_InnerCfg(4, Activation.TANH), _InnerCfg(2)),
+            lr=0.01,
+            extra={"k": [1, 2, 3]},
+        )
+        s = to_json(cfg)
+        back = from_json(s)
+        assert back == cfg
+        assert isinstance(back.layers, tuple)
+        assert back.layers[0].act is Activation.TANH
+
+    def test_forward_compatible_extra_keys(self):
+        s = to_json(_InnerCfg())
+        import json
+
+        d = json.loads(s)
+        d["future_field"] = 42
+        back = from_json(json.dumps(d))
+        assert back == _InnerCfg()
+
+    def test_ndarray_round_trip(self):
+        @register_config
+        @dataclasses.dataclass(frozen=True)
+        class _ArrCfg:
+            w: np.ndarray = None
+
+            def __eq__(self, other):
+                return np.array_equal(self.w, other.w)
+
+        cfg = _ArrCfg(w=np.arange(6, dtype=np.float32).reshape(2, 3))
+        back = from_json(to_json(cfg))
+        assert np.array_equal(back.w, cfg.w)
+        assert back.w.dtype == np.float32
+
+
+class TestRegistry:
+    def test_register_and_call(self):
+        @register_op("test_double")
+        def _double(x):
+            return x * 2.0
+
+        op = get_op("test_double")
+        out = op(jnp.ones((3,)))
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+
+    def test_abstract_eval(self):
+        @register_op("test_matmul")
+        def _mm(a, b):
+            return a @ b
+
+        shape = get_op("test_matmul").abstract_eval(
+            jax.ShapeDtypeStruct((4, 8), jnp.float32),
+            jax.ShapeDtypeStruct((8, 16), jnp.float32),
+        )
+        assert shape.shape == (4, 16)
+
+    def test_helper_toggle(self):
+        calls = []
+
+        def helper(x):
+            calls.append("helper")
+            return x + 1
+
+        @register_op("test_helper_op", helper=helper)
+        def _base(x):
+            calls.append("base")
+            return x + 1
+
+        op = get_op("test_helper_op")
+        op(1.0)
+        assert calls == ["helper"]
+        get_environment().allow_helpers = False
+        op(1.0)
+        assert calls == ["helper", "base"]
+
+    def test_duplicate_rejected(self):
+        @register_op("test_dup")
+        def _a(x):
+            return x
+
+        with pytest.raises(ValueError):
+            @register_op("test_dup")
+            def _b(x):
+                return x
+
+
+class TestRng:
+    def test_determinism(self):
+        a, b = RngState(7), RngState(7)
+        ka, kb = a.next_key(), b.next_key()
+        assert jax.random.uniform(ka, (3,)).tolist() == jax.random.uniform(kb, (3,)).tolist()
+
+    def test_stream_advances(self):
+        r = RngState(7)
+        k1, k2 = r.next_key(), r.next_key()
+        assert jax.random.uniform(k1, ()).item() != jax.random.uniform(k2, ()).item()
+
+    def test_split(self):
+        r = RngState(3)
+        keys = r.split(4)
+        assert keys.shape[0] == 4
+
+
+class TestDtypes:
+    def test_mapping(self):
+        assert DataType.FLOAT.jnp == jnp.float32
+        assert DataType.BFLOAT16.jnp == jnp.bfloat16
+        assert DataType.from_any("float32") is DataType.FLOAT
+        assert DataType.from_any(np.float64) is DataType.DOUBLE
+        assert DataType.FLOAT.is_floating and not DataType.INT.is_floating
+
+
+class TestListeners:
+    def test_bus_dispatch(self):
+        logged = []
+        bus = ListenerBus([ScoreIterationListener(print_every=2, log_fn=logged.append)])
+        for i in range(5):
+            bus.iteration_done(None, i, 0, 0.5)
+        assert len(logged) == 3  # iterations 0, 2, 4
+
+
+def test_multi_device_cpu_mesh_available():
+    # conftest forces 8 virtual CPU devices; sharding tests depend on this.
+    assert len(jax.devices()) == 8
