@@ -15,6 +15,12 @@ prev = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+# The session's sitecustomize imports jax (axon PJRT registration) before
+# conftest runs, so JAX_PLATFORMS was already latched — update config directly.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
