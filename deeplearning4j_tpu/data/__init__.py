@@ -1,0 +1,3 @@
+from .dataset import DataSet, MultiDataSet
+
+__all__ = ["DataSet", "MultiDataSet"]

@@ -1,0 +1,84 @@
+"""DataSet / MultiDataSet containers.
+
+Reference: org.nd4j.linalg.dataset.{DataSet, MultiDataSet} — features + labels
++ optional masks. Host-side numpy until the jitted step device_puts them (the
+async prefetch iterator overlaps that transfer; data/iterators.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class DataSet:
+    features: np.ndarray
+    labels: np.ndarray
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def split_test_and_train(self, n_train: int) -> Tuple["DataSet", "DataSet"]:
+        tr = DataSet(
+            self.features[:n_train], self.labels[:n_train],
+            None if self.features_mask is None else self.features_mask[:n_train],
+            None if self.labels_mask is None else self.labels_mask[:n_train],
+        )
+        te = DataSet(
+            self.features[n_train:], self.labels[n_train:],
+            None if self.features_mask is None else self.features_mask[n_train:],
+            None if self.labels_mask is None else self.labels_mask[n_train:],
+        )
+        return tr, te
+
+    def shuffle(self, seed: Optional[int] = None) -> None:
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(self.num_examples())
+        self.features = self.features[perm]
+        self.labels = self.labels[perm]
+        if self.features_mask is not None:
+            self.features_mask = self.features_mask[perm]
+        if self.labels_mask is not None:
+            self.labels_mask = self.labels_mask[perm]
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        n = self.num_examples()
+        return [
+            DataSet(
+                self.features[i : i + batch_size],
+                self.labels[i : i + batch_size],
+                None if self.features_mask is None else self.features_mask[i : i + batch_size],
+                None if self.labels_mask is None else self.labels_mask[i : i + batch_size],
+            )
+            for i in range(0, n, batch_size)
+        ]
+
+    @staticmethod
+    def merge(datasets: Sequence["DataSet"]) -> "DataSet":
+        return DataSet(
+            np.concatenate([d.features for d in datasets]),
+            np.concatenate([d.labels for d in datasets]),
+            None if datasets[0].features_mask is None
+            else np.concatenate([d.features_mask for d in datasets]),
+            None if datasets[0].labels_mask is None
+            else np.concatenate([d.labels_mask for d in datasets]),
+        )
+
+
+@dataclasses.dataclass
+class MultiDataSet:
+    """Multiple feature/label arrays (reference: MultiDataSet) — the
+    ComputationGraph input container."""
+
+    features: Tuple[np.ndarray, ...]
+    labels: Tuple[np.ndarray, ...]
+    features_masks: Optional[Tuple[Optional[np.ndarray], ...]] = None
+    labels_masks: Optional[Tuple[Optional[np.ndarray], ...]] = None
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])

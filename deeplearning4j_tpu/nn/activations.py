@@ -1,0 +1,109 @@
+"""Activation functions.
+
+Capability parity with the reference's ``org.nd4j.linalg.activations.Activation``
+enum (canonical: nd4j-api, ~20 members). Each is a pure jnp function; XLA fuses
+them into adjacent matmuls/convs, so there is no per-activation kernel to write
+(the reference needs one native kernel per activation per dtype — SURVEY.md
+§2.1 "legacy op loops").
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def _cube(x):
+    return x * x * x
+
+
+def _hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def _hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def _rationaltanh(x):
+    # DL4J's RationalTanh: 1.7159 * tanh_approx(2x/3) via rational approximation
+    a = 0.6666667 * x
+    approx = jnp.sign(a) * (1.0 - 1.0 / (1.0 + jnp.abs(a) + a * a + 1.41645 * a * a * a * a))
+    return 1.7159 * approx
+
+
+def _rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def _thresholdedrelu(x, theta: float = 1.0):
+    return jnp.where(x > theta, x, 0.0)
+
+
+ACTIVATIONS: Dict[str, Callable] = {
+    "IDENTITY": lambda x: x,
+    "RELU": jax.nn.relu,
+    "RELU6": jax.nn.relu6,
+    "LEAKYRELU": lambda x: jax.nn.leaky_relu(x, 0.01),
+    "ELU": jax.nn.elu,
+    "SELU": jax.nn.selu,
+    "CELU": jax.nn.celu,
+    "GELU": jax.nn.gelu,
+    "SIGMOID": jax.nn.sigmoid,
+    "HARDSIGMOID": _hardsigmoid,
+    "TANH": jnp.tanh,
+    "HARDTANH": _hardtanh,
+    "RATIONALTANH": _rationaltanh,
+    "RECTIFIEDTANH": _rectifiedtanh,
+    "SOFTMAX": lambda x: jax.nn.softmax(x, axis=-1),
+    "LOGSOFTMAX": lambda x: jax.nn.log_softmax(x, axis=-1),
+    "SOFTPLUS": jax.nn.softplus,
+    "SOFTSIGN": jax.nn.soft_sign,
+    "CUBE": _cube,
+    "SWISH": jax.nn.swish,
+    "MISH": jax.nn.mish,
+    "THRESHOLDEDRELU": _thresholdedrelu,
+    "GLU": lambda x: jax.nn.glu(x, axis=-1),
+}
+
+
+class Activation(enum.Enum):
+    """Named activations matching the reference enum's vocabulary."""
+
+    IDENTITY = "IDENTITY"
+    RELU = "RELU"
+    RELU6 = "RELU6"
+    LEAKYRELU = "LEAKYRELU"
+    ELU = "ELU"
+    SELU = "SELU"
+    CELU = "CELU"
+    GELU = "GELU"
+    SIGMOID = "SIGMOID"
+    HARDSIGMOID = "HARDSIGMOID"
+    TANH = "TANH"
+    HARDTANH = "HARDTANH"
+    RATIONALTANH = "RATIONALTANH"
+    RECTIFIEDTANH = "RECTIFIEDTANH"
+    SOFTMAX = "SOFTMAX"
+    LOGSOFTMAX = "LOGSOFTMAX"
+    SOFTPLUS = "SOFTPLUS"
+    SOFTSIGN = "SOFTSIGN"
+    CUBE = "CUBE"
+    SWISH = "SWISH"
+    MISH = "MISH"
+    THRESHOLDEDRELU = "THRESHOLDEDRELU"
+    GLU = "GLU"
+
+    def __call__(self, x):
+        return ACTIVATIONS[self.value](x)
+
+    @classmethod
+    def from_any(cls, a) -> "Activation":
+        if isinstance(a, Activation):
+            return a
+        if isinstance(a, str):
+            return cls[a.upper()]
+        raise TypeError(f"Cannot interpret activation: {a!r}")

@@ -1,0 +1,137 @@
+"""Input types — shape inference through the network config.
+
+Parity with the reference's ``org.deeplearning4j.nn.conf.inputs.InputType``
+(canonical: deeplearning4j-nn): ``setInputType`` on the config builder walks
+layers, auto-computes each layer's nIn, and inserts preprocessors at
+format-change boundaries. Same machinery here, as pure data.
+
+Data formats (reference defaults preserved at the API boundary):
+* feed-forward: [batch, size]
+* recurrent:    [batch, size, time]  (NCW)
+* CNN 2D:       [batch, channels, height, width]  (NCHW)
+* CNN 3D:       [batch, channels, depth, height, width] (NCDHW)
+XLA re-lays-out internally for the TPU; the declared format only fixes the
+user-facing axis order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from ..core.config import register_config
+
+
+@dataclasses.dataclass(frozen=True)
+class InputType:
+    kind: str = "feed_forward"  # feed_forward | recurrent | convolutional | convolutional3d | convolutional_flat
+
+    @staticmethod
+    def feed_forward(size: int) -> "FeedForwardType":
+        return FeedForwardType(size=int(size))
+
+    @staticmethod
+    def recurrent(size: int, timesteps: Optional[int] = None) -> "RecurrentType":
+        return RecurrentType(size=int(size), timesteps=timesteps)
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "ConvolutionalType":
+        return ConvolutionalType(height=int(height), width=int(width), channels=int(channels))
+
+    @staticmethod
+    def convolutional3d(depth: int, height: int, width: int, channels: int) -> "Convolutional3DType":
+        return Convolutional3DType(
+            depth=int(depth), height=int(height), width=int(width), channels=int(channels)
+        )
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "ConvolutionalFlatType":
+        return ConvolutionalFlatType(height=int(height), width=int(width), channels=int(channels))
+
+    def flat_size(self) -> int:
+        raise NotImplementedError
+
+    def shape(self, batch: int = -1) -> Tuple[int, ...]:
+        raise NotImplementedError
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class FeedForwardType(InputType):
+    kind: str = "feed_forward"
+    size: int = 0
+
+    def flat_size(self) -> int:
+        return self.size
+
+    def shape(self, batch: int = -1) -> Tuple[int, ...]:
+        return (batch, self.size)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class RecurrentType(InputType):
+    kind: str = "recurrent"
+    size: int = 0
+    timesteps: Optional[int] = None
+
+    def flat_size(self) -> int:
+        if self.timesteps is None:
+            raise ValueError("Recurrent input with unknown timesteps has no flat size")
+        return self.size * self.timesteps
+
+    def shape(self, batch: int = -1) -> Tuple[int, ...]:
+        return (batch, self.size, self.timesteps or -1)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class ConvolutionalType(InputType):
+    kind: str = "convolutional"
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def flat_size(self) -> int:
+        return self.height * self.width * self.channels
+
+    def shape(self, batch: int = -1) -> Tuple[int, ...]:
+        return (batch, self.channels, self.height, self.width)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class Convolutional3DType(InputType):
+    kind: str = "convolutional3d"
+    depth: int = 0
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def flat_size(self) -> int:
+        return self.depth * self.height * self.width * self.channels
+
+    def shape(self, batch: int = -1) -> Tuple[int, ...]:
+        return (batch, self.channels, self.depth, self.height, self.width)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class ConvolutionalFlatType(InputType):
+    """Flattened image input (e.g. MNIST as [batch, 784]) that conv layers
+    should interpret as [batch, c, h, w] — reference inserts a
+    FeedForwardToCnnPreProcessor for this case."""
+
+    kind: str = "convolutional_flat"
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def flat_size(self) -> int:
+        return self.height * self.width * self.channels
+
+    def shape(self, batch: int = -1) -> Tuple[int, ...]:
+        return (batch, self.flat_size())
+
+    def as_convolutional(self) -> ConvolutionalType:
+        return ConvolutionalType(height=self.height, width=self.width, channels=self.channels)

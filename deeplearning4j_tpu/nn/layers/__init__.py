@@ -1,0 +1,71 @@
+from .base import Layer, LayerContext, Params, State
+from .attention import (
+    LearnedSelfAttentionLayer,
+    RecurrentAttentionLayer,
+    SelfAttentionLayer,
+    dot_product_attention,
+)
+from .conv import (
+    Convolution1DLayer,
+    Convolution3DLayer,
+    ConvolutionLayer,
+    ConvolutionMode,
+    Deconvolution2DLayer,
+    DepthwiseConvolution2DLayer,
+    SeparableConvolution2DLayer,
+)
+from .feedforward import (
+    ActivationLayer,
+    DenseLayer,
+    DropoutLayer,
+    EmbeddingLayer,
+    EmbeddingSequenceLayer,
+    PReLULayer,
+)
+from .norm import (
+    BatchNormalizationLayer,
+    LayerNormLayer,
+    LocalResponseNormalizationLayer,
+)
+from .output import (
+    BaseOutputLayer,
+    CnnLossLayer,
+    LossLayer,
+    OutputLayer,
+    RnnLossLayer,
+    RnnOutputLayer,
+)
+from .pooling import (
+    Cropping2DLayer,
+    GlobalPoolingLayer,
+    PoolingType,
+    SpaceToDepthLayer,
+    Subsampling1DLayer,
+    Subsampling3DLayer,
+    SubsamplingLayer,
+    Upsampling1DLayer,
+    Upsampling2DLayer,
+    Upsampling3DLayer,
+    ZeroPadding1DLayer,
+    ZeroPaddingLayer,
+)
+from .preprocessors import (
+    CnnToFeedForwardPreProcessor,
+    CnnToRnnPreProcessor,
+    FeedForwardToCnnPreProcessor,
+    FeedForwardToRnnPreProcessor,
+    RnnToCnnPreProcessor,
+    RnnToFeedForwardPreProcessor,
+)
+from .recurrent import (
+    BidirectionalLayer,
+    BidirectionalMode,
+    GravesLSTMLayer,
+    LSTMLayer,
+    LastTimeStepLayer,
+    MaskZeroLayer,
+    SimpleRnnLayer,
+    TimeDistributedLayer,
+)
+
+__all__ = [n for n in dir() if not n.startswith("_")]

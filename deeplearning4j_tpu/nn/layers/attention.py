@@ -1,0 +1,256 @@
+"""Attention layers.
+
+Reference: org.deeplearning4j.nn.conf.layers.{SelfAttentionLayer,
+LearnedSelfAttentionLayer, RecurrentAttentionLayer} and the SameDiff
+``multiHeadDotProductAttention`` op (SURVEY.md §5.7).
+
+TPU design: attention is expressed as einsums that XLA maps to MXU matmuls.
+The masked-softmax uses an additive -inf bias (no data-dependent shapes). A
+Pallas flash-attention kernel can be slotted in as the accelerated helper for
+long sequences (ops/pallas) — the layer semantics here are the reference ones.
+
+Data format follows the recurrent convention [batch, features, time]; heads
+are split internally.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...core.config import register_config
+from ..activations import Activation
+from ..input_type import InputType, RecurrentType
+from ..weights import WeightInit, init_weights
+from .base import Layer, LayerContext, Params, State, apply_input_dropout
+
+
+def dot_product_attention(
+    q: jax.Array,  # [b, h, tq, d]
+    k: jax.Array,  # [b, h, tk, d]
+    v: jax.Array,  # [b, h, tk, dv]
+    mask: Optional[jax.Array] = None,  # [b, tk]
+    scaled: bool = True,
+) -> jax.Array:
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+    if scaled:
+        scores = scores / math.sqrt(q.shape[-1])
+    if mask is not None:
+        neg = jnp.asarray(jnp.finfo(scores.dtype).min, scores.dtype)
+        scores = jnp.where(mask[:, None, None, :] > 0, scores, neg)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkv->bhqv", weights, v)
+
+
+def _split_heads(x: jax.Array, n_heads: int) -> jax.Array:
+    b, t, f = x.shape
+    return x.reshape(b, t, n_heads, f // n_heads).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(x: jax.Array) -> jax.Array:
+    b, h, t, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, t, h * d)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class SelfAttentionLayer(Layer):
+    """Multi-head dot-product self-attention (reference: SelfAttentionLayer).
+    Input/output [b, f, t]. With ``project_input`` learns Wq/Wk/Wv/Wo."""
+
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: int = 0
+    project_input: bool = True
+
+    def __post_init__(self):
+        if self.n_out and not self.head_size:
+            object.__setattr__(self, "head_size", self.n_out // self.n_heads)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        size = self.n_out if self.project_input else input_type.size
+        return RecurrentType(size=size, timesteps=input_type.timesteps)
+
+    def with_input(self, input_type: InputType) -> "SelfAttentionLayer":
+        out = self
+        if not out.n_in:
+            out = dataclasses.replace(out, n_in=input_type.size)
+        if not out.n_out and not out.project_input:
+            out = dataclasses.replace(out, n_out=input_type.size)
+        if out.n_out and not out.head_size:
+            out = dataclasses.replace(out, head_size=out.n_out // out.n_heads)
+        return out
+
+    def has_params(self) -> bool:
+        return self.project_input
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return ("Wq", "Wk", "Wv", "Wo") if self.project_input else ()
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        if not self.project_input:
+            return {}
+        wi = self.weight_init or WeightInit.XAVIER
+        hs = self.n_heads * self.head_size
+        ks = jax.random.split(key, 4)
+        return {
+            "Wq": init_weights(ks[0], (self.n_in, hs), wi, self.n_in, hs, None, dtype),
+            "Wk": init_weights(ks[1], (self.n_in, hs), wi, self.n_in, hs, None, dtype),
+            "Wv": init_weights(ks[2], (self.n_in, hs), wi, self.n_in, hs, None, dtype),
+            "Wo": init_weights(ks[3], (hs, self.n_out), wi, hs, self.n_out, None, dtype),
+        }
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        x = apply_input_dropout(self, x, ctx)
+        xt = x.transpose(0, 2, 1)  # [b, t, f]
+        if self.project_input:
+            q = _split_heads(xt @ params["Wq"], self.n_heads)
+            k = _split_heads(xt @ params["Wk"], self.n_heads)
+            v = _split_heads(xt @ params["Wv"], self.n_heads)
+        else:
+            q = k = v = _split_heads(xt, self.n_heads)
+        o = dot_product_attention(q, k, v, mask=ctx.mask)
+        o = _merge_heads(o)
+        if self.project_input:
+            o = o @ params["Wo"]
+        act = self.activation or Activation.IDENTITY
+        return act(o).transpose(0, 2, 1), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class LearnedSelfAttentionLayer(Layer):
+    """Attention with learned query vectors (reference:
+    LearnedSelfAttentionLayer): output has fixed n_queries timesteps."""
+
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: int = 0
+    n_queries: int = 1
+    project_input: bool = True
+
+    def __post_init__(self):
+        if self.n_out and not self.head_size:
+            object.__setattr__(self, "head_size", self.n_out // self.n_heads)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        size = self.n_out if self.project_input else input_type.size
+        return RecurrentType(size=size, timesteps=self.n_queries)
+
+    def with_input(self, input_type: InputType) -> "LearnedSelfAttentionLayer":
+        out = self
+        if not out.n_in:
+            out = dataclasses.replace(out, n_in=input_type.size)
+        if not out.n_out and not out.project_input:
+            out = dataclasses.replace(out, n_out=input_type.size)
+        if out.n_out and not out.head_size:
+            out = dataclasses.replace(out, head_size=out.n_out // out.n_heads)
+        return out
+
+    def has_params(self) -> bool:
+        return True
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        base = ("Q",)
+        return base + (("Wq", "Wk", "Wv", "Wo") if self.project_input else ())
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        wi = self.weight_init or WeightInit.XAVIER
+        hs = self.n_heads * self.head_size if self.project_input else self.n_in
+        ks = jax.random.split(key, 5)
+        p: Params = {"Q": init_weights(ks[4], (self.n_queries, hs), wi, hs, hs, None, dtype)}
+        if self.project_input:
+            p.update({
+                "Wq": init_weights(ks[0], (hs, hs), wi, hs, hs, None, dtype),
+                "Wk": init_weights(ks[1], (self.n_in, hs), wi, self.n_in, hs, None, dtype),
+                "Wv": init_weights(ks[2], (self.n_in, hs), wi, self.n_in, hs, None, dtype),
+                "Wo": init_weights(ks[3], (hs, self.n_out), wi, hs, self.n_out, None, dtype),
+            })
+        return p
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        x = apply_input_dropout(self, x, ctx)
+        b = x.shape[0]
+        xt = x.transpose(0, 2, 1)
+        queries = jnp.broadcast_to(params["Q"], (b,) + params["Q"].shape)
+        if self.project_input:
+            q = _split_heads(queries @ params["Wq"], self.n_heads)
+            k = _split_heads(xt @ params["Wk"], self.n_heads)
+            v = _split_heads(xt @ params["Wv"], self.n_heads)
+        else:
+            q = _split_heads(queries, self.n_heads)
+            k = v = _split_heads(xt, self.n_heads)
+        o = _merge_heads(dot_product_attention(q, k, v, mask=ctx.mask))
+        if self.project_input:
+            o = o @ params["Wo"]
+        act = self.activation or Activation.IDENTITY
+        return act(o).transpose(0, 2, 1), state
+
+    def feed_forward_mask(self, mask, input_type):
+        return None  # output timesteps are the learned queries — all valid
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class RecurrentAttentionLayer(Layer):
+    """Recurrent cell attending over the full input sequence at each step
+    (reference: RecurrentAttentionLayer): h_t = act(x_t W + h_{t-1} RW +
+    attn(h_{t-1}, X) Wa + b)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 1
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return RecurrentType(size=self.n_out, timesteps=input_type.timesteps)
+
+    def with_input(self, input_type: InputType) -> "RecurrentAttentionLayer":
+        if self.n_in:
+            return self
+        return dataclasses.replace(self, n_in=input_type.size)
+
+    def has_params(self) -> bool:
+        return True
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return ("W", "RW", "Wa", "b")
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        wi = self.weight_init or WeightInit.XAVIER
+        ks = jax.random.split(key, 3)
+        return {
+            "W": init_weights(ks[0], (self.n_in, self.n_out), wi, self.n_in, self.n_out, None, dtype),
+            "RW": init_weights(ks[1], (self.n_out, self.n_out), wi, self.n_out, self.n_out, None, dtype),
+            "Wa": init_weights(ks[2], (self.n_in, self.n_out), wi, self.n_in, self.n_out, None, dtype),
+            "b": jnp.full((self.n_out,), self.bias_init, dtype),
+        }
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        x = apply_input_dropout(self, x, ctx)
+        b, f, t = x.shape
+        act = self.activation or Activation.TANH
+        xt = x.transpose(2, 0, 1)  # [t, b, f]
+        x_proj = jnp.einsum("tbf,fo->tbo", xt, params["W"]) + params["b"]
+        keys = x.transpose(0, 2, 1)  # [b, t, f]
+        mask = ctx.mask
+
+        def step(h, xp):
+            # attention of h over the input sequence
+            scores = jnp.einsum("bo,fo,btf->bt", h, params["Wa"], keys) / math.sqrt(f)
+            if mask is not None:
+                neg = jnp.asarray(jnp.finfo(scores.dtype).min, scores.dtype)
+                scores = jnp.where(mask > 0, scores, neg)
+            w = jax.nn.softmax(scores, axis=-1)
+            attended = jnp.einsum("bt,btf->bf", w, keys)  # [b, f]
+            h_new = act(xp + h @ params["RW"] + attended @ params["Wa"])
+            return h_new, h_new
+
+        h0 = jnp.zeros((b, self.n_out), x.dtype)
+        _, hs = jax.lax.scan(step, h0, x_proj)
+        return hs.transpose(1, 2, 0), state

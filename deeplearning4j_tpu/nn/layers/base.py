@@ -1,0 +1,121 @@
+"""Layer protocol.
+
+The reference splits a layer into a config class (org.deeplearning4j.nn.conf.
+layers.*) and an implementation class (org.deeplearning4j.nn.layers.*) bound to
+a param view in the model's flat buffer. Here a layer is ONE immutable config
+dataclass with pure functions:
+
+* ``output_type(input)``   — InputType shape inference (reference: getOutputType)
+* ``with_input(input)``    — returns a config with nIn/shape fields resolved
+                             (reference: setNIn during setInputType walk)
+* ``init(key, dtype)``     — build the param pytree (dict of named arrays,
+                             names matching the reference's param keys W/b/RW/
+                             gamma/beta... for checkpoint familiarity)
+* ``init_state(dtype)``    — non-trainable state (BN running stats, RNN carry)
+* ``apply(params, state, x, ctx)`` -> (y, new_state)
+
+``apply`` is trace-friendly: no Python branching on array values; ``train`` is
+a static Python bool baked into the jitted train/infer programs.
+
+Backprop does not exist as a method — jax reverse-mode AD differentiates
+``apply`` directly, which removes the reference's entire backpropGradient
+codepath (and its class of fwd/bwd mismatch bugs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+from ...core.config import register_config
+from ..activations import Activation
+from ..input_type import InputType
+from ..weights import Distribution, WeightInit
+
+Params = Dict[str, Any]
+State = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerContext:
+    """Per-call dynamic context threaded through layer application."""
+
+    train: bool = False
+    rng: Optional[jax.Array] = None  # dropout/noise key (None in inference)
+    mask: Optional[jax.Array] = None  # sequence mask [batch, time] where applicable
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class Layer:
+    """Base layer config. Fields set to None inherit the network's global
+    defaults (reference: NeuralNetConfiguration.Builder global conf)."""
+
+    name: Optional[str] = None
+    activation: Optional[Activation] = None
+    weight_init: Optional[WeightInit] = None
+    weight_init_distribution: Optional[Distribution] = None
+    bias_init: float = 0.0
+    dropout: Optional[float] = None  # retain-input semantics? see note below
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    l1_bias: Optional[float] = None
+    l2_bias: Optional[float] = None
+    weight_decay: Optional[float] = None
+    updater: Optional[Any] = None  # per-layer updater config override
+    frozen: bool = False  # transfer-learning freeze (reference: FrozenLayer)
+
+    # NOTE on dropout: the reference's layer-level ``dropOut(p)`` keeps each
+    # input unit with probability p and scales by 1/p (inverted dropout with
+    # p = RETAIN probability, applied to the layer INPUT). We preserve that
+    # convention: ``dropout=0.8`` keeps 80% of inputs.
+
+    # ---- shape inference ---------------------------------------------------
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def with_input(self, input_type: InputType) -> "Layer":
+        return self
+
+    # ---- parameters --------------------------------------------------------
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        return {}
+
+    def init_state(self, dtype: Any) -> State:
+        return {}
+
+    def has_params(self) -> bool:
+        return False
+
+    # ---- forward -----------------------------------------------------------
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        raise NotImplementedError
+
+    # ---- mask propagation (reference: feedForwardMaskArray) ----------------
+    def feed_forward_mask(self, mask: Optional[jax.Array], input_type: InputType) -> Optional[jax.Array]:
+        return mask
+
+    # ---- regularization contribution for the score (reference: calcRegularizationScore)
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return tuple()
+
+    def weight_param_names(self) -> Tuple[str, ...]:
+        """Params that l1/l2/weight-decay apply to (biases excluded)."""
+        return tuple(n for n in self.trainable_param_names() if n not in ("b", "gb", "bb"))
+
+
+def resolve(value, default):
+    return default if value is None else value
+
+
+def apply_input_dropout(cfg: Layer, x: jax.Array, ctx: LayerContext) -> jax.Array:
+    """Inverted dropout on layer input, reference retain-probability semantics."""
+    if cfg.dropout is None or not ctx.train or ctx.rng is None:
+        return x
+    retain = float(cfg.dropout)
+    if retain >= 1.0:
+        return x
+    keep = jax.random.bernoulli(ctx.rng, retain, x.shape)
+    return jax.numpy.where(keep, x / retain, 0.0).astype(x.dtype)

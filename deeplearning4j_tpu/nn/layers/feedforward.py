@@ -1,0 +1,207 @@
+"""Feed-forward layers: Dense, Activation, Dropout, Embedding, PReLU.
+
+Reference configs: org.deeplearning4j.nn.conf.layers.{DenseLayer,
+ActivationLayer, DropoutLayer, EmbeddingLayer, EmbeddingSequenceLayer,
+PReLULayer} (canonical: deeplearning4j-nn).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...core.config import register_config
+from ..activations import Activation
+from ..input_type import FeedForwardType, InputType, RecurrentType
+from ..weights import WeightInit, init_weights
+from .base import Layer, LayerContext, Params, State, apply_input_dropout
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class DenseLayer(Layer):
+    """Fully connected layer: y = act(xW + b). Params W:[nIn,nOut] b:[1,nOut]."""
+
+    n_in: int = 0
+    n_out: int = 0
+    has_bias: bool = True
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return FeedForwardType(size=self.n_out)
+
+    def with_input(self, input_type: InputType) -> "DenseLayer":
+        if self.n_in:
+            return self
+        return dataclasses.replace(self, n_in=input_type.flat_size())
+
+    def has_params(self) -> bool:
+        return True
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return ("W", "b") if self.has_bias else ("W",)
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        w = init_weights(
+            key, (self.n_in, self.n_out), self.weight_init or WeightInit.XAVIER,
+            fan_in=self.n_in, fan_out=self.n_out,
+            distribution=self.weight_init_distribution, dtype=dtype,
+        )
+        p: Params = {"W": w}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        x = apply_input_dropout(self, x, ctx)
+        y = x @ params["W"]
+        if self.has_bias:
+            y = y + params["b"]
+        act = self.activation or Activation.SIGMOID  # reference default
+        return act(y), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class ActivationLayer(Layer):
+    """Applies an activation only (reference: ActivationLayer)."""
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        act = self.activation or Activation.IDENTITY
+        return act(x), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class DropoutLayer(Layer):
+    """Standalone dropout layer (reference: DropoutLayer). ``dropout`` is the
+    retain probability, matching the reference's convention."""
+
+    def __post_init__(self):
+        if self.dropout is None:
+            object.__setattr__(self, "dropout", 0.5)
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        return apply_input_dropout(self, x, ctx), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class EmbeddingLayer(Layer):
+    """Index -> embedding row lookup for single indices (reference:
+    EmbeddingLayer). Input: [batch] or [batch, 1] integer ids. On TPU the
+    lookup is a gather, which XLA maps efficiently; there is no sparse-update
+    special path (full-dense grads are fine at TPU HBM bandwidth)."""
+
+    n_in: int = 0  # vocab size
+    n_out: int = 0
+    has_bias: bool = False
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return FeedForwardType(size=self.n_out)
+
+    def with_input(self, input_type: InputType) -> "EmbeddingLayer":
+        return self  # vocab size cannot be inferred from input shape
+
+    def has_params(self) -> bool:
+        return True
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return ("W", "b") if self.has_bias else ("W",)
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        w = init_weights(
+            key, (self.n_in, self.n_out), self.weight_init or WeightInit.XAVIER,
+            fan_in=self.n_in, fan_out=self.n_out,
+            distribution=self.weight_init_distribution, dtype=dtype,
+        )
+        p: Params = {"W": w}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx.squeeze(-1)
+        y = jnp.take(params["W"], idx, axis=0)
+        if self.has_bias:
+            y = y + params["b"]
+        act = self.activation or Activation.IDENTITY
+        return act(y), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class EmbeddingSequenceLayer(Layer):
+    """Sequence of ids -> sequence of embeddings (reference:
+    EmbeddingSequenceLayer). Input [batch, time] (or [batch, 1, time]) ids;
+    output recurrent format [batch, n_out, time]."""
+
+    n_in: int = 0
+    n_out: int = 0
+    has_bias: bool = False
+    inference_mode: bool = False
+
+    def output_type(self, input_type: InputType) -> InputType:
+        ts = input_type.timesteps if isinstance(input_type, RecurrentType) else None
+        return RecurrentType(size=self.n_out, timesteps=ts)
+
+    def has_params(self) -> bool:
+        return True
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return ("W", "b") if self.has_bias else ("W",)
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        w = init_weights(
+            key, (self.n_in, self.n_out), self.weight_init or WeightInit.XAVIER,
+            fan_in=self.n_in, fan_out=self.n_out,
+            distribution=self.weight_init_distribution, dtype=dtype,
+        )
+        p: Params = {"W": w}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 3:  # [batch, 1, time]
+            idx = idx.squeeze(1)
+        emb = jnp.take(params["W"], idx, axis=0)  # [batch, time, n_out]
+        if self.has_bias:
+            emb = emb + params["b"]
+        act = self.activation or Activation.IDENTITY
+        return act(emb).transpose(0, 2, 1), state  # -> [batch, n_out, time]
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class PReLULayer(Layer):
+    """Parametric ReLU with learned per-element alpha (reference: PReLULayer)."""
+
+    input_shape: Tuple[int, ...] = ()
+    shared_axes: Tuple[int, ...] = ()  # 1-indexed feature axes to share alpha over
+
+    def with_input(self, input_type: InputType) -> "PReLULayer":
+        if self.input_shape:
+            return self
+        return dataclasses.replace(self, input_shape=tuple(input_type.shape(1)[1:]))
+
+    def has_params(self) -> bool:
+        return True
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return ("W",)
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        shape = list(self.input_shape)
+        for ax in self.shared_axes:
+            shape[ax - 1] = 1
+        return {"W": jnp.zeros(tuple(shape), dtype)}
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        alpha = params["W"]
+        return jnp.where(x >= 0, x, alpha * x), state

@@ -1,0 +1,156 @@
+"""Normalization layers.
+
+Reference: org.deeplearning4j.nn.conf.layers.{BatchNormalization,
+LocalResponseNormalization} (+ cuDNN helpers CudnnBatchNormalizationHelper,
+CudnnLocalResponseNormalizationHelper — here XLA fuses the normalization math
+into neighbours, no helper needed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...core.config import register_config
+from ..activations import Activation
+from ..input_type import ConvolutionalType, FeedForwardType, InputType, RecurrentType
+from .base import Layer, LayerContext, Params, State
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class BatchNormalizationLayer(Layer):
+    """Batch normalization (reference: BatchNormalization).
+
+    Params: gamma/beta [nOut]; state: running mean/var [nOut] updated with the
+    reference's decay convention: global = decay*global + (1-decay)*batch.
+    Supports FF [b,f], recurrent [b,f,t] and CNN [b,c,h,w] inputs (per-channel).
+    """
+
+    n_out: int = 0
+    decay: float = 0.9
+    eps: float = 1e-5
+    lock_gamma_beta: bool = False
+    gamma_init: float = 1.0
+    beta_init: float = 0.0
+
+    def with_input(self, input_type: InputType) -> "BatchNormalizationLayer":
+        if self.n_out:
+            return self
+        if isinstance(input_type, (ConvolutionalType, RecurrentType)):
+            n = input_type.channels if isinstance(input_type, ConvolutionalType) else input_type.size
+        else:
+            n = input_type.flat_size()
+        return dataclasses.replace(self, n_out=n)
+
+    def has_params(self) -> bool:
+        return True
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return () if self.lock_gamma_beta else ("gamma", "beta")
+
+    def weight_param_names(self) -> Tuple[str, ...]:
+        return ()  # reference never regularizes gamma/beta
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        if self.lock_gamma_beta:
+            return {}
+        return {
+            "gamma": jnp.full((self.n_out,), self.gamma_init, dtype),
+            "beta": jnp.full((self.n_out,), self.beta_init, dtype),
+        }
+
+    def init_state(self, dtype: Any) -> State:
+        return {
+            "mean": jnp.zeros((self.n_out,), dtype),
+            "var": jnp.ones((self.n_out,), dtype),
+        }
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        # reduce over all axes except the feature axis (1)
+        axes = (0,) + tuple(range(2, x.ndim))
+        bshape = (1, self.n_out) + (1,) * (x.ndim - 2)
+        if ctx.train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1.0 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1.0 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        xhat = (x - mean.reshape(bshape)) * jax.lax.rsqrt(var.reshape(bshape) + self.eps)
+        if not self.lock_gamma_beta:
+            xhat = xhat * params["gamma"].reshape(bshape) + params["beta"].reshape(bshape)
+        act = self.activation or Activation.IDENTITY
+        return act(xhat), new_state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class LocalResponseNormalizationLayer(Layer):
+    """Cross-channel LRN over NCHW (reference: LocalResponseNormalization;
+    AlexNet-era). y = x / (k + alpha*sum_adjacent(x^2))^beta."""
+
+    k: float = 2.0
+    n: float = 5.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        half = int(self.n) // 2
+        sq = x * x
+        # sum over a window of channels: pad then reduce_window over axis 1
+        summed = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add,
+            window_dimensions=(1, int(self.n), 1, 1),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (half, half), (0, 0), (0, 0)),
+        )
+        return x / jnp.power(self.k + self.alpha * summed, self.beta), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class LayerNormLayer(Layer):
+    """Layer normalization over the feature axis. The reference exposes this as
+    the ``layerNorm`` option on dense/RNN layers and the SameDiff ``layerNorm``
+    op; here it is also a standalone layer (transformer building block)."""
+
+    n_out: int = 0
+    eps: float = 1e-5
+
+    def with_input(self, input_type: InputType) -> "LayerNormLayer":
+        if self.n_out:
+            return self
+        n = input_type.size if isinstance(input_type, RecurrentType) else input_type.flat_size()
+        return dataclasses.replace(self, n_out=n)
+
+    def has_params(self) -> bool:
+        return True
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return ("gamma", "beta")
+
+    def weight_param_names(self) -> Tuple[str, ...]:
+        return ()
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        return {
+            "gamma": jnp.ones((self.n_out,), dtype),
+            "beta": jnp.zeros((self.n_out,), dtype),
+        }
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        feat_axis = 1 if x.ndim == 3 else -1  # recurrent [b,f,t] vs ff [b,f]
+        mean = jnp.mean(x, axis=feat_axis, keepdims=True)
+        var = jnp.var(x, axis=feat_axis, keepdims=True)
+        xhat = (x - mean) * jax.lax.rsqrt(var + self.eps)
+        bshape = (1, self.n_out, 1) if x.ndim == 3 else (1, self.n_out)
+        y = xhat * params["gamma"].reshape(bshape) + params["beta"].reshape(bshape)
+        act = self.activation or Activation.IDENTITY
+        return act(y), state

@@ -1,0 +1,232 @@
+"""Output / loss layers.
+
+Reference: org.deeplearning4j.nn.conf.layers.{OutputLayer, RnnOutputLayer,
+RnnLossLayer, LossLayer, CnnLossLayer, CenterLossOutputLayer}. An output layer
+= (optional dense projection) + ILossFunction; the model calls
+``compute_loss`` during fit and ``apply`` during output().
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...core.config import register_config
+from ..activations import Activation
+from ..input_type import ConvolutionalType, FeedForwardType, InputType, RecurrentType
+from ..losses import LossFunction
+from ..weights import WeightInit, init_weights
+from .base import Layer, LayerContext, Params, State, apply_input_dropout
+
+
+class BaseOutputLayer(Layer):
+    """Marker base for layers that terminate a network with a loss."""
+
+    def preoutput(self, params: Params, x: jax.Array, ctx: LayerContext) -> jax.Array:
+        raise NotImplementedError
+
+    def compute_loss(
+        self,
+        params: Params,
+        x: jax.Array,
+        labels: jax.Array,
+        ctx: LayerContext,
+        label_mask: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        raise NotImplementedError
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class OutputLayer(BaseOutputLayer):
+    """Dense + loss on feed-forward input (reference: OutputLayer).
+    Default activation SOFTMAX + MCXENT, matching the reference."""
+
+    n_in: int = 0
+    n_out: int = 0
+    loss: LossFunction = LossFunction.MCXENT
+    has_bias: bool = True
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return FeedForwardType(size=self.n_out)
+
+    def with_input(self, input_type: InputType) -> "OutputLayer":
+        if self.n_in:
+            return self
+        return dataclasses.replace(self, n_in=input_type.flat_size())
+
+    def has_params(self) -> bool:
+        return True
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return ("W", "b") if self.has_bias else ("W",)
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        w = init_weights(key, (self.n_in, self.n_out),
+                         self.weight_init or WeightInit.XAVIER,
+                         self.n_in, self.n_out, self.weight_init_distribution, dtype)
+        p: Params = {"W": w}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def preoutput(self, params: Params, x: jax.Array, ctx: LayerContext) -> jax.Array:
+        x = apply_input_dropout(self, x, ctx)
+        y = x @ params["W"]
+        if self.has_bias:
+            y = y + params["b"]
+        return y
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        act = self.activation or Activation.SOFTMAX
+        return act(self.preoutput(params, x, ctx)), state
+
+    def compute_loss(self, params, x, labels, ctx, label_mask=None):
+        pre = self.preoutput(params, x, ctx)
+        act = self.activation or Activation.SOFTMAX
+        return self.loss.score(labels, pre, act, mask=label_mask)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class LossLayer(BaseOutputLayer):
+    """Loss without params (reference: LossLayer). Activation default IDENTITY."""
+
+    loss: LossFunction = LossFunction.MCXENT
+
+    def preoutput(self, params: Params, x: jax.Array, ctx: LayerContext) -> jax.Array:
+        return x
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        act = self.activation or Activation.IDENTITY
+        return act(x), state
+
+    def compute_loss(self, params, x, labels, ctx, label_mask=None):
+        act = self.activation or Activation.IDENTITY
+        return self.loss.score(labels, x, act, mask=label_mask)
+
+
+def _rnn_to_ff(a: jax.Array) -> jax.Array:
+    """[b, f, t] -> [b*t, f] preserving the reference's flattening order."""
+    b, f, t = a.shape
+    return a.transpose(0, 2, 1).reshape(b * t, f)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class RnnOutputLayer(BaseOutputLayer):
+    """Per-timestep dense + loss (reference: RnnOutputLayer). Input [b, nIn, t],
+    labels [b, nOut, t], mask [b, t]."""
+
+    n_in: int = 0
+    n_out: int = 0
+    loss: LossFunction = LossFunction.MCXENT
+    has_bias: bool = True
+
+    def output_type(self, input_type: InputType) -> InputType:
+        ts = input_type.timesteps if isinstance(input_type, RecurrentType) else None
+        return RecurrentType(size=self.n_out, timesteps=ts)
+
+    def with_input(self, input_type: InputType) -> "RnnOutputLayer":
+        if self.n_in or not isinstance(input_type, RecurrentType):
+            return self
+        return dataclasses.replace(self, n_in=input_type.size)
+
+    def has_params(self) -> bool:
+        return True
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return ("W", "b") if self.has_bias else ("W",)
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        w = init_weights(key, (self.n_in, self.n_out),
+                         self.weight_init or WeightInit.XAVIER,
+                         self.n_in, self.n_out, self.weight_init_distribution, dtype)
+        p: Params = {"W": w}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def preoutput(self, params: Params, x: jax.Array, ctx: LayerContext) -> jax.Array:
+        x = apply_input_dropout(self, x, ctx)
+        flat = _rnn_to_ff(x)
+        y = flat @ params["W"]
+        if self.has_bias:
+            y = y + params["b"]
+        return y  # [b*t, nOut]
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        b, _, t = x.shape
+        act = self.activation or Activation.SOFTMAX
+        y = act(self.preoutput(params, x, ctx))
+        return y.reshape(b, t, self.n_out).transpose(0, 2, 1), state
+
+    def compute_loss(self, params, x, labels, ctx, label_mask=None):
+        pre = self.preoutput(params, x, ctx)  # [b*t, nOut]
+        lab = _rnn_to_ff(labels)
+        act = self.activation or Activation.SOFTMAX
+        mask = None
+        if label_mask is not None:
+            mask = label_mask.reshape(-1)
+        elif ctx.mask is not None:
+            mask = ctx.mask.reshape(-1)
+        return self.loss.score(lab, pre, act, mask=mask)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class RnnLossLayer(BaseOutputLayer):
+    """Per-timestep loss without params (reference: RnnLossLayer)."""
+
+    loss: LossFunction = LossFunction.MCXENT
+
+    def preoutput(self, params: Params, x: jax.Array, ctx: LayerContext) -> jax.Array:
+        return x
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        act = self.activation or Activation.IDENTITY
+        b, f, t = x.shape
+        y = act(_rnn_to_ff(x))
+        return y.reshape(b, t, f).transpose(0, 2, 1), state
+
+    def compute_loss(self, params, x, labels, ctx, label_mask=None):
+        pre = _rnn_to_ff(x)
+        lab = _rnn_to_ff(labels)
+        act = self.activation or Activation.IDENTITY
+        mask = None
+        if label_mask is not None:
+            mask = label_mask.reshape(-1)
+        elif ctx.mask is not None:
+            mask = ctx.mask.reshape(-1)
+        return self.loss.score(lab, pre, act, mask=mask)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class CnnLossLayer(BaseOutputLayer):
+    """Per-pixel loss on CNN output [b, c, h, w] (reference: CnnLossLayer).
+    Labels same shape; mask [b, 1, h, w] or [b, h, w] optional."""
+
+    loss: LossFunction = LossFunction.MCXENT
+
+    def preoutput(self, params: Params, x: jax.Array, ctx: LayerContext) -> jax.Array:
+        return x
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        act = self.activation or Activation.IDENTITY
+        # activation applied over channel axis: move C last, apply, move back
+        y = act(x.transpose(0, 2, 3, 1)).transpose(0, 3, 1, 2)
+        return y, state
+
+    def compute_loss(self, params, x, labels, ctx, label_mask=None):
+        b, c, h, w = x.shape
+        pre = x.transpose(0, 2, 3, 1).reshape(b * h * w, c)
+        lab = labels.transpose(0, 2, 3, 1).reshape(b * h * w, c)
+        act = self.activation or Activation.IDENTITY
+        mask = None
+        if label_mask is not None:
+            mask = label_mask.reshape(-1)
+        return self.loss.score(lab, pre, act, mask=mask)

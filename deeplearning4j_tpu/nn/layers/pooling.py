@@ -1,0 +1,333 @@
+"""Pooling and spatial reshape layers.
+
+Reference configs: SubsamplingLayer / Subsampling1DLayer / Subsampling3DLayer,
+GlobalPoolingLayer, Upsampling1D/2D/3D, ZeroPaddingLayer, Cropping2D,
+SpaceToDepthLayer (canonical: org.deeplearning4j.nn.conf.layers.*). All lower
+to ``lax.reduce_window`` / reshape — XLA maps these directly onto the VPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.config import register_config
+from ..input_type import (
+    Convolutional3DType,
+    ConvolutionalType,
+    FeedForwardType,
+    InputType,
+    RecurrentType,
+)
+from .base import Layer, LayerContext, Params, State
+from .conv import ConvolutionMode, _lax_padding, _out_size
+
+
+class PoolingType(enum.Enum):
+    MAX = "MAX"
+    AVG = "AVG"
+    SUM = "SUM"
+    PNORM = "PNORM"
+
+
+def _pool(x, pooling, window, strides, padding, pnorm: int = 2, spatial_axes=None):
+    """reduce_window pooling over the given spatial window (full-shape specs)."""
+    if pooling is PoolingType.MAX:
+        init = -jnp.inf
+        y = lax.reduce_window(x, init, lax.max, window, strides, padding)
+        return y
+    if pooling in (PoolingType.AVG, PoolingType.SUM):
+        y = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+        if pooling is PoolingType.SUM:
+            return y
+        if padding == "SAME" or (isinstance(padding, (list, tuple)) and any(p != (0, 0) for p in padding)):
+            # divide by the actual (unpadded) window count per position
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+            return y / counts
+        denom = 1
+        for w in window:
+            denom *= w
+        return y / denom
+    if pooling is PoolingType.PNORM:
+        p = float(pnorm)
+        y = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides, padding)
+        return y ** (1.0 / p)
+    raise ValueError(f"Unhandled pooling {pooling}")
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class SubsamplingLayer(Layer):
+    """2-D pooling over NCHW (reference: SubsamplingLayer)."""
+
+    pooling_type: PoolingType = PoolingType.MAX
+    kernel_size: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    padding: Tuple[int, int] = (0, 0)
+    dilation: Tuple[int, int] = (1, 1)
+    convolution_mode: ConvolutionMode = ConvolutionMode.TRUNCATE
+    pnorm: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        h = _out_size(input_type.height, self.kernel_size[0], self.stride[0],
+                      self.padding[0], self.dilation[0], self.convolution_mode)
+        w = _out_size(input_type.width, self.kernel_size[1], self.stride[1],
+                      self.padding[1], self.dilation[1], self.convolution_mode)
+        return ConvolutionalType(height=h, width=w, channels=input_type.channels)
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        pad = _lax_padding(self.convolution_mode, self.padding, self.kernel_size, self.dilation)
+        if isinstance(pad, list):
+            pad = [(0, 0), (0, 0)] + pad
+        window = (1, 1) + tuple(self.kernel_size)
+        strides = (1, 1) + tuple(self.stride)
+        return _pool(x, self.pooling_type, window, strides, pad, self.pnorm), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class Subsampling1DLayer(Layer):
+    """1-D pooling over [batch, channels, time] (reference: Subsampling1DLayer)."""
+
+    pooling_type: PoolingType = PoolingType.MAX
+    kernel_size: int = 2
+    stride: int = 2
+    padding: int = 0
+    convolution_mode: ConvolutionMode = ConvolutionMode.TRUNCATE
+    pnorm: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        ts = input_type.timesteps
+        if ts is not None:
+            ts = _out_size(ts, self.kernel_size, self.stride, self.padding, 1, self.convolution_mode)
+        return RecurrentType(size=input_type.size, timesteps=ts)
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        pad = _lax_padding(self.convolution_mode, (self.padding,), (self.kernel_size,), (1,))
+        if isinstance(pad, list):
+            pad = [(0, 0), (0, 0)] + pad
+        window = (1, 1, self.kernel_size)
+        strides = (1, 1, self.stride)
+        return _pool(x, self.pooling_type, window, strides, pad, self.pnorm), state
+
+    def feed_forward_mask(self, mask, input_type):
+        if mask is None:
+            return None
+        return mask[:, :: self.stride]
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class Subsampling3DLayer(Layer):
+    """3-D pooling over NCDHW (reference: Subsampling3DLayer)."""
+
+    pooling_type: PoolingType = PoolingType.MAX
+    kernel_size: Tuple[int, int, int] = (2, 2, 2)
+    stride: Tuple[int, int, int] = (2, 2, 2)
+    padding: Tuple[int, int, int] = (0, 0, 0)
+    convolution_mode: ConvolutionMode = ConvolutionMode.TRUNCATE
+
+    def output_type(self, input_type: InputType) -> InputType:
+        d, h, w = (
+            _out_size(s, k, st, p, 1, self.convolution_mode)
+            for s, k, st, p in zip(
+                (input_type.depth, input_type.height, input_type.width),
+                self.kernel_size, self.stride, self.padding,
+            )
+        )
+        return Convolutional3DType(depth=d, height=h, width=w, channels=input_type.channels)
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        pad = _lax_padding(self.convolution_mode, self.padding, self.kernel_size, (1, 1, 1))
+        if isinstance(pad, list):
+            pad = [(0, 0), (0, 0)] + pad
+        window = (1, 1) + tuple(self.kernel_size)
+        strides = (1, 1) + tuple(self.stride)
+        return _pool(x, self.pooling_type, window, strides, pad), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class GlobalPoolingLayer(Layer):
+    """Global pooling over spatial/time dims with mask support (reference:
+    GlobalPoolingLayer). CNN input -> [batch, channels]; recurrent input
+    [batch, size, time] -> [batch, size] honoring the time mask."""
+
+    pooling_type: PoolingType = PoolingType.MAX
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if isinstance(input_type, RecurrentType):
+            return FeedForwardType(size=input_type.size)
+        if isinstance(input_type, ConvolutionalType):
+            return FeedForwardType(size=input_type.channels)
+        if isinstance(input_type, Convolutional3DType):
+            return FeedForwardType(size=input_type.channels)
+        return input_type
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        axes = tuple(range(2, x.ndim))
+        mask = ctx.mask
+        if mask is not None and x.ndim == 3:  # recurrent [b, c, t], mask [b, t]
+            m = mask[:, None, :].astype(x.dtype)
+            if self.pooling_type is PoolingType.MAX:
+                neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+                return jnp.max(jnp.where(m > 0, x, neg), axis=2), state
+            if self.pooling_type in (PoolingType.AVG, PoolingType.SUM):
+                s = jnp.sum(x * m, axis=2)
+                if self.pooling_type is PoolingType.SUM:
+                    return s, state
+                return s / jnp.maximum(jnp.sum(m, axis=2), 1.0), state
+            p = float(self.pnorm)
+            s = jnp.sum((jnp.abs(x) * m) ** p, axis=2)
+            return s ** (1.0 / p), state
+        if self.pooling_type is PoolingType.MAX:
+            return jnp.max(x, axis=axes), state
+        if self.pooling_type is PoolingType.AVG:
+            return jnp.mean(x, axis=axes), state
+        if self.pooling_type is PoolingType.SUM:
+            return jnp.sum(x, axis=axes), state
+        p = float(self.pnorm)
+        return jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p), state
+
+    def feed_forward_mask(self, mask, input_type):
+        return None  # time dimension is consumed
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class Upsampling2DLayer(Layer):
+    """Nearest-neighbor upsampling (reference: Upsampling2D)."""
+
+    size: Tuple[int, int] = (2, 2)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return ConvolutionalType(
+            height=input_type.height * self.size[0],
+            width=input_type.width * self.size[1],
+            channels=input_type.channels,
+        )
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        y = jnp.repeat(jnp.repeat(x, self.size[0], axis=2), self.size[1], axis=3)
+        return y, state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class Upsampling1DLayer(Layer):
+    size: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        ts = input_type.timesteps
+        return RecurrentType(size=input_type.size, timesteps=None if ts is None else ts * self.size)
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        return jnp.repeat(x, self.size, axis=2), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class Upsampling3DLayer(Layer):
+    size: Tuple[int, int, int] = (2, 2, 2)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return Convolutional3DType(
+            depth=input_type.depth * self.size[0],
+            height=input_type.height * self.size[1],
+            width=input_type.width * self.size[2],
+            channels=input_type.channels,
+        )
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        for ax, s in zip((2, 3, 4), self.size):
+            x = jnp.repeat(x, s, axis=ax)
+        return x, state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class ZeroPaddingLayer(Layer):
+    """Zero padding for NCHW (reference: ZeroPaddingLayer). padding =
+    (top, bottom, left, right)."""
+
+    padding: Tuple[int, int, int, int] = (1, 1, 1, 1)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t, b, l, r = self.padding
+        return ConvolutionalType(
+            height=input_type.height + t + b,
+            width=input_type.width + l + r,
+            channels=input_type.channels,
+        )
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        t, b, l, r = self.padding
+        return jnp.pad(x, ((0, 0), (0, 0), (t, b), (l, r))), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class ZeroPadding1DLayer(Layer):
+    padding: Tuple[int, int] = (1, 1)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        ts = input_type.timesteps
+        return RecurrentType(
+            size=input_type.size,
+            timesteps=None if ts is None else ts + self.padding[0] + self.padding[1],
+        )
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        return jnp.pad(x, ((0, 0), (0, 0), self.padding)), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class Cropping2DLayer(Layer):
+    """Crop NCHW spatially (reference: Cropping2D). crop = (top, bottom, left, right)."""
+
+    crop: Tuple[int, int, int, int] = (0, 0, 0, 0)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t, b, l, r = self.crop
+        return ConvolutionalType(
+            height=input_type.height - t - b,
+            width=input_type.width - l - r,
+            channels=input_type.channels,
+        )
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        t, b, l, r = self.crop
+        h, w = x.shape[2], x.shape[3]
+        return x[:, :, t : h - b, l : w - r], state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class SpaceToDepthLayer(Layer):
+    """NCHW space-to-depth (reference: SpaceToDepthLayer)."""
+
+    block_size: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        bs = self.block_size
+        return ConvolutionalType(
+            height=input_type.height // bs,
+            width=input_type.width // bs,
+            channels=input_type.channels * bs * bs,
+        )
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        n, c, h, w = x.shape
+        bs = self.block_size
+        y = x.reshape(n, c, h // bs, bs, w // bs, bs)
+        y = y.transpose(0, 3, 5, 1, 2, 4).reshape(n, c * bs * bs, h // bs, w // bs)
+        return y, state

@@ -1,0 +1,121 @@
+"""Input preprocessors — format conversions between layer families.
+
+Reference: org.deeplearning4j.nn.conf.preprocessor.{CnnToFeedForwardPreProcessor,
+FeedForwardToCnnPreProcessor, RnnToFeedForwardPreProcessor,
+FeedForwardToRnnPreProcessor, CnnToRnnPreProcessor, RnnToCnnPreProcessor}.
+The config builder auto-inserts these at format boundaries during the
+``setInputType`` walk, exactly like the reference. They are param-free layers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+
+from ...core.config import register_config
+from ..input_type import (
+    ConvolutionalType,
+    FeedForwardType,
+    InputType,
+    RecurrentType,
+)
+from .base import Layer, LayerContext, Params, State
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class CnnToFeedForwardPreProcessor(Layer):
+    """[b, c, h, w] -> [b, c*h*w] (reference flattening order)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return FeedForwardType(size=self.channels * self.height * self.width)
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        return x.reshape(x.shape[0], -1), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class FeedForwardToCnnPreProcessor(Layer):
+    """[b, c*h*w] -> [b, c, h, w]."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return ConvolutionalType(height=self.height, width=self.width, channels=self.channels)
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        return x.reshape(x.shape[0], self.channels, self.height, self.width), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class RnnToFeedForwardPreProcessor(Layer):
+    """[b, f, t] -> [b*t, f] (time folded into batch, reference order)."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return FeedForwardType(size=input_type.size)
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        b, f, t = x.shape
+        return x.transpose(0, 2, 1).reshape(b * t, f), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class FeedForwardToRnnPreProcessor(Layer):
+    """[b*t, f] -> [b, f, t]; timesteps restored from config."""
+
+    timesteps: int = 0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return RecurrentType(size=input_type.flat_size(), timesteps=self.timesteps or None)
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        bt, f = x.shape
+        t = self.timesteps
+        return x.reshape(bt // t, t, f).transpose(0, 2, 1), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class CnnToRnnPreProcessor(Layer):
+    """[b, c, h, w] -> [b, c*h*w, 1]-style sequence (reference: CnnToRnnPreProcessor
+    treats each example as one timestep of size c*h*w; used for video via
+    TimeDistributed in practice)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return RecurrentType(size=self.channels * self.height * self.width, timesteps=1)
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        return x.reshape(x.shape[0], -1, 1), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class RnnToCnnPreProcessor(Layer):
+    """[b, c*h*w, t] -> [b*t, c, h, w]."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return ConvolutionalType(height=self.height, width=self.width, channels=self.channels)
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        b, f, t = x.shape
+        flat = x.transpose(0, 2, 1).reshape(b * t, f)
+        return flat.reshape(b * t, self.channels, self.height, self.width), state

@@ -1,0 +1,411 @@
+"""Recurrent layers: LSTM, GravesLSTM, SimpleRnn, Bidirectional + wrappers.
+
+Reference: org.deeplearning4j.nn.conf.layers.{LSTM, GravesLSTM,
+GravesBidirectionalLSTM, SimpleRnn}, impl org.deeplearning4j.nn.layers.
+recurrent.LSTMHelpers (canonical: deeplearning4j-nn) with the cuDNN LSTM
+helper (CudnnLSTMHelper) as the accelerated path.
+
+TPU design (SURVEY.md §7 hard part #2): the whole sequence's input projection
+is ONE batched matmul [b*t, nIn]@[nIn, 4n] (MXU-sized), then a ``lax.scan``
+carries (h, c) through time with only the [b, n]@[n, 4n] recurrent matmul
+inside the loop. XLA unrolls/pipelines the scan; there is no per-timestep
+dispatch (the reference pays a JNI round-trip per gate op per step on the
+non-cuDNN path).
+
+Conventions preserved from the reference:
+* data format [batch, size, time] (NCW)
+* gate order in the fused weight columns: [i, f, o, g]
+  (input, forget, output, cell-input — reference LSTMParamInitializer)
+* weights: W [nIn, 4n], RW [n, 4n] (+3n peephole columns for GravesLSTM), b [4n]
+* ``forget_gate_bias_init`` default 1.0
+* masked timesteps: state carried through unchanged, output zeroed
+* stateful streaming via carried (h, c) — rnnTimeStep / TBPTT semantics
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.config import register_config
+from ..activations import Activation
+from ..input_type import FeedForwardType, InputType, RecurrentType
+from ..weights import WeightInit, init_weights
+from .base import Layer, LayerContext, Params, State, apply_input_dropout
+
+
+def _lstm_scan(
+    x_proj: jax.Array,  # [b, t, 4n] precomputed x@W + b
+    rw: jax.Array,      # [n, 4n]
+    h0: jax.Array,      # [b, n]
+    c0: jax.Array,      # [b, n]
+    mask: Optional[jax.Array],  # [b, t] or None
+    gate_act,
+    cell_act,
+    peephole: Optional[jax.Array] = None,  # [3, n] (pi, pf, po) or None
+):
+    n = h0.shape[-1]
+
+    def step(carry, inp):
+        h, c = carry
+        if mask is None:
+            xp = inp
+            m = None
+        else:
+            xp, m = inp
+        z = xp + h @ rw  # [b, 4n]
+        zi, zf, zo, zg = jnp.split(z, 4, axis=-1)
+        if peephole is not None:
+            zi = zi + peephole[0] * c
+            zf = zf + peephole[1] * c
+        i = gate_act(zi)
+        f = gate_act(zf)
+        g = cell_act(zg)
+        c_new = f * c + i * g
+        if peephole is not None:
+            zo = zo + peephole[2] * c_new
+        o = gate_act(zo)
+        h_new = o * cell_act(c_new)
+        if m is not None:
+            mm = m[:, None]
+            c_new = mm * c_new + (1.0 - mm) * c
+            h_out = mm * h_new
+            h_new = mm * h_new + (1.0 - mm) * h
+        else:
+            h_out = h_new
+        return (h_new, c_new), h_out
+
+    xs = x_proj.transpose(1, 0, 2)  # [t, b, 4n]
+    if mask is not None:
+        inputs = (xs, mask.T.astype(x_proj.dtype))
+    else:
+        inputs = xs
+    (h_f, c_f), hs = lax.scan(step, (h0, c0), inputs)
+    return hs.transpose(1, 2, 0), h_f, c_f  # [b, n, t]
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class LSTMLayer(Layer):
+    """Standard LSTM, no peepholes (reference: conf.layers.LSTM)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    forget_gate_bias_init: float = 1.0
+    gate_activation: Activation = Activation.SIGMOID
+
+    peephole: bool = dataclasses.field(default=False, repr=False)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        ts = input_type.timesteps if isinstance(input_type, RecurrentType) else None
+        return RecurrentType(size=self.n_out, timesteps=ts)
+
+    def with_input(self, input_type: InputType) -> "LSTMLayer":
+        if self.n_in or not isinstance(input_type, RecurrentType):
+            return self
+        return dataclasses.replace(self, n_in=input_type.size)
+
+    def has_params(self) -> bool:
+        return True
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return ("W", "RW", "b") + (("P",) if self.peephole else ())
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        k1, k2, k3 = jax.random.split(key, 3)
+        wi = self.weight_init or WeightInit.XAVIER
+        w = init_weights(k1, (self.n_in, 4 * self.n_out), wi,
+                         self.n_in, 4 * self.n_out, self.weight_init_distribution, dtype)
+        rw = init_weights(k2, (self.n_out, 4 * self.n_out), wi,
+                          self.n_out, 4 * self.n_out, self.weight_init_distribution, dtype)
+        b = jnp.zeros((4 * self.n_out,), dtype)
+        # forget-gate bias block = columns [n, 2n)
+        b = b.at[self.n_out : 2 * self.n_out].set(self.forget_gate_bias_init)
+        p: Params = {"W": w, "RW": rw, "b": b}
+        if self.peephole:
+            p["P"] = 0.01 * jax.random.normal(k3, (3, self.n_out), dtype)
+        return p
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        x = apply_input_dropout(self, x, ctx)
+        b, _, t = x.shape
+        xt = x.transpose(0, 2, 1)  # [b, t, nIn]
+        x_proj = xt.reshape(b * t, self.n_in) @ params["W"] + params["b"]
+        x_proj = x_proj.reshape(b, t, 4 * self.n_out)
+        h0 = state.get("h")
+        c0 = state.get("c")
+        if h0 is None:
+            h0 = jnp.zeros((b, self.n_out), x.dtype)
+            c0 = jnp.zeros((b, self.n_out), x.dtype)
+        cell_act = self.activation or Activation.TANH
+        hs, h_f, c_f = _lstm_scan(
+            x_proj, params["RW"], h0, c0, ctx.mask,
+            self.gate_activation, cell_act,
+            peephole=params.get("P"),
+        )
+        return hs, {"h": h_f, "c": c_f}
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class GravesLSTMLayer(LSTMLayer):
+    """LSTM with peephole connections per Graves (2013) — reference:
+    GravesLSTM, the char-RNN benchmark layer (BASELINE.json:9)."""
+
+    peephole: bool = dataclasses.field(default=True, repr=False)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class SimpleRnnLayer(Layer):
+    """Vanilla RNN: h_t = act(x_t W + h_{t-1} RW + b) (reference: SimpleRnn)."""
+
+    n_in: int = 0
+    n_out: int = 0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        ts = input_type.timesteps if isinstance(input_type, RecurrentType) else None
+        return RecurrentType(size=self.n_out, timesteps=ts)
+
+    def with_input(self, input_type: InputType) -> "SimpleRnnLayer":
+        if self.n_in or not isinstance(input_type, RecurrentType):
+            return self
+        return dataclasses.replace(self, n_in=input_type.size)
+
+    def has_params(self) -> bool:
+        return True
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return ("W", "RW", "b")
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        k1, k2 = jax.random.split(key)
+        wi = self.weight_init or WeightInit.XAVIER
+        return {
+            "W": init_weights(k1, (self.n_in, self.n_out), wi, self.n_in, self.n_out,
+                              self.weight_init_distribution, dtype),
+            "RW": init_weights(k2, (self.n_out, self.n_out), wi, self.n_out, self.n_out,
+                               self.weight_init_distribution, dtype),
+            "b": jnp.full((self.n_out,), self.bias_init, dtype),
+        }
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        x = apply_input_dropout(self, x, ctx)
+        b, _, t = x.shape
+        act = self.activation or Activation.TANH
+        xt = x.transpose(0, 2, 1)
+        x_proj = (xt.reshape(b * t, self.n_in) @ params["W"] + params["b"]).reshape(b, t, self.n_out)
+        h0 = state.get("h")
+        if h0 is None:
+            h0 = jnp.zeros((b, self.n_out), x.dtype)
+        mask = ctx.mask
+
+        def step(h, inp):
+            if mask is None:
+                xp = inp
+                m = None
+            else:
+                xp, m = inp
+            h_new = act(xp + h @ params["RW"])
+            if m is not None:
+                mm = m[:, None]
+                h_out = mm * h_new
+                h_new = mm * h_new + (1.0 - mm) * h
+            else:
+                h_out = h_new
+            return h_new, h_out
+
+        xs = x_proj.transpose(1, 0, 2)
+        inputs = (xs, mask.T.astype(x.dtype)) if mask is not None else xs
+        h_f, hs = lax.scan(step, h0, inputs)
+        return hs.transpose(1, 2, 0), {"h": h_f}
+
+
+class BidirectionalMode(enum.Enum):
+    CONCAT = "CONCAT"
+    ADD = "ADD"
+    MUL = "MUL"
+    AVERAGE = "AVERAGE"
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class BidirectionalLayer(Layer):
+    """Bidirectional wrapper around any recurrent layer (reference:
+    conf.layers.recurrent.Bidirectional). GravesBidirectionalLSTM ==
+    Bidirectional(GravesLSTM, CONCAT)."""
+
+    fwd: Optional[Layer] = None
+    mode: BidirectionalMode = BidirectionalMode.CONCAT
+
+    def output_type(self, input_type: InputType) -> InputType:
+        inner = self.fwd.output_type(input_type)
+        if self.mode is BidirectionalMode.CONCAT:
+            return RecurrentType(size=inner.size * 2, timesteps=inner.timesteps)
+        return inner
+
+    def with_input(self, input_type: InputType) -> "BidirectionalLayer":
+        return dataclasses.replace(self, fwd=self.fwd.with_input(input_type))
+
+    def has_params(self) -> bool:
+        return True
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return tuple(f"f_{n}" for n in self.fwd.trainable_param_names()) + tuple(
+            f"b_{n}" for n in self.fwd.trainable_param_names()
+        )
+
+    def weight_param_names(self) -> Tuple[str, ...]:
+        return tuple(f"f_{n}" for n in self.fwd.weight_param_names()) + tuple(
+            f"b_{n}" for n in self.fwd.weight_param_names()
+        )
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        kf, kb = jax.random.split(key)
+        pf = self.fwd.init(kf, dtype)
+        pb = self.fwd.init(kb, dtype)
+        out = {f"f_{k}": v for k, v in pf.items()}
+        out.update({f"b_{k}": v for k, v in pb.items()})
+        return out
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        pf = {k[2:]: v for k, v in params.items() if k.startswith("f_")}
+        pb = {k[2:]: v for k, v in params.items() if k.startswith("b_")}
+        yf, _ = self.fwd.apply(pf, {}, x, ctx)
+        # reverse time respecting mask (valid steps are left-aligned in DL4J)
+        x_rev = jnp.flip(x, axis=2)
+        ctx_rev = dataclasses.replace(
+            ctx, mask=None if ctx.mask is None else jnp.flip(ctx.mask, axis=1)
+        )
+        yb, _ = self.fwd.apply(pb, {}, x_rev, ctx_rev)
+        yb = jnp.flip(yb, axis=2)
+        if self.mode is BidirectionalMode.CONCAT:
+            y = jnp.concatenate([yf, yb], axis=1)
+        elif self.mode is BidirectionalMode.ADD:
+            y = yf + yb
+        elif self.mode is BidirectionalMode.MUL:
+            y = yf * yb
+        else:
+            y = 0.5 * (yf + yb)
+        return y, state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class LastTimeStepLayer(Layer):
+    """Extract the last (unmasked) timestep: [b, f, t] -> [b, f]
+    (reference: recurrent.LastTimeStep wrapper)."""
+
+    underlying: Optional[Layer] = None
+
+    def output_type(self, input_type: InputType) -> InputType:
+        it = self.underlying.output_type(input_type) if self.underlying else input_type
+        return FeedForwardType(size=it.size)
+
+    def with_input(self, input_type: InputType) -> "LastTimeStepLayer":
+        if self.underlying is None:
+            return self
+        return dataclasses.replace(self, underlying=self.underlying.with_input(input_type))
+
+    def has_params(self) -> bool:
+        return self.underlying is not None and self.underlying.has_params()
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return self.underlying.trainable_param_names() if self.underlying else ()
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        return self.underlying.init(key, dtype) if self.underlying else {}
+
+    def init_state(self, dtype: Any) -> State:
+        return self.underlying.init_state(dtype) if self.underlying else {}
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        if self.underlying is not None:
+            x, state = self.underlying.apply(params, state, x, ctx)
+        if ctx.mask is not None:
+            lengths = jnp.sum(ctx.mask.astype(jnp.int32), axis=1)
+            idx = jnp.maximum(lengths - 1, 0)
+            y = jnp.take_along_axis(x, idx[:, None, None], axis=2).squeeze(2)
+        else:
+            y = x[:, :, -1]
+        return y, state
+
+    def feed_forward_mask(self, mask, input_type):
+        return None
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class MaskZeroLayer(Layer):
+    """Sets input timesteps matching ``mask_value`` to zero and masks them
+    downstream (reference: recurrent.MaskZeroLayer)."""
+
+    underlying: Optional[Layer] = None
+    mask_value: float = 0.0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return self.underlying.output_type(input_type) if self.underlying else input_type
+
+    def with_input(self, input_type: InputType) -> "MaskZeroLayer":
+        if self.underlying is None:
+            return self
+        return dataclasses.replace(self, underlying=self.underlying.with_input(input_type))
+
+    def has_params(self) -> bool:
+        return self.underlying is not None and self.underlying.has_params()
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return self.underlying.trainable_param_names() if self.underlying else ()
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        return self.underlying.init(key, dtype) if self.underlying else {}
+
+    def init_state(self, dtype: Any) -> State:
+        return self.underlying.init_state(dtype) if self.underlying else {}
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        not_masked = jnp.any(x != self.mask_value, axis=1)  # [b, t]
+        mask = not_masked.astype(x.dtype)
+        x = x * mask[:, None, :]
+        ctx = dataclasses.replace(ctx, mask=mask)
+        if self.underlying is None:
+            return x, state
+        return self.underlying.apply(params, state, x, ctx)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class TimeDistributedLayer(Layer):
+    """Applies a feed-forward layer independently at every timestep
+    (reference: recurrent.TimeDistributed). [b, f, t] -> [b, f', t]."""
+
+    underlying: Optional[Layer] = None
+
+    def output_type(self, input_type: InputType) -> InputType:
+        inner = self.underlying.output_type(FeedForwardType(size=input_type.size))
+        return RecurrentType(size=inner.flat_size(), timesteps=input_type.timesteps)
+
+    def with_input(self, input_type: InputType) -> "TimeDistributedLayer":
+        return dataclasses.replace(
+            self, underlying=self.underlying.with_input(FeedForwardType(size=input_type.size))
+        )
+
+    def has_params(self) -> bool:
+        return self.underlying.has_params()
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return self.underlying.trainable_param_names()
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        return self.underlying.init(key, dtype)
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        b, f, t = x.shape
+        flat = x.transpose(0, 2, 1).reshape(b * t, f)
+        y, state = self.underlying.apply(params, state, flat, dataclasses.replace(ctx, mask=None))
+        return y.reshape(b, t, -1).transpose(0, 2, 1), state

@@ -1,0 +1,162 @@
+"""Loss functions.
+
+Parity with the reference's ``org.nd4j.linalg.lossfunctions.LossFunctions``
+(canonical: nd4j-api, ILossFunction impls). Semantics preserved:
+
+* per-example score arrays (for masking / weighted losses), mean-reduced score;
+* optional per-output ``weights`` vector;
+* optional ``mask`` — [batch] or [batch, time] for sequence outputs (callers
+  flatten time into batch before calling, as the reference's RnnOutputLayer
+  does);
+* softmax+MCXENT and sigmoid+XENT compute from pre-activations via log-softmax
+  / logits for numerical stability — mathematically identical to the
+  reference's activate-then-loss with its fused backward.
+
+Gradients come from jax autodiff; there is no ``computeGradient`` twin to keep
+in sync (a classic divergence bug source in the reference, where ILossFunction
+implements score and gradient separately).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .activations import Activation
+
+_EPS = 1e-7
+
+
+def _apply_mask_and_mean(per_example: jax.Array, mask: Optional[jax.Array]) -> jax.Array:
+    if mask is not None:
+        mask = mask.reshape(per_example.shape[0])
+        per_example = per_example * mask
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        return jnp.sum(per_example) / denom
+    return jnp.mean(per_example)
+
+
+class LossFunction(enum.Enum):
+    MSE = "MSE"
+    L1 = "L1"
+    L2 = "L2"
+    XENT = "XENT"
+    MCXENT = "MCXENT"
+    SPARSE_MCXENT = "SPARSE_MCXENT"
+    NEGATIVELOGLIKELIHOOD = "NEGATIVELOGLIKELIHOOD"
+    COSINE_PROXIMITY = "COSINE_PROXIMITY"
+    HINGE = "HINGE"
+    SQUARED_HINGE = "SQUARED_HINGE"
+    KL_DIVERGENCE = "KL_DIVERGENCE"
+    MEAN_ABSOLUTE_ERROR = "MEAN_ABSOLUTE_ERROR"
+    MEAN_ABSOLUTE_PERCENTAGE_ERROR = "MEAN_ABSOLUTE_PERCENTAGE_ERROR"
+    MEAN_SQUARED_LOGARITHMIC_ERROR = "MEAN_SQUARED_LOGARITHMIC_ERROR"
+    POISSON = "POISSON"
+    WASSERSTEIN = "WASSERSTEIN"
+
+    @classmethod
+    def from_any(cls, l) -> "LossFunction":
+        if isinstance(l, LossFunction):
+            return l
+        return cls[str(l).upper()]
+
+    def score_array(
+        self,
+        labels: jax.Array,
+        preoutput: jax.Array,
+        activation: Activation,
+        weights: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """Per-example scores, shape [batch]. ``preoutput`` is pre-activation."""
+        return _score_array(self, labels, preoutput, activation, weights)
+
+    def score(
+        self,
+        labels: jax.Array,
+        preoutput: jax.Array,
+        activation: Activation,
+        mask: Optional[jax.Array] = None,
+        weights: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        per = self.score_array(labels, preoutput, activation, weights)
+        return _apply_mask_and_mean(per, mask)
+
+
+def _weighted(err: jax.Array, weights: Optional[jax.Array]) -> jax.Array:
+    if weights is not None:
+        err = err * weights
+    return err
+
+
+def _score_array(
+    kind: LossFunction,
+    labels: jax.Array,
+    pre: jax.Array,
+    activation: Activation,
+    weights: Optional[jax.Array],
+) -> jax.Array:
+    act = Activation.from_any(activation)
+    sum_last = lambda a: jnp.sum(a, axis=tuple(range(1, a.ndim)))
+
+    if kind in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD):
+        if act is Activation.SOFTMAX:
+            logp = jax.nn.log_softmax(pre, axis=-1)
+        else:
+            logp = jnp.log(jnp.clip(act(pre), _EPS, 1.0))
+        return sum_last(_weighted(-labels * logp, weights))
+
+    if kind is LossFunction.SPARSE_MCXENT:
+        if act is Activation.SOFTMAX:
+            logp = jax.nn.log_softmax(pre, axis=-1)
+        else:
+            logp = jnp.log(jnp.clip(act(pre), _EPS, 1.0))
+        idx = labels.astype(jnp.int32)
+        if idx.ndim == logp.ndim:  # [batch, 1] -> [batch]
+            idx = idx.squeeze(-1)
+        picked = jnp.take_along_axis(logp, idx[..., None], axis=-1).squeeze(-1)
+        return -picked
+
+    if kind is LossFunction.XENT:
+        if act is Activation.SIGMOID:
+            # stable BCE-with-logits
+            per = jnp.maximum(pre, 0) - pre * labels + jnp.log1p(jnp.exp(-jnp.abs(pre)))
+        else:
+            p = jnp.clip(act(pre), _EPS, 1.0 - _EPS)
+            per = -(labels * jnp.log(p) + (1 - labels) * jnp.log1p(-p))
+        return sum_last(_weighted(per, weights))
+
+    out = act(pre)
+    if kind is LossFunction.MSE:
+        return sum_last(_weighted((out - labels) ** 2, weights)) / out.shape[-1]
+    if kind is LossFunction.L2:
+        return sum_last(_weighted((out - labels) ** 2, weights))
+    if kind is LossFunction.MEAN_ABSOLUTE_ERROR:
+        return sum_last(_weighted(jnp.abs(out - labels), weights)) / out.shape[-1]
+    if kind is LossFunction.L1:
+        return sum_last(_weighted(jnp.abs(out - labels), weights))
+    if kind is LossFunction.MEAN_ABSOLUTE_PERCENTAGE_ERROR:
+        pct = jnp.abs((labels - out) / jnp.clip(jnp.abs(labels), _EPS)) * 100.0
+        return sum_last(_weighted(pct, weights)) / out.shape[-1]
+    if kind is LossFunction.MEAN_SQUARED_LOGARITHMIC_ERROR:
+        per = (jnp.log1p(jnp.clip(out, -1 + _EPS)) - jnp.log1p(jnp.clip(labels, -1 + _EPS))) ** 2
+        return sum_last(_weighted(per, weights)) / out.shape[-1]
+    if kind is LossFunction.COSINE_PROXIMITY:
+        on = out / jnp.clip(jnp.linalg.norm(out, axis=-1, keepdims=True), _EPS)
+        ln = labels / jnp.clip(jnp.linalg.norm(labels, axis=-1, keepdims=True), _EPS)
+        return -sum_last(on * ln)
+    if kind is LossFunction.HINGE:
+        return sum_last(_weighted(jnp.maximum(0.0, 1.0 - labels * out), weights))
+    if kind is LossFunction.SQUARED_HINGE:
+        return sum_last(_weighted(jnp.maximum(0.0, 1.0 - labels * out) ** 2, weights))
+    if kind is LossFunction.KL_DIVERGENCE:
+        p = jnp.clip(labels, _EPS, 1.0)
+        q = jnp.clip(out, _EPS, 1.0)
+        return sum_last(_weighted(p * (jnp.log(p) - jnp.log(q)), weights))
+    if kind is LossFunction.POISSON:
+        return sum_last(_weighted(out - labels * jnp.log(jnp.clip(out, _EPS)), weights))
+    if kind is LossFunction.WASSERSTEIN:
+        return sum_last(_weighted(labels * out, weights))
+    raise ValueError(f"Unhandled loss {kind}")

@@ -1,0 +1,31 @@
+from .evaluation import ROC, Evaluation, EvaluationBinary, RegressionEvaluation
+from .schedules import (
+    CycleSchedule,
+    ExponentialSchedule,
+    FixedSchedule,
+    ISchedule,
+    InverseSchedule,
+    MapSchedule,
+    PolySchedule,
+    RampSchedule,
+    ScheduleType,
+    SigmoidSchedule,
+    StepSchedule,
+)
+from .solver import Solver
+from .updaters import (
+    AMSGrad,
+    AdaDelta,
+    AdaGrad,
+    AdaMax,
+    Adam,
+    AdamW,
+    IUpdater,
+    Nadam,
+    Nesterovs,
+    NoOp,
+    RmsProp,
+    Sgd,
+)
+
+__all__ = [n for n in dir() if not n.startswith("_")]

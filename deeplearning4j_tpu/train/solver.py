@@ -1,0 +1,209 @@
+"""Solver — the training-step machinery.
+
+Reference: org.deeplearning4j.optimize.{Solver, solvers.StochasticGradientDescent},
+MultiLayerUpdater/UpdaterBlock, gradient normalization (SURVEY.md §3.1).
+
+TPU design: one jitted, donated train step per (mask-signature) — forward +
+loss + backward + gradient normalization + per-layer updater + param update
+compile into a single XLA program. The reference's per-op JNI dispatch, its
+flat-buffer updater views, and its workspace management all collapse into this
+one compiled function. Params and optimizer state are donated so XLA updates
+buffers in place (steady-state allocation: zero — the workspace property).
+
+Per-layer updater overrides (reference: UpdaterBlock boundaries) are honored:
+each layer gets its own optax transformation chain; frozen layers get
+``set_to_zero``. Decoupled weight decay applies to weight params only,
+mirroring the reference's weightDecay semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..nn.conf import BackpropType, GradientNormalization
+from ..nn.layers.base import Layer
+from .updaters import IUpdater, NoOp, Sgd, updater_from_any
+
+
+def _normalize_gradients(
+    grads: Dict[str, Dict[str, jax.Array]],
+    mode: GradientNormalization,
+    threshold: float,
+) -> Dict[str, Dict[str, jax.Array]]:
+    """Reference: GradientNormalization applied before the updater."""
+    eps = 1e-8
+    if mode is GradientNormalization.NONE:
+        return grads
+    if mode is GradientNormalization.RENORMALIZE_L2_PER_LAYER:
+        out = {}
+        for lname, lg in grads.items():
+            norm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in lg.values()) + eps)
+            out[lname] = {k: g / norm for k, g in lg.items()}
+        return out
+    if mode is GradientNormalization.RENORMALIZE_L2_PER_PARAM_TYPE:
+        return jax.tree_util.tree_map(
+            lambda g: g / (jnp.linalg.norm(g.ravel()) + eps), grads
+        )
+    if mode is GradientNormalization.CLIP_ELEMENT_WISE_ABSOLUTE_VALUE:
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, -threshold, threshold), grads
+        )
+    if mode is GradientNormalization.CLIP_L2_PER_LAYER:
+        out = {}
+        for lname, lg in grads.items():
+            norm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in lg.values()) + eps)
+            scale = jnp.minimum(1.0, threshold / norm)
+            out[lname] = {k: g * scale for k, g in lg.items()}
+        return out
+    if mode is GradientNormalization.CLIP_L2_PER_PARAM_TYPE:
+        def clip(g):
+            norm = jnp.linalg.norm(g.ravel()) + eps
+            return g * jnp.minimum(1.0, threshold / norm)
+
+        return jax.tree_util.tree_map(clip, grads)
+    raise ValueError(f"Unhandled normalization {mode}")
+
+
+class LayerOptimizers:
+    """Per-layer optax chains (reference: UpdaterBlock boundaries)."""
+
+    def __init__(self, model) -> None:
+        conf = model.conf
+        self.txs: Dict[str, optax.GradientTransformation] = {}
+        global_updater = updater_from_any(conf.updater) if conf.updater is not None else Sgd()
+        for i, layer in enumerate(model.layers):
+            name = conf.layer_name(i)
+            if not layer.has_params():
+                continue
+            if layer.frozen:
+                self.txs[name] = optax.set_to_zero()
+                continue
+            updater = updater_from_any(layer.updater) if layer.updater is not None else global_updater
+            parts = []
+            wd = layer.weight_decay
+            if wd:
+                weight_names = set(layer.weight_param_names())
+                parts.append(
+                    optax.masked(
+                        optax.add_decayed_weights(wd),
+                        {k: (k in weight_names) for k in layer.trainable_param_names()},
+                    )
+                )
+            parts.append(updater.to_optax())
+            self.txs[name] = optax.chain(*parts) if len(parts) > 1 else parts[0]
+
+    def init(self, params) -> Dict[str, Any]:
+        return {name: tx.init(params[name]) for name, tx in self.txs.items()}
+
+    def update(self, grads, opt_state, params):
+        new_params = {}
+        new_opt = {}
+        for name, p in params.items():
+            if name in self.txs:
+                updates, new_opt[name] = self.txs[name].update(grads[name], opt_state[name], p)
+                new_params[name] = optax.apply_updates(p, updates)
+            else:
+                new_params[name] = p
+        return new_params, new_opt
+
+
+class Solver:
+    def __init__(self, model) -> None:
+        self.model = model
+        self.optim = LayerOptimizers(model)
+        self.opt_state = self.optim.init(model.params)
+        self._step_cache: Dict[Any, Any] = {}
+
+    def _make_step(self, has_mask: bool, has_label_mask: bool, stateful: bool):
+        model = self.model
+        conf = model.conf
+
+        def step(params, opt_state, state, rnn_state, x, y, rng, mask, label_mask):
+            def loss_fn(p):
+                return model.loss_pure(
+                    p, state, x, y, rng=rng, mask=mask, label_mask=label_mask,
+                    rnn_state=rnn_state if stateful else None, train=True,
+                )
+
+            (score, (new_state, new_rnn)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            grads = _normalize_gradients(
+                grads, conf.gradient_normalization, conf.gradient_normalization_threshold
+            )
+            new_params, new_opt = self.optim.update(grads, opt_state, params)
+            return new_params, new_opt, new_state, new_rnn, score
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _step_fn(self, has_mask, has_label_mask, stateful):
+        key = (has_mask, has_label_mask, stateful)
+        if key not in self._step_cache:
+            self._step_cache[key] = self._make_step(*key)
+        return self._step_cache[key]
+
+    def fit_batch(self, x, y, mask=None, label_mask=None, rnn_state=None) -> Tuple[float, Optional[dict]]:
+        model = self.model
+        x = jnp.asarray(x, model.dtype)
+        y = jnp.asarray(y)
+        mask_a = None if mask is None else jnp.asarray(mask, model.dtype)
+        lmask_a = None if label_mask is None else jnp.asarray(label_mask, model.dtype)
+        stateful = rnn_state is not None
+        fn = self._step_fn(mask_a is not None, lmask_a is not None, stateful)
+        rng = model._rng.next_key()
+        params, opt_state, state, new_rnn, score = fn(
+            model.params, self.opt_state, model.state,
+            rnn_state if stateful else {}, x, y, rng, mask_a, lmask_a,
+        )
+        model.params = params
+        model.state = state
+        self.opt_state = opt_state
+        model.last_batch_size = int(x.shape[0])
+        return score, new_rnn
+
+    def fit(self, data, labels=None, *, epochs: int = 1, mask=None, label_mask=None) -> None:
+        model = self.model
+        from ..nn.sequential import _as_batches
+
+        for _ in range(epochs):
+            model.listeners.epoch_start(model)
+            for feats, labs, msk, lmsk in _as_batches(data, labels, mask):
+                if label_mask is not None:
+                    lmsk = label_mask
+                if (
+                    model.conf.backprop_type is BackpropType.TRUNCATED_BPTT
+                    and getattr(feats, "ndim", 0) == 3
+                    and feats.shape[2] > model.conf.tbptt_fwd_length
+                ):
+                    score = self._fit_tbptt(feats, labs, msk, lmsk)
+                else:
+                    score, _ = self.fit_batch(feats, labs, msk, lmsk)
+                model.score_value = float(score)
+                model.iteration_count += 1
+                model.listeners.iteration_done(
+                    model, model.iteration_count, model.epoch_count, model.score_value
+                )
+            model.listeners.epoch_end(model)
+            model.epoch_count += 1
+
+    def _fit_tbptt(self, feats, labs, msk, lmsk) -> float:
+        """Truncated BPTT windowed loop (reference: doTruncatedBPTT): slide a
+        window of tbptt_fwd_length steps, carry RNN state (h/c) across windows
+        within the batch, reset between batches."""
+        model = self.model
+        t_total = feats.shape[2]
+        length = model.conf.tbptt_fwd_length
+        rnn_state: dict = {}
+        last_score = 0.0
+        for start in range(0, t_total, length):
+            end = min(start + length, t_total)
+            fw = feats[:, :, start:end]
+            lw = labs[:, :, start:end] if getattr(labs, "ndim", 0) == 3 else labs
+            mw = None if msk is None else msk[:, start:end]
+            lmw = None if lmsk is None else lmsk[:, start:end]
+            score, new_rnn = self.fit_batch(fw, lw, mw, lmw, rnn_state=rnn_state)
+            rnn_state = jax.lax.stop_gradient(new_rnn) if new_rnn else {}
+            last_score = score
+        return last_score

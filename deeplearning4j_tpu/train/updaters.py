@@ -1,0 +1,189 @@
+"""Updaters — optimizer configs with the reference's vocabulary, optax math.
+
+Reference: org.nd4j.linalg.learning.config.{Sgd, Adam, AdamW, AMSGrad, Nadam,
+Nesterovs, RmsProp, AdaGrad, AdaDelta, AdaMax, NoOp} + the DL4J-side
+MultiLayerUpdater/UpdaterBlock machinery (SURVEY.md §2.2).
+
+TPU design: each updater config builds an ``optax.GradientTransformation``;
+per-layer updater overrides (reference: UpdaterBlock boundaries) compose via
+``optax.multi_transform`` over the params pytree. The whole update runs inside
+the jitted train step — there is no separate updater dispatch per block as in
+the reference (XLA fuses the lot).
+
+Learning rates accept either a float or an ISchedule (train/schedules.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Union
+
+import optax
+
+from ..core.config import register_config
+from .schedules import ISchedule
+
+LR = Union[float, ISchedule]
+
+
+def _lr_fn(lr: LR):
+    if isinstance(lr, ISchedule):
+        # optax schedules get a step count; epochs enter via ScheduleType at
+        # the trainer level (iteration-based inside jit).
+        return lambda count: lr.value_at(count, 0)
+    return float(lr)
+
+
+@dataclasses.dataclass(frozen=True)
+class IUpdater:
+    """Base updater config."""
+
+    def to_optax(self) -> optax.GradientTransformation:
+        raise NotImplementedError
+
+    @property
+    def has_state(self) -> bool:
+        return True
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class Sgd(IUpdater):
+    learning_rate: LR = 1e-1
+
+    def to_optax(self) -> optax.GradientTransformation:
+        return optax.sgd(_lr_fn(self.learning_rate))
+
+    @property
+    def has_state(self) -> bool:
+        return False
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class Adam(IUpdater):
+    learning_rate: LR = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self) -> optax.GradientTransformation:
+        return optax.adam(_lr_fn(self.learning_rate), b1=self.beta1, b2=self.beta2,
+                          eps=self.epsilon)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class AdamW(IUpdater):
+    """Decoupled weight decay Adam (reference: AdamW / the weightDecay option)."""
+
+    learning_rate: LR = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+    weight_decay: float = 1e-2
+
+    def to_optax(self) -> optax.GradientTransformation:
+        return optax.adamw(_lr_fn(self.learning_rate), b1=self.beta1, b2=self.beta2,
+                           eps=self.epsilon, weight_decay=self.weight_decay)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class AMSGrad(IUpdater):
+    learning_rate: LR = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self) -> optax.GradientTransformation:
+        return optax.amsgrad(_lr_fn(self.learning_rate), b1=self.beta1, b2=self.beta2,
+                             eps=self.epsilon)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class Nadam(IUpdater):
+    learning_rate: LR = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self) -> optax.GradientTransformation:
+        return optax.nadam(_lr_fn(self.learning_rate), b1=self.beta1, b2=self.beta2,
+                           eps=self.epsilon)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class Nesterovs(IUpdater):
+    learning_rate: LR = 1e-1
+    momentum: float = 0.9
+
+    def to_optax(self) -> optax.GradientTransformation:
+        return optax.sgd(_lr_fn(self.learning_rate), momentum=self.momentum, nesterov=True)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class RmsProp(IUpdater):
+    learning_rate: LR = 1e-1
+    decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def to_optax(self) -> optax.GradientTransformation:
+        return optax.rmsprop(_lr_fn(self.learning_rate), decay=self.decay, eps=self.epsilon)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class AdaGrad(IUpdater):
+    learning_rate: LR = 1e-1
+    epsilon: float = 1e-6
+
+    def to_optax(self) -> optax.GradientTransformation:
+        return optax.adagrad(_lr_fn(self.learning_rate), eps=self.epsilon)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class AdaDelta(IUpdater):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def to_optax(self) -> optax.GradientTransformation:
+        return optax.adadelta(rho=self.rho, eps=self.epsilon)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class AdaMax(IUpdater):
+    learning_rate: LR = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self) -> optax.GradientTransformation:
+        return optax.adamax(_lr_fn(self.learning_rate), b1=self.beta1, b2=self.beta2,
+                            eps=self.epsilon)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class NoOp(IUpdater):
+    """Applies raw gradients scaled by nothing (frozen params use this)."""
+
+    def to_optax(self) -> optax.GradientTransformation:
+        return optax.set_to_zero()
+
+    @property
+    def has_state(self) -> bool:
+        return False
+
+
+def updater_from_any(u: Any) -> IUpdater:
+    if isinstance(u, IUpdater):
+        return u
+    if u is None:
+        return Sgd()
+    raise TypeError(f"Not an updater: {u!r}")

@@ -1,0 +1,3 @@
+from .gradcheck import check_gradients
+
+__all__ = ["check_gradients"]

@@ -20,6 +20,10 @@ import jax  # noqa: E402
 # The session's sitecustomize imports jax (axon PJRT registration) before
 # conftest runs, so JAX_PLATFORMS was already latched — update config directly.
 jax.config.update("jax_platforms", "cpu")
+# float64 enabled globally: gradient checks require double precision
+# (reference: DataType.DOUBLE for GradCheckUtil); float32 paths pass explicit
+# dtypes everywhere so this does not change their behavior.
+jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402
 

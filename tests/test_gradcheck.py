@@ -1,0 +1,254 @@
+"""Numerical gradient checks for the layer zoo.
+
+Mirrors the reference's gradientcheck test family (GradientCheckTests,
+CNNGradientCheckTest, LSTMGradientCheckTests, ...): small double-precision
+networks, central-difference vs analytic gradients (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (
+    Activation,
+    InputType,
+    LossFunction,
+    MultiLayerNetwork,
+    NeuralNetConfiguration,
+    WeightInit,
+)
+from deeplearning4j_tpu.nn.layers import (
+    BatchNormalizationLayer,
+    BidirectionalLayer,
+    Convolution1DLayer,
+    ConvolutionLayer,
+    DenseLayer,
+    EmbeddingLayer,
+    GlobalPoolingLayer,
+    GravesLSTMLayer,
+    LSTMLayer,
+    LastTimeStepLayer,
+    LayerNormLayer,
+    OutputLayer,
+    PoolingType,
+    RnnOutputLayer,
+    SelfAttentionLayer,
+    SimpleRnnLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.utils import check_gradients
+
+SEED = 42
+
+
+def build(layers, input_type, l1=None, l2=None):
+    b = NeuralNetConfiguration.builder().seed(SEED).data_type("float64")
+    if l1 is not None:
+        b = b.l1(l1)
+    if l2 is not None:
+        b = b.l2(l2)
+    lb = b.list()
+    for l in layers:
+        lb = lb.layer(l)
+    conf = lb.set_input_type(input_type).build()
+    return MultiLayerNetwork(conf).init()
+
+
+def rand(shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape)
+
+
+def onehot(cls, k):
+    return np.eye(k)[cls]
+
+
+class TestDenseGradients:
+    def test_mlp_mcxent(self):
+        model = build(
+            [DenseLayer(n_out=6, activation=Activation.TANH),
+             OutputLayer(n_out=3, loss=LossFunction.MCXENT)],
+            InputType.feed_forward(4),
+        )
+        x = rand((5, 4))
+        y = onehot(np.arange(5) % 3, 3)
+        assert check_gradients(model, x, y, print_results=True)
+
+    def test_mlp_mse_identity(self):
+        model = build(
+            [DenseLayer(n_out=6, activation=Activation.SIGMOID),
+             OutputLayer(n_out=2, loss=LossFunction.MSE, activation=Activation.IDENTITY)],
+            InputType.feed_forward(4),
+        )
+        x = rand((5, 4))
+        y = rand((5, 2), seed=1)
+        assert check_gradients(model, x, y)
+
+    @pytest.mark.parametrize("act", [Activation.RELU, Activation.ELU, Activation.SOFTPLUS,
+                                     Activation.GELU, Activation.SWISH, Activation.MISH])
+    def test_activations(self, act):
+        model = build(
+            [DenseLayer(n_out=5, activation=act),
+             OutputLayer(n_out=2, loss=LossFunction.MCXENT)],
+            InputType.feed_forward(3),
+        )
+        x = rand((4, 3), seed=2) + 0.1  # avoid relu kinks at 0
+        y = onehot(np.arange(4) % 2, 2)
+        assert check_gradients(model, x, y)
+
+    def test_l1_l2_regularization(self):
+        model = build(
+            [DenseLayer(n_out=5, activation=Activation.TANH),
+             OutputLayer(n_out=2, loss=LossFunction.MCXENT)],
+            InputType.feed_forward(3), l1=1e-2, l2=1e-2,
+        )
+        x = rand((4, 3), seed=3)
+        y = onehot(np.arange(4) % 2, 2)
+        assert check_gradients(model, x, y)
+
+    def test_embedding(self):
+        model = build(
+            [EmbeddingLayer(n_in=7, n_out=5, activation=Activation.TANH),
+             OutputLayer(n_out=3, loss=LossFunction.MCXENT)],
+            InputType.feed_forward(1),
+        )
+        x = (np.arange(6) % 7).reshape(6, 1).astype(np.float64)
+        y = onehot(np.arange(6) % 3, 3)
+        assert check_gradients(model, x, y)
+
+    def test_layernorm(self):
+        model = build(
+            [DenseLayer(n_out=6, activation=Activation.IDENTITY),
+             LayerNormLayer(),
+             OutputLayer(n_out=2, loss=LossFunction.MCXENT)],
+            InputType.feed_forward(4),
+        )
+        x = rand((5, 4), seed=4)
+        y = onehot(np.arange(5) % 2, 2)
+        assert check_gradients(model, x, y)
+
+
+class TestCnnGradients:
+    def test_conv_pool_dense(self):
+        model = build(
+            [ConvolutionLayer(n_out=3, kernel_size=(2, 2), activation=Activation.TANH),
+             SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)),
+             OutputLayer(n_out=2, loss=LossFunction.MCXENT)],
+            InputType.convolutional(6, 6, 2),
+        )
+        x = rand((3, 2, 6, 6), seed=5)
+        y = onehot(np.arange(3) % 2, 2)
+        assert check_gradients(model, x, y, subset=150)
+
+    def test_avg_pool(self):
+        model = build(
+            [ConvolutionLayer(n_out=2, kernel_size=(3, 3), activation=Activation.SIGMOID),
+             SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2), pooling_type=PoolingType.AVG),
+             OutputLayer(n_out=2, loss=LossFunction.MSE, activation=Activation.IDENTITY)],
+            InputType.convolutional(6, 6, 1),
+        )
+        x = rand((3, 1, 6, 6), seed=6)
+        y = rand((3, 2), seed=7)
+        assert check_gradients(model, x, y, subset=120)
+
+    def test_batchnorm(self):
+        model = build(
+            [ConvolutionLayer(n_out=2, kernel_size=(2, 2), activation=Activation.IDENTITY),
+             BatchNormalizationLayer(),
+             GlobalPoolingLayer(pooling_type=PoolingType.AVG),
+             OutputLayer(n_out=2, loss=LossFunction.MCXENT)],
+            InputType.convolutional(5, 5, 1),
+        )
+        x = rand((4, 1, 5, 5), seed=8)
+        y = onehot(np.arange(4) % 2, 2)
+        assert check_gradients(model, x, y, subset=120)
+
+    def test_conv1d(self):
+        model = build(
+            [Convolution1DLayer(n_out=3, kernel_size=2, activation=Activation.TANH),
+             RnnOutputLayer(n_out=2, loss=LossFunction.MCXENT)],
+            InputType.recurrent(2, 7),
+        )
+        x = rand((3, 2, 7), seed=9)
+        cls = (rand((3, 6), seed=10) > 0).astype(int)
+        y = np.eye(2)[cls].transpose(0, 2, 1)
+        assert check_gradients(model, x, y)
+
+
+class TestRnnGradients:
+    def test_lstm(self):
+        model = build(
+            [LSTMLayer(n_out=4, activation=Activation.TANH),
+             RnnOutputLayer(n_out=2, loss=LossFunction.MCXENT)],
+            InputType.recurrent(3),
+        )
+        x = rand((3, 3, 5), seed=11)
+        cls = (rand((3, 5), seed=12) > 0).astype(int)
+        y = np.eye(2)[cls].transpose(0, 2, 1)
+        assert check_gradients(model, x, y)
+
+    def test_graves_lstm_peepholes(self):
+        model = build(
+            [GravesLSTMLayer(n_out=3, activation=Activation.TANH),
+             RnnOutputLayer(n_out=2, loss=LossFunction.MCXENT)],
+            InputType.recurrent(2),
+        )
+        x = rand((2, 2, 4), seed=13)
+        cls = (rand((2, 4), seed=14) > 0).astype(int)
+        y = np.eye(2)[cls].transpose(0, 2, 1)
+        assert check_gradients(model, x, y)
+
+    def test_lstm_with_mask(self):
+        model = build(
+            [LSTMLayer(n_out=3),
+             RnnOutputLayer(n_out=2, loss=LossFunction.MCXENT)],
+            InputType.recurrent(2),
+        )
+        x = rand((3, 2, 6), seed=15)
+        cls = (rand((3, 6), seed=16) > 0).astype(int)
+        y = np.eye(2)[cls].transpose(0, 2, 1)
+        mask = np.ones((3, 6))
+        mask[0, 4:] = 0
+        mask[2, 2:] = 0
+        assert check_gradients(model, x, y, mask=mask, label_mask=mask)
+
+    def test_simple_rnn(self):
+        model = build(
+            [SimpleRnnLayer(n_out=4),
+             RnnOutputLayer(n_out=2, loss=LossFunction.MCXENT)],
+            InputType.recurrent(3),
+        )
+        x = rand((3, 3, 5), seed=17)
+        cls = (rand((3, 5), seed=18) > 0).astype(int)
+        y = np.eye(2)[cls].transpose(0, 2, 1)
+        assert check_gradients(model, x, y)
+
+    def test_bidirectional_lstm(self):
+        model = build(
+            [BidirectionalLayer(fwd=LSTMLayer(n_out=3)),
+             RnnOutputLayer(n_out=2, loss=LossFunction.MCXENT)],
+            InputType.recurrent(2),
+        )
+        x = rand((2, 2, 5), seed=19)
+        cls = (rand((2, 5), seed=20) > 0).astype(int)
+        y = np.eye(2)[cls].transpose(0, 2, 1)
+        assert check_gradients(model, x, y)
+
+    def test_last_time_step(self):
+        model = build(
+            [LastTimeStepLayer(underlying=LSTMLayer(n_out=4)),
+             OutputLayer(n_out=2, loss=LossFunction.MCXENT)],
+            InputType.recurrent(3),
+        )
+        x = rand((3, 3, 6), seed=21)
+        y = onehot(np.arange(3) % 2, 2)
+        assert check_gradients(model, x, y)
+
+    def test_self_attention(self):
+        model = build(
+            [SelfAttentionLayer(n_out=4, n_heads=2, activation=Activation.IDENTITY),
+             GlobalPoolingLayer(pooling_type=PoolingType.AVG),
+             OutputLayer(n_out=2, loss=LossFunction.MCXENT)],
+            InputType.recurrent(4),
+        )
+        x = rand((3, 4, 5), seed=22)
+        y = onehot(np.arange(3) % 2, 2)
+        assert check_gradients(model, x, y)

@@ -1,0 +1,258 @@
+"""MultiLayerNetwork end-to-end tests: build, fit, output, serde of config."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.core import from_json, to_json
+from deeplearning4j_tpu.nn import (
+    Activation,
+    InputType,
+    LossFunction,
+    MultiLayerNetwork,
+    NeuralNetConfiguration,
+    WeightInit,
+)
+from deeplearning4j_tpu.nn.layers import (
+    BatchNormalizationLayer,
+    ConvolutionLayer,
+    DenseLayer,
+    GravesLSTMLayer,
+    LSTMLayer,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.train import Adam, Sgd
+
+
+def small_mlp_conf(seed=12345):
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(Adam(1e-2))
+        .weight_init(WeightInit.XAVIER)
+        .list()
+        .layer(DenseLayer(n_out=16, activation=Activation.RELU))
+        .layer(DenseLayer(n_out=8, activation=Activation.TANH))
+        .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT))
+        .set_input_type(InputType.feed_forward(10))
+        .build()
+    )
+
+
+def make_xor_like(n=64, d=10, k=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    cls = (np.abs(x[:, 0] * 3).astype(np.int64) + (x[:, 1] > 0)) % k
+    y = np.eye(k, dtype=np.float32)[cls]
+    return x, y
+
+
+class TestBuild:
+    def test_n_in_inference(self):
+        conf = small_mlp_conf()
+        assert conf.layers[0].n_in == 10
+        assert conf.layers[1].n_in == 16
+        assert conf.layers[2].n_in == 8
+
+    def test_global_defaults_applied(self):
+        conf = small_mlp_conf()
+        assert conf.layers[0].weight_init is WeightInit.XAVIER
+        assert conf.layers[0].updater == Adam(1e-2)
+
+    def test_config_json_round_trip(self):
+        conf = small_mlp_conf()
+        back = from_json(to_json(conf))
+        assert back == conf
+
+    def test_cnn_preprocessor_insertion(self):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3)))
+            .layer(SubsamplingLayer())
+            .layer(DenseLayer(n_out=10))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.convolutional_flat(12, 12, 1))
+            .build()
+        )
+        names = [type(l).__name__ for l in conf.layers]
+        assert names[0] == "FeedForwardToCnnPreProcessor"
+        assert "CnnToFeedForwardPreProcessor" in names
+        # conv 12x12 -(3x3)-> 10x10 -(2x2 pool)-> 5x5 * 4ch = 100
+        assert conf.layers[names.index("DenseLayer")].n_in == 100
+
+    def test_init_params_shapes(self):
+        model = MultiLayerNetwork(small_mlp_conf()).init()
+        assert model.params["layer_0"]["W"].shape == (10, 16)
+        assert model.params["layer_2"]["b"].shape == (3,)
+        assert model.num_params() == 10 * 16 + 16 + 16 * 8 + 8 + 8 * 3 + 3
+
+    def test_init_deterministic(self):
+        m1 = MultiLayerNetwork(small_mlp_conf()).init()
+        m2 = MultiLayerNetwork(small_mlp_conf()).init()
+        np.testing.assert_array_equal(
+            np.asarray(m1.params["layer_0"]["W"]), np.asarray(m2.params["layer_0"]["W"])
+        )
+
+    def test_summary(self):
+        model = MultiLayerNetwork(small_mlp_conf()).init()
+        s = model.summary()
+        assert "DenseLayer" in s and "Total params" in s
+
+
+class TestFit:
+    def test_mlp_learns(self):
+        x, y = make_xor_like()
+        model = MultiLayerNetwork(small_mlp_conf()).init()
+        s0 = model.score(x, y)
+        model.fit(x, y, epochs=60)
+        s1 = model.score(x, y)
+        assert s1 < s0 * 0.7, f"loss did not decrease: {s0} -> {s1}"
+
+    def test_output_shape_and_softmax(self):
+        x, y = make_xor_like()
+        model = MultiLayerNetwork(small_mlp_conf()).init()
+        out = np.asarray(model.output(x))
+        assert out.shape == (64, 3)
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+
+    def test_evaluate(self):
+        x, y = make_xor_like()
+        model = MultiLayerNetwork(small_mlp_conf()).init()
+        model.fit(x, y, epochs=30)
+        ev = model.evaluate(x, y)
+        assert ev.accuracy() > 0.5
+
+    def test_listeners_called(self):
+        from deeplearning4j_tpu.core import CollectScoresListener
+
+        x, y = make_xor_like()
+        model = MultiLayerNetwork(small_mlp_conf()).init()
+        listener = CollectScoresListener()
+        model.add_listeners(listener)
+        model.fit(x, y, epochs=3)
+        assert len(listener.scores) == 3
+        assert all(np.isfinite(s) for s in listener.scores)
+
+
+class TestCnn:
+    def test_lenet_style_fit(self):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(7)
+            .updater(Adam(1e-2))
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3), activation=Activation.RELU))
+            .layer(SubsamplingLayer())
+            .layer(BatchNormalizationLayer())
+            .layer(DenseLayer(n_out=16, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.convolutional_flat(10, 10, 1))
+            .build()
+        )
+        model = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 100)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x[:, :50].sum(axis=1) > 0).astype(np.int64)]
+        s0 = model.score(x, y)
+        model.fit(x, y, epochs=30)
+        assert model.score(x, y) < s0
+
+    def test_bn_running_stats_update(self):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .updater(Sgd(0.1))
+            .list()
+            .layer(BatchNormalizationLayer())
+            .layer(OutputLayer(n_out=2, loss=LossFunction.MSE, activation=Activation.IDENTITY))
+            .set_input_type(InputType.feed_forward(4))
+            .build()
+        )
+        model = MultiLayerNetwork(conf).init()
+        before = np.asarray(model.state["layer_0"]["mean"]).copy()
+        x = np.random.default_rng(1).normal(5.0, size=(16, 4)).astype(np.float32)
+        y = np.zeros((16, 2), dtype=np.float32)
+        model.fit(x, y, epochs=2)
+        after = np.asarray(model.state["layer_0"]["mean"])
+        assert not np.allclose(before, after)
+        assert after.mean() > 0.5  # moved toward the batch mean of ~5
+
+
+class TestRnn:
+    def test_lstm_shapes_and_fit(self):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(3)
+            .updater(Adam(1e-2))
+            .list()
+            .layer(LSTMLayer(n_out=8, activation=Activation.TANH))
+            .layer(RnnOutputLayer(n_out=2, loss=LossFunction.MCXENT))
+            .set_input_type(InputType.recurrent(5))
+            .build()
+        )
+        model = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 5, 12)).astype(np.float32)
+        labels_cls = (x.sum(axis=1) > 0).astype(np.int64)  # [8, 12]
+        y = np.eye(2, dtype=np.float32)[labels_cls].transpose(0, 2, 1)  # [8, 2, 12]
+        out = np.asarray(model.output(x))
+        assert out.shape == (8, 2, 12)
+        s0 = model.score(x, y)
+        model.fit(x, y, epochs=25)
+        assert model.score(x, y) < s0
+
+    def test_graves_lstm_has_peepholes(self):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .list()
+            .layer(GravesLSTMLayer(n_out=4))
+            .layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(3))
+            .build()
+        )
+        model = MultiLayerNetwork(conf).init()
+        assert "P" in model.params["layer_0"]
+        assert model.params["layer_0"]["P"].shape == (3, 4)
+
+    def test_rnn_time_step_stateful(self):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(11)
+            .list()
+            .layer(LSTMLayer(n_out=6))
+            .layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(4))
+            .build()
+        )
+        model = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(2).normal(size=(2, 4, 10)).astype(np.float32)
+        full = np.asarray(model.output(x))
+        # streaming: two chunks of 5 steps must reproduce the full output
+        model.rnn_clear_previous_state()
+        o1 = np.asarray(model.rnn_time_step(x[:, :, :5]))
+        o2 = np.asarray(model.rnn_time_step(x[:, :, 5:]))
+        streamed = np.concatenate([o1, o2], axis=2)
+        np.testing.assert_allclose(full, streamed, rtol=1e-4, atol=1e-5)
+
+    def test_masking_changes_loss(self):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(5)
+            .list()
+            .layer(LSTMLayer(n_out=4))
+            .layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(3))
+            .build()
+        )
+        model = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(3).normal(size=(4, 3, 6)).astype(np.float32)
+        y = np.zeros((4, 2, 6), dtype=np.float32)
+        y[:, 0, :] = 1.0
+        mask = np.ones((4, 6), dtype=np.float32)
+        mask[:, 3:] = 0.0
+        s_full = model.score(x, y)
+        s_masked = model.score(x, y, mask=mask, label_mask=mask)
+        assert not np.isclose(s_full, s_masked)
