@@ -53,7 +53,12 @@ def _encode(obj: Any) -> Any:
     if obj is None or isinstance(obj, (bool, int, float, str)):
         return obj
     if isinstance(obj, enum.Enum):
-        return {_TYPE_KEY: "@enum", "enum": type(obj).__name__, "value": obj.name}
+        cls = type(obj)
+        return {
+            _TYPE_KEY: "@enum",
+            "enum": f"{cls.__module__}:{cls.__qualname__}",
+            "value": obj.name,
+        }
     if isinstance(obj, (list, tuple)):
         enc = [_encode(v) for v in obj]
         if isinstance(obj, tuple):
@@ -85,8 +90,15 @@ def _encode(obj: Any) -> Any:
 
 
 def _enum_class(name: str) -> type:
-    # Enums used inside configs register lazily on first encode via their module;
-    # search registered config modules' enums by walking known enum subclasses.
+    if ":" in name:
+        module, qualname = name.split(":", 1)
+        import importlib
+
+        obj: Any = importlib.import_module(module)
+        for part in qualname.split("."):
+            obj = getattr(obj, part)
+        return obj
+    # legacy format: bare class name — search known enum subclasses
     for sub in _all_enum_subclasses(enum.Enum):
         if sub.__name__ == name:
             return sub
