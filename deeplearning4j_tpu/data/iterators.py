@@ -1,0 +1,205 @@
+"""Dataset iterators with async prefetch.
+
+Reference: org.nd4j.linalg.dataset.api.iterator.DataSetIterator and
+AsyncDataSetIterator (background prefetch thread + bounded queue — the
+I/O↔compute overlap boundary in SURVEY.md §3.1).
+
+TPU design: the async wrapper prefetches AND device_puts ahead of compute, so
+the jitted train step never waits on host→HBM transfer (double buffering).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from .dataset import DataSet
+
+
+class DataSetIterator:
+    """Base iterator protocol (reference: DataSetIterator)."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> DataSet:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def batch_size(self) -> int:
+        raise NotImplementedError
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate over an in-memory DataSet in minibatches (reference:
+    ListDataSetIterator / IteratorDataSetIterator)."""
+
+    def __init__(self, data: DataSet, batch: int, shuffle: bool = False, seed: int = 0) -> None:
+        self.data = data
+        self.batch = batch
+        self.shuffle = shuffle
+        self.seed = seed
+        self._epoch = 0
+        self._order = np.arange(data.num_examples())
+        self._pos = 0
+        self.reset()
+
+    def reset(self) -> None:
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self._epoch)
+            self._order = rng.permutation(self.data.num_examples())
+            self._epoch += 1
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < self.data.num_examples()
+
+    def next(self) -> DataSet:
+        idx = self._order[self._pos : self._pos + self.batch]
+        self._pos += self.batch
+        d = self.data
+        return DataSet(
+            d.features[idx], d.labels[idx],
+            None if d.features_mask is None else d.features_mask[idx],
+            None if d.labels_mask is None else d.labels_mask[idx],
+        )
+
+    def batch_size(self) -> int:
+        return self.batch
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch with a bounded queue (reference:
+    AsyncDataSetIterator; queue_size = reference's default 8). Optionally
+    applies ``device_put_fn`` on the worker thread so batches land on device
+    before the consumer asks for them."""
+
+    _SENTINEL = object()
+
+    def __init__(
+        self,
+        underlying: DataSetIterator,
+        queue_size: int = 8,
+        device_put_fn: Optional[Callable[[DataSet], DataSet]] = None,
+    ) -> None:
+        self.underlying = underlying
+        self.queue_size = queue_size
+        self.device_put_fn = device_put_fn
+        self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._next_item = None
+        self._started = False
+
+    def _worker(self) -> None:
+        try:
+            while self.underlying.has_next():
+                item = self.underlying.next()
+                if self.device_put_fn is not None:
+                    item = self.device_put_fn(item)
+                self._queue.put(item)
+        except BaseException as e:  # propagate to consumer
+            self._error = e
+        finally:
+            self._queue.put(self._SENTINEL)
+
+    def _ensure_started(self) -> None:
+        if not self._started:
+            self._thread = threading.Thread(target=self._worker, daemon=True)
+            self._thread.start()
+            self._started = True
+            self._advance()
+
+    def _advance(self) -> None:
+        item = self._queue.get()
+        if item is self._SENTINEL:
+            if self._error is not None:
+                raise self._error
+            self._next_item = None
+        else:
+            self._next_item = item
+
+    def has_next(self) -> bool:
+        self._ensure_started()
+        return self._next_item is not None
+
+    def next(self) -> DataSet:
+        self._ensure_started()
+        if self._next_item is None:
+            raise StopIteration
+        item = self._next_item
+        self._advance()
+        return item
+
+    def reset(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            # drain so the worker can exit
+            while self._next_item is not None:
+                self._advance()
+            self._thread.join(timeout=5)
+        self.underlying.reset()
+        self._queue = queue.Queue(maxsize=self.queue_size)
+        self._error = None
+        self._started = False
+        self._next_item = None
+
+    def batch_size(self) -> int:
+        return self.underlying.batch_size()
+
+
+def device_put_dataset(ds: DataSet) -> DataSet:
+    """Standard device_put_fn for AsyncDataSetIterator: moves features/labels
+    to the default device on the prefetch thread so the train step's inputs
+    are already in HBM."""
+    import jax
+
+    return DataSet(
+        jax.device_put(ds.features),
+        jax.device_put(ds.labels),
+        None if ds.features_mask is None else jax.device_put(ds.features_mask),
+        None if ds.labels_mask is None else jax.device_put(ds.labels_mask),
+    )
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Repeats an iterator for N epochs (reference: MultipleEpochsIterator)."""
+
+    def __init__(self, underlying: DataSetIterator, epochs: int) -> None:
+        self.underlying = underlying
+        self.epochs = epochs
+        self._epoch = 0
+
+    def reset(self) -> None:
+        self.underlying.reset()
+        self._epoch = 0
+
+    def has_next(self) -> bool:
+        if self.underlying.has_next():
+            return True
+        if self._epoch + 1 < self.epochs:
+            self._epoch += 1
+            self.underlying.reset()
+            return self.underlying.has_next()
+        return False
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        return self.underlying.next()
+
+    def batch_size(self) -> int:
+        return self.underlying.batch_size()

@@ -1,0 +1,17 @@
+from . import zoo
+from .serializer import (
+    read_normalizer,
+    restore_computation_graph,
+    restore_model,
+    restore_multi_layer_network,
+    write_model,
+)
+
+__all__ = [
+    "read_normalizer",
+    "restore_computation_graph",
+    "restore_model",
+    "restore_multi_layer_network",
+    "write_model",
+    "zoo",
+]

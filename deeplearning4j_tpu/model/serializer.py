@@ -1,0 +1,150 @@
+"""Model serialization.
+
+Reference: org.deeplearning4j.util.ModelSerializer (SURVEY.md §5.4): a zip
+with ``configuration.json`` (the config DSL — "config is data"),
+``coefficients.bin`` (single flat param vector, possible because of the
+contiguous-params invariant), ``updaterState.bin`` and an optional normalizer
+entry. Same layout here:
+
+  configuration.json   — core.config JSON of the MultiLayerConfiguration/
+                         ComputationGraphConfiguration
+  coefficients.npy     — flat float param vector (ravel_pytree order)
+  state.npz            — non-trainable state leaves (BN running stats)
+  updaterState.npz     — optax optimizer-state leaves (optional)
+  normalizer.npz       — normalizer state (optional)
+  meta.json            — model class + framework version
+
+Orbax handles sharded/async checkpoints for the distributed trainer
+(parallel/); this serializer is the reference-parity single-file format.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+from .. import __version__
+from ..core.config import from_json, to_json
+
+_CONF = "configuration.json"
+_COEFF = "coefficients.npy"
+_STATE = "state.npz"
+_UPDATER = "updaterState.npz"
+_NORM = "normalizer.npz"
+_META = "meta.json"
+
+
+def _leaves_to_npz(tree: Any) -> bytes:
+    leaves = jax.tree_util.tree_leaves(tree)
+    buf = io.BytesIO()
+    np.savez(buf, **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
+    return buf.getvalue()
+
+
+def _npz_to_leaves(data: bytes, template: Any) -> Any:
+    z = np.load(io.BytesIO(data))
+    leaves = [z[f"leaf_{i}"] for i in range(len(z.files))]
+    treedef = jax.tree_util.tree_structure(template)
+    t_leaves = jax.tree_util.tree_leaves(template)
+    if len(leaves) != len(t_leaves):
+        raise ValueError(
+            f"Checkpoint has {len(leaves)} state leaves, model expects {len(t_leaves)}"
+        )
+    import jax.numpy as jnp
+
+    cast = [jnp.asarray(l, np.asarray(t).dtype) for l, t in zip(leaves, t_leaves)]
+    return jax.tree_util.tree_unflatten(treedef, cast)
+
+
+def write_model(model, path: str, save_updater: bool = False, normalizer=None) -> None:
+    """Reference: ModelSerializer.writeModel(model, file, saveUpdater[, normalizer])."""
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr(_CONF, to_json(model.conf))
+        flat, _ = ravel_pytree(model.params)
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(flat))
+        zf.writestr(_COEFF, buf.getvalue())
+        zf.writestr(_STATE, _leaves_to_npz(model.state))
+        meta = {
+            "model_class": type(model).__name__,
+            "framework": "deeplearning4j_tpu",
+            "version": __version__,
+        }
+        zf.writestr(_META, json.dumps(meta))
+        if save_updater and model._trainer is not None:
+            zf.writestr(_UPDATER, _leaves_to_npz(model._trainer.opt_state))
+        if normalizer is not None:
+            buf = io.BytesIO()
+            np.savez(buf, **normalizer.state_dict())
+            zf.writestr(_NORM, buf.getvalue())
+
+
+def restore_multi_layer_network(path: str, load_updater: bool = False):
+    """Reference: ModelSerializer.restoreMultiLayerNetwork."""
+    from ..nn.sequential import MultiLayerNetwork
+
+    return _restore(path, MultiLayerNetwork, load_updater)
+
+
+def restore_computation_graph(path: str, load_updater: bool = False):
+    """Reference: ModelSerializer.restoreComputationGraph."""
+    from ..nn.graph import ComputationGraph
+
+    return _restore(path, ComputationGraph, load_updater)
+
+
+def restore_model(path: str, load_updater: bool = False):
+    with zipfile.ZipFile(path) as zf:
+        meta = json.loads(zf.read(_META))
+    if meta["model_class"] == "ComputationGraph":
+        return restore_computation_graph(path, load_updater)
+    return restore_multi_layer_network(path, load_updater)
+
+
+def _restore(path: str, cls, load_updater: bool):
+    with zipfile.ZipFile(path) as zf:
+        conf = from_json(zf.read(_CONF).decode())
+        model = cls(conf).init()
+        flat = np.load(io.BytesIO(zf.read(_COEFF)))
+        _, unravel = ravel_pytree(model.params)
+        model.params = unravel(jax.numpy.asarray(flat))
+        if _STATE in zf.namelist():
+            model.state = _npz_to_leaves(zf.read(_STATE), model.state)
+        if load_updater and _UPDATER in zf.namelist():
+            from ..train.solver import Solver
+
+            model._trainer = Solver(model)
+            model._trainer.opt_state = _npz_to_leaves(
+                zf.read(_UPDATER), model._trainer.opt_state
+            )
+    return model
+
+
+def read_normalizer(path: str):
+    from ..data.normalizers import (
+        ImagePreProcessingScaler,
+        NormalizerMinMaxScaler,
+        NormalizerStandardize,
+        VGG16ImagePreProcessor,
+    )
+
+    kinds = {
+        "standardize": NormalizerStandardize,
+        "minmax": NormalizerMinMaxScaler,
+        "image": ImagePreProcessingScaler,
+        "vgg16": VGG16ImagePreProcessor,
+    }
+    with zipfile.ZipFile(path) as zf:
+        if _NORM not in zf.namelist():
+            return None
+        z = np.load(io.BytesIO(zf.read(_NORM)))
+        d = {k: z[k] for k in z.files}
+    norm = kinds[str(d["kind"])]()
+    norm.load_state_dict(d)
+    return norm

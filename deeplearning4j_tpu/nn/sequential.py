@@ -180,6 +180,8 @@ class MultiLayerNetwork:
         key = jax.random.fold_in(rng, len(self.layers) - 1) if rng is not None else None
         ctx = LayerContext(train=train, rng=key, mask=cur_mask)
         loss = out_layer.compute_loss(params.get(name, {}), feat, labels, ctx, label_mask=label_mask)
+        # output layer state passes through unchanged (loss layers are stateless)
+        new_state[name] = dict(state.get(name, {}))
         # score in >= float32 precision; float64 models keep float64 (gradcheck)
         score_dtype = jnp.promote_types(self.dtype, jnp.float32)
         reg = jnp.asarray(0.0, score_dtype)

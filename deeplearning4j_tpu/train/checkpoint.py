@@ -1,0 +1,102 @@
+"""Checkpoint listener + evaluative listener.
+
+Reference: org.deeplearning4j.optimize.listeners.CheckpointListener (every N
+iters/epochs, keep-last-K policy, lastCheckpoint() resume helper) and
+EvaluativeListener (periodic evaluation during fit) — SURVEY.md §5.4/§5.5.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, List, Optional
+
+from ..core.listeners import TrainingListener
+
+
+class CheckpointListener(TrainingListener):
+    def __init__(
+        self,
+        directory: str,
+        save_every_n_iterations: Optional[int] = None,
+        save_every_n_epochs: Optional[int] = None,
+        save_every_n_seconds: Optional[float] = None,
+        keep_last: Optional[int] = None,
+        save_updater: bool = True,
+        log_fn=None,
+    ) -> None:
+        if not (save_every_n_iterations or save_every_n_epochs or save_every_n_seconds):
+            raise ValueError("Configure at least one save frequency")
+        self.directory = directory
+        self.every_iter = save_every_n_iterations
+        self.every_epoch = save_every_n_epochs
+        self.every_seconds = save_every_n_seconds
+        self.keep_last = keep_last
+        self.save_updater = save_updater
+        self.log_fn = log_fn
+        self._last_save_time = time.time()
+        self._saved: List[str] = []
+        os.makedirs(directory, exist_ok=True)
+
+    def _save(self, model, iteration: int, epoch: int) -> None:
+        from ..model.serializer import write_model
+
+        fname = os.path.join(
+            self.directory, f"checkpoint_iter{iteration}_epoch{epoch}.zip"
+        )
+        write_model(model, fname, save_updater=self.save_updater)
+        self._saved.append(fname)
+        meta = {
+            "iteration": iteration, "epoch": epoch, "time": time.time(),
+            "file": os.path.basename(fname),
+        }
+        with open(os.path.join(self.directory, "lastCheckpoint.json"), "w") as f:
+            json.dump(meta, f)
+        if self.keep_last is not None:
+            while len(self._saved) > self.keep_last:
+                old = self._saved.pop(0)
+                if os.path.exists(old):
+                    os.remove(old)
+        if self.log_fn:
+            self.log_fn(f"Saved checkpoint: {fname}")
+        self._last_save_time = time.time()
+
+    def iteration_done(self, model: Any, iteration: int, epoch: int, score: float) -> None:
+        if self.every_iter and iteration % self.every_iter == 0:
+            self._save(model, iteration, epoch)
+        elif self.every_seconds and (time.time() - self._last_save_time) >= self.every_seconds:
+            self._save(model, iteration, epoch)
+
+    def on_epoch_end(self, model: Any) -> None:
+        if self.every_epoch and (model.epoch_count + 1) % self.every_epoch == 0:
+            self._save(model, model.iteration_count, model.epoch_count)
+
+    @staticmethod
+    def last_checkpoint(directory: str) -> Optional[str]:
+        """Resume helper (reference: lastCheckpoint())."""
+        meta_path = os.path.join(directory, "lastCheckpoint.json")
+        if not os.path.exists(meta_path):
+            return None
+        with open(meta_path) as f:
+            meta = json.load(f)
+        path = os.path.join(directory, meta["file"])
+        return path if os.path.exists(path) else None
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodic evaluation on a held-out iterator (reference: EvaluativeListener)."""
+
+    def __init__(self, test_data, frequency: int = 100, log_fn=print) -> None:
+        self.test_data = test_data
+        self.frequency = frequency
+        self.log_fn = log_fn
+        self.history: List[float] = []
+
+    def iteration_done(self, model: Any, iteration: int, epoch: int, score: float) -> None:
+        if iteration % self.frequency != 0:
+            return
+        ev = model.evaluate(self.test_data)
+        self.history.append(ev.accuracy())
+        if self.log_fn:
+            self.log_fn(f"iter {iteration}: eval accuracy {ev.accuracy():.4f}")

@@ -22,6 +22,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from ..nn.conf import BackpropType, GradientNormalization
@@ -163,10 +164,95 @@ class Solver:
         model.last_batch_size = int(x.shape[0])
         return score, new_rnn
 
+    def fit_scan(self, features, labels, *, steps_per_call: Optional[int] = None) -> float:
+        """Compiled multi-step training: ``lax.scan`` over a stack of batches
+        so an entire epoch is ONE device dispatch.
+
+        ``features``/``labels`` are [n_batches, batch, ...] stacks. This is the
+        TPU-native answer to dispatch latency (SURVEY.md §7): where the
+        reference amortizes JNI overhead with workspaces, we amortize dispatch
+        with a compiled training loop. Semantics identical to calling
+        fit_batch n_batches times with no listeners attached; returns the
+        final score.
+        """
+        model = self.model
+        x = jnp.asarray(features, model.dtype)
+        y = jnp.asarray(labels)
+        key = ("scan",)
+        if key not in self._step_cache:
+            conf = model.conf
+
+            def one_step(carry, batch):
+                params, opt_state, state, rng = carry
+                xb, yb = batch
+                rng, step_key = jax.random.split(rng)
+
+                def loss_fn(p):
+                    return model.loss_pure(p, state, xb, yb, rng=step_key, train=True)
+
+                (score, (new_state, _)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+                grads = _normalize_gradients(
+                    grads, conf.gradient_normalization, conf.gradient_normalization_threshold
+                )
+                new_params, new_opt = self.optim.update(grads, opt_state, params)
+                return (new_params, new_opt, new_state, rng), score
+
+            def epoch(params, opt_state, state, xs, ys, rng):
+                (params, opt_state, state, _), scores = jax.lax.scan(
+                    one_step, (params, opt_state, state, rng), (xs, ys)
+                )
+                return params, opt_state, state, scores[-1]
+
+            self._step_cache[key] = jax.jit(epoch, donate_argnums=(0, 1, 2))
+        fn = self._step_cache[key]
+        rng = self.model._rng.next_key()
+        params, opt_state, state, score = fn(
+            model.params, self.opt_state, model.state, x, y, rng
+        )
+        model.params = params
+        model.state = state
+        self.opt_state = opt_state
+        model.iteration_count += int(x.shape[0])
+        model.last_batch_size = int(x.shape[1])
+        return score
+
     def fit(self, data, labels=None, *, epochs: int = 1, mask=None, label_mask=None) -> None:
         model = self.model
         from ..nn.sequential import _as_batches
 
+        # Without listeners the per-iteration score stays a device scalar —
+        # fetching it would force a host sync every step and stall the XLA
+        # dispatch pipeline (the reference has the same async property on CUDA:
+        # JITA syncs lazily, SURVEY.md §3.1).
+        sync_every_iter = bool(model.listeners.listeners)
+
+        # Fast path: no listeners, no masks, standard backprop -> stack uniform
+        # batches and run the whole epoch as one compiled scan (one dispatch).
+        if (
+            not sync_every_iter
+            and mask is None
+            and label_mask is None
+            and model.conf.backprop_type is not BackpropType.TRUNCATED_BPTT
+        ):
+            batches = [
+                (f, l) for f, l, m, lm in _as_batches(data, labels, mask)
+                if m is None and lm is None
+            ]
+            shapes = {(np.shape(f), np.shape(l)) for f, l in batches}
+            if batches and len(shapes) == 1:
+                xs = np.stack([np.asarray(f) for f, _ in batches])
+                ys = np.stack([np.asarray(l) for _, l in batches])
+                last = None
+                for _ in range(epochs):
+                    model.listeners.epoch_start(model)
+                    last = self.fit_scan(xs, ys)
+                    model.listeners.epoch_end(model)
+                    model.epoch_count += 1
+                if last is not None:
+                    model.score_value = float(last)
+                return
+
+        last_score = None
         for _ in range(epochs):
             model.listeners.epoch_start(model)
             for feats, labs, msk, lmsk in _as_batches(data, labels, mask):
@@ -180,13 +266,17 @@ class Solver:
                     score = self._fit_tbptt(feats, labs, msk, lmsk)
                 else:
                     score, _ = self.fit_batch(feats, labs, msk, lmsk)
-                model.score_value = float(score)
+                last_score = score
                 model.iteration_count += 1
-                model.listeners.iteration_done(
-                    model, model.iteration_count, model.epoch_count, model.score_value
-                )
+                if sync_every_iter:
+                    model.score_value = float(score)
+                    model.listeners.iteration_done(
+                        model, model.iteration_count, model.epoch_count, model.score_value
+                    )
             model.listeners.epoch_end(model)
             model.epoch_count += 1
+        if last_score is not None:
+            model.score_value = float(last_score)
 
     def _fit_tbptt(self, feats, labs, msk, lmsk) -> float:
         """Truncated BPTT windowed loop (reference: doTruncatedBPTT): slide a

@@ -1,0 +1,225 @@
+"""Stage-3 tests: iterators, normalizers, serializer, checkpoints,
+early stopping, scan fast path, MNIST, LeNet."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import (
+    AsyncDataSetIterator,
+    ListDataSetIterator,
+    MultipleEpochsIterator,
+)
+from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.data.normalizers import (
+    ImagePreProcessingScaler,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+)
+from deeplearning4j_tpu.model.serializer import (
+    restore_multi_layer_network,
+    write_model,
+)
+from deeplearning4j_tpu.model.zoo import LeNet
+from deeplearning4j_tpu.nn import (
+    Activation,
+    InputType,
+    LossFunction,
+    MultiLayerNetwork,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.train import Adam
+from deeplearning4j_tpu.train.checkpoint import CheckpointListener
+from deeplearning4j_tpu.train.early_stopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    MaxEpochsTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+
+
+def tiny_model(seed=1):
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(Adam(1e-2))
+        .list()
+        .layer(DenseLayer(n_out=8, activation=Activation.TANH))
+        .layer(OutputLayer(n_out=2))
+        .set_input_type(InputType.feed_forward(4))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def tiny_data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(axis=1) > 0).astype(int)]
+    return x, y
+
+
+class TestIterators:
+    def test_list_iterator_batches(self):
+        x, y = tiny_data(10)
+        it = ListDataSetIterator(DataSet(x, y), batch=4)
+        sizes = [ds.num_examples() for ds in it]
+        assert sizes == [4, 4, 2]
+
+    def test_list_iterator_reset_and_shuffle(self):
+        x, y = tiny_data(8)
+        it = ListDataSetIterator(DataSet(x, y), batch=8, shuffle=True, seed=1)
+        first = next(iter(it)).features.copy()
+        second = next(iter(it)).features.copy()
+        assert first.shape == second.shape
+        assert not np.array_equal(first, second)  # different epoch order
+
+    def test_async_iterator_equivalence(self):
+        x, y = tiny_data(20)
+        plain = list(ListDataSetIterator(DataSet(x, y), batch=6))
+        async_it = AsyncDataSetIterator(ListDataSetIterator(DataSet(x, y), batch=6))
+        got = list(async_it)
+        assert len(got) == len(plain)
+        for a, b in zip(got, plain):
+            np.testing.assert_array_equal(a.features, b.features)
+
+    def test_async_iterator_reset(self):
+        x, y = tiny_data(12)
+        it = AsyncDataSetIterator(ListDataSetIterator(DataSet(x, y), batch=4))
+        assert len(list(it)) == 3
+        assert len(list(it)) == 3  # again after implicit reset
+
+    def test_multiple_epochs(self):
+        x, y = tiny_data(8)
+        it = MultipleEpochsIterator(ListDataSetIterator(DataSet(x, y), batch=4), epochs=3)
+        assert len(list(it)) == 6
+
+
+class TestNormalizers:
+    def test_standardize_round_trip(self):
+        x, y = tiny_data(50)
+        ds = DataSet(x.copy(), y)
+        norm = NormalizerStandardize()
+        norm.fit(ds)
+        norm.transform(ds)
+        assert abs(ds.features.mean()) < 0.1
+        norm.revert(ds)
+        np.testing.assert_allclose(ds.features, x, atol=1e-4)
+
+    def test_minmax(self):
+        x, y = tiny_data(50)
+        ds = DataSet(x.copy(), y)
+        norm = NormalizerMinMaxScaler()
+        norm.fit(ds)
+        norm.transform(ds)
+        assert ds.features.min() >= -1e-6 and ds.features.max() <= 1 + 1e-6
+
+    def test_image_scaler(self):
+        ds = DataSet(np.full((2, 3), 255.0, np.float32), np.zeros((2, 1)))
+        ImagePreProcessingScaler().transform(ds)
+        np.testing.assert_allclose(ds.features, 1.0)
+
+
+class TestSerializer:
+    def test_round_trip(self, tmp_path):
+        model = tiny_model()
+        x, y = tiny_data()
+        model.fit(x, y, epochs=3)
+        out_before = np.asarray(model.output(x))
+        path = str(tmp_path / "model.zip")
+        write_model(model, path, save_updater=True)
+        restored = restore_multi_layer_network(path, load_updater=True)
+        out_after = np.asarray(restored.output(x))
+        np.testing.assert_allclose(out_before, out_after, rtol=1e-6)
+        assert restored.conf == model.conf
+
+    def test_training_resumes_identically(self, tmp_path):
+        x, y = tiny_data()
+        m1 = tiny_model()
+        m1.fit(x, y, epochs=2)
+        path = str(tmp_path / "m.zip")
+        write_model(m1, path, save_updater=True)
+        m2 = restore_multi_layer_network(path, load_updater=True)
+        # restored updater state means continued training matches
+        m1._rng = type(m1._rng)(99)
+        m2._rng = type(m2._rng)(99)
+        m1.fit(x, y, epochs=1)
+        m2.fit(x, y, epochs=1)
+        np.testing.assert_allclose(
+            np.asarray(m1.params["layer_0"]["W"]),
+            np.asarray(m2.params["layer_0"]["W"]), rtol=1e-5,
+        )
+
+
+class TestCheckpoint:
+    def test_checkpoint_and_keep_last(self, tmp_path):
+        model = tiny_model()
+        model.add_listeners(CheckpointListener(str(tmp_path), save_every_n_iterations=2, keep_last=2))
+        x, y = tiny_data()
+        for _ in range(6):
+            model.fit(x, y)
+        zips = [f for f in os.listdir(tmp_path) if f.endswith(".zip")]
+        assert len(zips) == 2
+        last = CheckpointListener.last_checkpoint(str(tmp_path))
+        assert last is not None
+        restored = restore_multi_layer_network(last)
+        assert restored.num_params() == model.num_params()
+
+
+class TestEarlyStopping:
+    def test_stops_and_returns_best(self):
+        x, y = tiny_data(64)
+        train_ds = DataSet(x, y)
+        config = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(ListDataSetIterator(train_ds, 32)),
+            epoch_termination_conditions=[
+                MaxEpochsTerminationCondition(8),
+                ScoreImprovementEpochTerminationCondition(3),
+            ],
+        )
+        trainer = EarlyStoppingTrainer(config, tiny_model(), ListDataSetIterator(train_ds, 32))
+        result = trainer.fit()
+        assert result.total_epochs <= 8
+        assert result.best_model is not None
+        assert np.isfinite(result.best_model_score)
+
+
+class TestScanFastPath:
+    def test_scan_matches_loop(self):
+        x, y = tiny_data(32)
+        m_scan = tiny_model(seed=5)
+        m_loop = tiny_model(seed=5)
+        from deeplearning4j_tpu.core import CollectScoresListener
+
+        # listener forces the per-batch loop path
+        m_loop.add_listeners(CollectScoresListener())
+        it1 = ListDataSetIterator(DataSet(x, y), batch=8)
+        it2 = ListDataSetIterator(DataSet(x, y), batch=8)
+        m_scan.fit(it1, epochs=2)
+        m_loop.fit(it2, epochs=2)
+        np.testing.assert_allclose(
+            np.asarray(m_scan.params["layer_0"]["W"]),
+            np.asarray(m_loop.params["layer_0"]["W"]),
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+class TestMnistLeNet:
+    def test_mnist_shapes(self):
+        it = MnistDataSetIterator(32, train=True, num_examples=64)
+        ds = next(iter(it))
+        assert ds.features.shape == (32, 784)
+        assert ds.labels.shape == (32, 10)
+        assert 0.0 <= ds.features.min() and ds.features.max() <= 1.0
+
+    def test_lenet_learns_mnist(self):
+        model = LeNet(seed=1).init()
+        it = MnistDataSetIterator(64, train=True, num_examples=512, seed=7)
+        model.fit(it, epochs=5)
+        test_it = MnistDataSetIterator(64, train=False, num_examples=256, seed=7)
+        ev = model.evaluate(test_it)
+        assert ev.accuracy() > 0.6, f"LeNet accuracy too low: {ev.accuracy()}"
