@@ -1,3 +1,5 @@
 from .lenet import LeNet
+from .resnet50 import ResNet50
+from .vgg16 import AlexNet, VGG16
 
-__all__ = ["LeNet"]
+__all__ = ["AlexNet", "LeNet", "ResNet50", "VGG16"]

@@ -80,6 +80,15 @@ class MultiLayerNetwork:
     def layer_names(self) -> List[str]:
         return [self.conf.layer_name(i) for i in range(len(self.layers))]
 
+    def named_param_layers(self):
+        """(name, layer) pairs for layers holding trainable params — the
+        updater-block boundaries (used by the Solver's LayerOptimizers)."""
+        return [
+            (self.conf.layer_name(i), l)
+            for i, l in enumerate(self.layers)
+            if l.has_params()
+        ]
+
     def init(self, seed: Optional[int] = None) -> "MultiLayerNetwork":
         rng = RngState(self.conf.seed if seed is None else seed)
         dtype = self.dtype

@@ -1,0 +1,163 @@
+"""GraphSolver — training machinery for ComputationGraph.
+
+Reference: ComputationGraph.fit() shares the Solver/StochasticGradientDescent
+machinery with MultiLayerNetwork (SURVEY.md §3.2 "same skeleton"). Here the
+GraphSolver reuses LayerOptimizers + gradient normalization from solver.py;
+the jitted step takes tuples of inputs/labels (MultiDataSet).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import DataSet, MultiDataSet
+from .solver import LayerOptimizers, _normalize_gradients
+
+
+class GraphSolver:
+    def __init__(self, model) -> None:
+        self.model = model
+        self.optim = LayerOptimizers(model)
+        self.opt_state = self.optim.init(model.params)
+        self._step_cache: Dict[Any, Any] = {}
+
+    def _step_fn(self, n_in: int, n_out: int):
+        key = ("step", n_in, n_out)
+        if key not in self._step_cache:
+            model = self.model
+            conf = model.conf
+
+            def step(params, opt_state, state, xs, ys, rng):
+                def loss_fn(p):
+                    return model.loss_pure(p, state, xs, ys, rng=rng, train=True)
+
+                (score, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+                grads = _normalize_gradients(
+                    grads, conf.gradient_normalization, conf.gradient_normalization_threshold
+                )
+                new_params, new_opt = self.optim.update(grads, opt_state, params)
+                return new_params, new_opt, new_state, score
+
+            self._step_cache[key] = jax.jit(step, donate_argnums=(0, 1, 2))
+        return self._step_cache[key]
+
+    def _scan_fn(self):
+        key = ("scan",)
+        if key not in self._step_cache:
+            model = self.model
+            conf = model.conf
+
+            def one_step(carry, batch):
+                params, opt_state, state, rng = carry
+                xs, ys = batch
+                rng, step_key = jax.random.split(rng)
+
+                def loss_fn(p):
+                    return model.loss_pure(p, state, xs, ys, rng=step_key, train=True)
+
+                (score, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+                grads = _normalize_gradients(
+                    grads, conf.gradient_normalization, conf.gradient_normalization_threshold
+                )
+                new_params, new_opt = self.optim.update(grads, opt_state, params)
+                return (new_params, new_opt, new_state, rng), score
+
+            def epoch(params, opt_state, state, xs, ys, rng):
+                (params, opt_state, state, _), scores = jax.lax.scan(
+                    one_step, (params, opt_state, state, rng), (xs, ys)
+                )
+                return params, opt_state, state, scores[-1]
+
+            self._step_cache[key] = jax.jit(epoch, donate_argnums=(0, 1, 2))
+        return self._step_cache[key]
+
+    def fit_batch(self, xs: Tuple, ys: Tuple):
+        model = self.model
+        xs = tuple(jnp.asarray(x, model.dtype) for x in xs)
+        ys = tuple(jnp.asarray(y) for y in ys)
+        fn = self._step_fn(len(xs), len(ys))
+        rng = model._rng.next_key()
+        params, opt_state, state, score = fn(
+            model.params, self.opt_state, model.state, xs, ys, rng
+        )
+        model.params = params
+        model.state = state
+        self.opt_state = opt_state
+        model.last_batch_size = int(xs[0].shape[0])
+        return score
+
+    def fit(self, data, labels=None, *, epochs: int = 1) -> None:
+        model = self.model
+        sync_every_iter = bool(model.listeners.listeners)
+        batches = list(self._as_multi_batches(data, labels))
+        # scan fast path: uniform shapes, no listeners
+        shapes = {
+            tuple(np.shape(a) for a in xs) + tuple(np.shape(a) for a in ys)
+            for xs, ys in batches
+        }
+        if not sync_every_iter and batches and len(shapes) == 1:
+            xs_stack = tuple(
+                np.stack([np.asarray(b[0][i]) for b in batches])
+                for i in range(len(batches[0][0]))
+            )
+            ys_stack = tuple(
+                np.stack([np.asarray(b[1][i]) for b in batches])
+                for i in range(len(batches[0][1]))
+            )
+            fn = self._scan_fn()
+            last = None
+            for _ in range(epochs):
+                model.listeners.epoch_start(model)
+                rng = model._rng.next_key()
+                params, opt_state, state, score = fn(
+                    model.params, self.opt_state, model.state,
+                    tuple(jnp.asarray(x, model.dtype) for x in xs_stack),
+                    tuple(jnp.asarray(y) for y in ys_stack), rng,
+                )
+                model.params = params
+                model.state = state
+                self.opt_state = opt_state
+                model.iteration_count += len(batches)
+                model.last_batch_size = int(xs_stack[0].shape[1])
+                last = score
+                model.listeners.epoch_end(model)
+                model.epoch_count += 1
+            if last is not None:
+                model.score_value = float(last)
+            return
+
+        last_score = None
+        for _ in range(epochs):
+            model.listeners.epoch_start(model)
+            for xs, ys in batches:
+                score = self.fit_batch(xs, ys)
+                last_score = score
+                model.iteration_count += 1
+                if sync_every_iter:
+                    model.score_value = float(score)
+                    model.listeners.iteration_done(
+                        model, model.iteration_count, model.epoch_count, model.score_value
+                    )
+            model.listeners.epoch_end(model)
+            model.epoch_count += 1
+        if last_score is not None:
+            model.score_value = float(last_score)
+
+    def _as_multi_batches(self, data, labels):
+        as_tuple = self.model._as_tuple
+        if labels is not None:
+            yield as_tuple(data), as_tuple(labels)
+            return
+        if isinstance(data, (DataSet, MultiDataSet)):
+            data = [data]
+        for item in data:
+            if isinstance(item, MultiDataSet):
+                yield tuple(item.features), tuple(item.labels)
+            elif isinstance(item, DataSet):
+                yield (item.features,), (item.labels,)
+            else:
+                yield as_tuple(item[0]), as_tuple(item[1])

@@ -76,10 +76,7 @@ class LayerOptimizers:
         conf = model.conf
         self.txs: Dict[str, optax.GradientTransformation] = {}
         global_updater = updater_from_any(conf.updater) if conf.updater is not None else Sgd()
-        for i, layer in enumerate(model.layers):
-            name = conf.layer_name(i)
-            if not layer.has_params():
-                continue
+        for name, layer in model.named_param_layers():
             if layer.frozen:
                 self.txs[name] = optax.set_to_zero()
                 continue
