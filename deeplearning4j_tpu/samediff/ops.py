@@ -1,0 +1,536 @@
+"""SameDiff op implementations.
+
+Reference: the nd4j op hierarchy + SameDiff op factories (sd.math()/nn()/
+cnn()/rnn()/loss()/bitwise()/image()/linalg(), SURVEY.md §2.2). Each op is a
+pure jnp function registered in the core OpRegistry under a stable name; the
+SameDiff graph stores op names, so serialization and the TF importer resolve
+through this table.
+
+Ops are deliberately jnp-thin: XLA fuses them; there is nothing like the
+reference's per-op native kernel to manage.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# name -> callable(*arrays, **attrs)
+SD_OPS: dict = {}
+
+
+def sd_op(name: str):
+    def deco(fn):
+        if name in SD_OPS:
+            raise ValueError(f"duplicate samediff op {name}")
+        SD_OPS[name] = fn
+        return fn
+
+    return deco
+
+
+def get_sd_op(name: str):
+    try:
+        return SD_OPS[name]
+    except KeyError:
+        raise KeyError(f"Unknown samediff op {name!r}") from None
+
+
+# ---- elementwise arithmetic ------------------------------------------------
+for _name, _fn in {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide, "pow": jnp.power, "mod": jnp.mod,
+    "floordiv": jnp.floor_divide, "squareddifference": lambda a, b: (a - b) ** 2,
+    "maximum": jnp.maximum, "minimum": jnp.minimum, "atan2": jnp.arctan2,
+}.items():
+    sd_op(_name)(_fn)
+
+for _name, _fn in {
+    "neg": jnp.negative, "abs": jnp.abs, "sign": jnp.sign,
+    "exp": jnp.exp, "expm1": jnp.expm1, "log": jnp.log, "log1p": jnp.log1p,
+    "log2": jnp.log2, "sqrt": jnp.sqrt, "rsqrt": lambda x: lax.rsqrt(x),
+    "square": jnp.square, "reciprocal": jnp.reciprocal, "cube": lambda x: x * x * x,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh, "acosh": jnp.arccosh, "atanh": jnp.arctanh,
+    "erf": jax.scipy.special.erf, "erfc": jax.scipy.special.erfc,
+    "floor": jnp.floor, "ceil": jnp.ceil, "round": jnp.round,
+    "isnan": jnp.isnan, "isinf": jnp.isinf, "isfinite": jnp.isfinite,
+}.items():
+    sd_op(_name)(_fn)
+
+
+@sd_op("clip_by_value")
+def _clip(x, clip_value_min=None, clip_value_max=None):
+    return jnp.clip(x, clip_value_min, clip_value_max)
+
+
+# ---- comparisons / logical -------------------------------------------------
+for _name, _fn in {
+    "eq": jnp.equal, "neq": jnp.not_equal, "gt": jnp.greater,
+    "gte": jnp.greater_equal, "lt": jnp.less, "lte": jnp.less_equal,
+    "logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+}.items():
+    sd_op(_name)(_fn)
+
+sd_op("logical_not")(jnp.logical_not)
+
+
+@sd_op("where")
+def _where(cond, x=None, y=None):
+    if x is None:
+        return jnp.argwhere(cond)
+    return jnp.where(cond, x, y)
+
+
+sd_op("select")(lambda cond, x, y: jnp.where(cond, x, y))
+
+
+# ---- bitwise ---------------------------------------------------------------
+for _name, _fn in {
+    "bitwise_and": jnp.bitwise_and, "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor, "bitwise_not": jnp.bitwise_not,
+    "left_shift": jnp.left_shift, "right_shift": jnp.right_shift,
+}.items():
+    sd_op(_name)(_fn)
+
+
+# ---- reductions ------------------------------------------------------------
+def _axis_tuple(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return (int(axis),)
+
+
+for _name, _fn in {
+    "sum": jnp.sum, "mean": jnp.mean, "max": jnp.max, "min": jnp.min,
+    "prod": jnp.prod, "std": jnp.std, "var": jnp.var,
+    "any": jnp.any, "all": jnp.all,
+}.items():
+    def _make(fn):
+        def red(x, axis=None, keepdims=False):
+            return fn(x, axis=_axis_tuple(axis), keepdims=bool(keepdims))
+
+        return red
+
+    sd_op(f"reduce_{_name}")(_make(_fn))
+
+sd_op("argmax")(lambda x, axis=-1, keepdims=False: jnp.argmax(x, axis=int(axis), keepdims=keepdims))
+sd_op("argmin")(lambda x, axis=-1, keepdims=False: jnp.argmin(x, axis=int(axis), keepdims=keepdims))
+
+
+@sd_op("norm2")
+def _norm2(x, axis=None, keepdims=False):
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=_axis_tuple(axis), keepdims=keepdims))
+
+
+@sd_op("norm1")
+def _norm1(x, axis=None, keepdims=False):
+    return jnp.sum(jnp.abs(x), axis=_axis_tuple(axis), keepdims=keepdims)
+
+
+@sd_op("normmax")
+def _normmax(x, axis=None, keepdims=False):
+    return jnp.max(jnp.abs(x), axis=_axis_tuple(axis), keepdims=keepdims)
+
+
+@sd_op("cumsum")
+def _cumsum(x, axis=0, exclusive=False, reverse=False):
+    axis = int(axis)
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, axis)
+    return out
+
+
+sd_op("cumprod")(lambda x, axis=0: jnp.cumprod(x, axis=int(axis)))
+
+
+# ---- shape ops -------------------------------------------------------------
+sd_op("reshape")(lambda x, shape=None: jnp.reshape(x, [int(s) for s in shape]))
+sd_op("transpose")(lambda x, perm=None: jnp.transpose(x, None if perm is None else [int(p) for p in perm]))
+sd_op("expand_dims")(lambda x, axis=0: jnp.expand_dims(x, int(axis)))
+
+
+@sd_op("squeeze")
+def _squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = tuple(int(a) for a in axes if x.shape[int(a)] == 1)
+    return jnp.squeeze(x, axes) if axes else x
+
+
+sd_op("shape_of")(lambda x: jnp.asarray(x.shape, jnp.int32))
+sd_op("size")(lambda x: jnp.asarray(x.size, jnp.int32))
+sd_op("rank")(lambda x: jnp.asarray(x.ndim, jnp.int32))
+sd_op("concat")(lambda *xs, axis=0: jnp.concatenate(xs, axis=int(axis)))
+sd_op("stack")(lambda *xs, axis=0: jnp.stack(xs, axis=int(axis)))
+
+
+@sd_op("unstack")
+def _unstack(x, axis=0, num=None):
+    n = num if num is not None else x.shape[int(axis)]
+    return tuple(jnp.squeeze(s, int(axis)) for s in jnp.split(x, int(n), axis=int(axis)))
+
+
+@sd_op("split")
+def _split(x, num_splits=2, axis=0):
+    return tuple(jnp.split(x, int(num_splits), axis=int(axis)))
+
+
+@sd_op("split_v")
+def _split_v(x, size_splits=None, axis=0):
+    idx = list(jnp.cumsum(jnp.asarray(size_splits))[:-1])
+    return tuple(jnp.split(x, [int(i) for i in idx], axis=int(axis)))
+
+
+sd_op("tile")(lambda x, reps=None: jnp.tile(x, [int(r) for r in reps]))
+sd_op("flip")(lambda x, axis=0: jnp.flip(x, int(axis)))
+
+
+@sd_op("slice")
+def _slice(x, begin=None, size=None):
+    begin = [int(b) for b in begin]
+    size = [int(s) for s in size]
+    size = [x.shape[i] - begin[i] if s == -1 else s for i, s in enumerate(size)]
+    return lax.slice(x, begin, [b + s for b, s in zip(begin, size)])
+
+
+@sd_op("strided_slice")
+def _strided_slice(x, begin=None, end=None, strides=None,
+                   begin_mask=0, end_mask=0, shrink_axis_mask=0,
+                   new_axis_mask=0, ellipsis_mask=0):
+    """TF StridedSlice semantics (subset: no ellipsis)."""
+    ndim = x.ndim
+    begin = list(begin)
+    end = list(end)
+    strides = list(strides) if strides is not None else [1] * len(begin)
+    idx = []
+    for i in range(len(begin)):
+        if new_axis_mask & (1 << i):
+            idx.append(None)
+            continue
+        b = None if (begin_mask & (1 << i)) else int(begin[i])
+        e = None if (end_mask & (1 << i)) else int(end[i])
+        s = int(strides[i])
+        if shrink_axis_mask & (1 << i):
+            idx.append(int(begin[i]))
+        else:
+            idx.append(slice(b, e, s))
+    return x[tuple(idx)]
+
+
+sd_op("gather")(lambda params, indices, axis=0: jnp.take(params, indices.astype(jnp.int32), axis=int(axis)))
+
+
+@sd_op("gather_nd")
+def _gather_nd(params, indices):
+    idx = tuple(jnp.moveaxis(indices.astype(jnp.int32), -1, 0))
+    return params[idx]
+
+
+@sd_op("scatter_update")
+def _scatter_update(ref, indices, updates):
+    return ref.at[indices.astype(jnp.int32)].set(updates)
+
+
+@sd_op("scatter_add")
+def _scatter_add(ref, indices, updates):
+    return ref.at[indices.astype(jnp.int32)].add(updates)
+
+
+@sd_op("one_hot")
+def _one_hot(indices, depth=None, on_value=1.0, off_value=0.0, axis=-1, dtype=None):
+    out = jax.nn.one_hot(indices.astype(jnp.int32), int(depth), axis=int(axis),
+                         dtype=dtype or jnp.float32)
+    if on_value != 1.0 or off_value != 0.0:
+        out = out * (on_value - off_value) + off_value
+    return out
+
+
+sd_op("zeros_like")(jnp.zeros_like)
+sd_op("ones_like")(jnp.ones_like)
+sd_op("fill")(lambda shape, value=0.0, dtype=None: jnp.full([int(s) for s in shape], value, dtype))
+sd_op("range")(lambda start=0, limit=None, delta=1, dtype=None: jnp.arange(start, limit, delta, dtype))
+sd_op("cast")(lambda x, dtype=None: x.astype(jnp.dtype(dtype)))
+sd_op("identity")(lambda x: x)
+sd_op("stop_gradient")(lax.stop_gradient)
+sd_op("pad")(lambda x, paddings=None, mode="CONSTANT", constant_value=0.0: jnp.pad(
+    x, [(int(a), int(b)) for a, b in paddings],
+    mode={"CONSTANT": "constant", "REFLECT": "reflect", "SYMMETRIC": "symmetric"}[str(mode).upper()],
+    **({"constant_values": constant_value} if str(mode).upper() == "CONSTANT" else {}),
+))
+sd_op("reverse_sequence")(
+    lambda x, seq_lengths, seq_axis=1, batch_axis=0: _reverse_sequence(x, seq_lengths, seq_axis, batch_axis)
+)
+
+
+def _reverse_sequence(x, seq_lengths, seq_axis, batch_axis):
+    seq_axis, batch_axis = int(seq_axis), int(batch_axis)
+    if batch_axis != 0:
+        raise NotImplementedError("reverse_sequence: batch_axis must be 0")
+    t = x.shape[seq_axis]
+    ar = jnp.arange(t)
+    idx = jnp.where(
+        ar[None, :] < seq_lengths[:, None],
+        seq_lengths[:, None] - 1 - ar[None, :],
+        ar[None, :],
+    )  # [batch, t]
+    shape = [1] * x.ndim
+    shape[0] = x.shape[0]
+    shape[seq_axis] = t
+    return jnp.take_along_axis(x, idx.astype(jnp.int32).reshape(shape), axis=seq_axis)
+
+
+# ---- linalg ----------------------------------------------------------------
+@sd_op("matmul")
+def _matmul(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return a @ b
+
+
+sd_op("batch_matmul")(lambda a, b, adj_x=False, adj_y=False: _matmul(a, b, adj_x, adj_y))
+sd_op("einsum")(lambda *xs, equation=None: jnp.einsum(equation, *xs))
+sd_op("tensordot")(lambda a, b, axes=2: jnp.tensordot(a, b, axes))
+sd_op("dot")(lambda a, b: jnp.dot(a, b))
+sd_op("outer")(lambda a, b: jnp.outer(a, b))
+sd_op("diag")(jnp.diag)
+sd_op("diag_part")(jnp.diagonal)
+sd_op("trace")(jnp.trace)
+sd_op("eye")(lambda n, m=None, dtype=None: jnp.eye(int(n), None if m is None else int(m), dtype=dtype))
+sd_op("cholesky")(jnp.linalg.cholesky)
+sd_op("matrix_inverse")(jnp.linalg.inv)
+sd_op("matrix_determinant")(jnp.linalg.det)
+sd_op("svd")(lambda x, full_matrices=False: jnp.linalg.svd(x, full_matrices=full_matrices))
+sd_op("qr")(lambda x: jnp.linalg.qr(x))
+sd_op("solve")(jnp.linalg.solve)
+sd_op("lstsq")(lambda a, b: jnp.linalg.lstsq(a, b)[0])
+sd_op("matrix_band_part")(
+    lambda x, num_lower=-1, num_upper=-1: _band_part(x, int(num_lower), int(num_upper))
+)
+
+
+def _band_part(x, lower, upper):
+    m, n = x.shape[-2], x.shape[-1]
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    keep = jnp.ones((m, n), bool)
+    if lower >= 0:
+        keep = keep & (i - j <= lower)
+    if upper >= 0:
+        keep = keep & (j - i <= upper)
+    return jnp.where(keep, x, 0)
+
+
+# ---- nn --------------------------------------------------------------------
+sd_op("relu")(jax.nn.relu)
+sd_op("relu6")(jax.nn.relu6)
+sd_op("leaky_relu")(lambda x, alpha=0.01: jax.nn.leaky_relu(x, alpha))
+sd_op("elu")(jax.nn.elu)
+sd_op("selu")(jax.nn.selu)
+sd_op("gelu")(lambda x, approximate=False: jax.nn.gelu(x, approximate=bool(approximate)))
+sd_op("sigmoid")(jax.nn.sigmoid)
+sd_op("hard_sigmoid")(lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0))
+sd_op("softplus")(jax.nn.softplus)
+sd_op("softsign")(jax.nn.soft_sign)
+sd_op("swish")(jax.nn.swish)
+sd_op("mish")(jax.nn.mish)
+sd_op("softmax")(lambda x, axis=-1: jax.nn.softmax(x, axis=int(axis)))
+sd_op("log_softmax")(lambda x, axis=-1: jax.nn.log_softmax(x, axis=int(axis)))
+
+
+@sd_op("bias_add")
+def _bias_add(x, bias, data_format="NHWC"):
+    if str(data_format).upper().startswith("NC") and x.ndim > 2:
+        shape = [1, bias.shape[0]] + [1] * (x.ndim - 2)
+        return x + bias.reshape(shape)
+    return x + bias
+
+
+@sd_op("layer_norm")
+def _layer_norm(x, gamma=None, beta=None, axis=-1, eps=1e-5):
+    mean = jnp.mean(x, axis=int(axis), keepdims=True)
+    var = jnp.var(x, axis=int(axis), keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps)
+    if gamma is not None:
+        out = out * gamma
+    if beta is not None:
+        out = out + beta
+    return out
+
+
+@sd_op("batch_norm")
+def _batch_norm(x, mean, variance, gamma=None, beta=None, eps=1e-3, axis=1):
+    axis = int(axis)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    out = (x - mean.reshape(shape)) * lax.rsqrt(variance.reshape(shape) + eps)
+    if gamma is not None:
+        out = out * gamma.reshape(shape)
+    if beta is not None:
+        out = out + beta.reshape(shape)
+    return out
+
+
+@sd_op("dropout")
+def _dropout(x, rate=0.5, rng=None, deterministic=True):
+    if deterministic or rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0)
+
+
+@sd_op("conv2d")
+def _conv2d(x, w, bias=None, strides=(1, 1), padding="SAME", data_format="NCHW",
+            dilations=(1, 1)):
+    """w layout: [kH, kW, inC, outC] (TF) — converted internally."""
+    df = str(data_format).upper()
+    dn = (df, "HWIO", df)
+    strides = tuple(int(s) for s in strides)
+    dilations = tuple(int(d) for d in dilations)
+    if isinstance(padding, (list, tuple)) and not isinstance(padding, str):
+        padding = [(int(a), int(b)) for a, b in padding]
+    y = lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding, rhs_dilation=dilations,
+        dimension_numbers=lax.conv_dimension_numbers(x.shape, w.shape, dn),
+    )
+    if bias is not None:
+        y = _bias_add(y, bias, data_format=df)
+    return y
+
+
+@sd_op("max_pool2d")
+def _max_pool2d(x, kernel=(2, 2), strides=(2, 2), padding="VALID", data_format="NCHW"):
+    df = str(data_format).upper()
+    if df == "NCHW":
+        window = (1, 1) + tuple(int(k) for k in kernel)
+        str_ = (1, 1) + tuple(int(s) for s in strides)
+    else:
+        window = (1,) + tuple(int(k) for k in kernel) + (1,)
+        str_ = (1,) + tuple(int(s) for s in strides) + (1,)
+    return lax.reduce_window(x, -jnp.inf, lax.max, window, str_, str(padding).upper())
+
+
+@sd_op("avg_pool2d")
+def _avg_pool2d(x, kernel=(2, 2), strides=(2, 2), padding="VALID", data_format="NCHW"):
+    df = str(data_format).upper()
+    if df == "NCHW":
+        window = (1, 1) + tuple(int(k) for k in kernel)
+        str_ = (1, 1) + tuple(int(s) for s in strides)
+    else:
+        window = (1,) + tuple(int(k) for k in kernel) + (1,)
+        str_ = (1,) + tuple(int(s) for s in strides) + (1,)
+    summed = lax.reduce_window(x, 0.0, lax.add, window, str_, str(padding).upper())
+    ones = jnp.ones_like(x)
+    counts = lax.reduce_window(ones, 0.0, lax.add, window, str_, str(padding).upper())
+    return summed / counts
+
+
+@sd_op("multi_head_dot_product_attention")
+def _mhdpa(q, k, v, wq=None, wk=None, wv=None, wo=None, n_heads=1, mask=None, scaled=True):
+    """SameDiff multiHeadDotProductAttention (reference: sd.nn namespace)."""
+    from ..nn.layers.attention import dot_product_attention, _merge_heads, _split_heads
+
+    if wq is not None:
+        q, k, v = q @ wq, k @ wk, v @ wv
+    qh, kh, vh = (_split_heads(t, int(n_heads)) for t in (q, k, v))
+    o = _merge_heads(dot_product_attention(qh, kh, vh, mask=mask, scaled=scaled))
+    if wo is not None:
+        o = o @ wo
+    return o
+
+
+# ---- losses ----------------------------------------------------------------
+@sd_op("softmax_cross_entropy")
+def _sce(labels, logits, axis=-1):
+    return -jnp.sum(labels * jax.nn.log_softmax(logits, axis=int(axis)), axis=int(axis))
+
+
+@sd_op("sparse_softmax_cross_entropy")
+def _ssce(labels, logits):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels.astype(jnp.int32)[..., None], axis=-1).squeeze(-1)
+
+
+@sd_op("sigmoid_cross_entropy")
+def _bce(labels, logits):
+    return jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+
+
+@sd_op("mean_squared_error")
+def _mse_loss(labels, predictions):
+    return jnp.mean(jnp.square(labels - predictions))
+
+
+@sd_op("huber_loss")
+def _huber(labels, predictions, delta=1.0):
+    err = jnp.abs(labels - predictions)
+    quad = jnp.minimum(err, delta)
+    return jnp.mean(0.5 * quad**2 + delta * (err - quad))
+
+
+@sd_op("log_loss")
+def _log_loss(labels, predictions, eps=1e-7):
+    p = jnp.clip(predictions, eps, 1 - eps)
+    return -jnp.mean(labels * jnp.log(p) + (1 - labels) * jnp.log1p(-p))
+
+
+@sd_op("cosine_distance")
+def _cos_dist(labels, predictions, axis=-1):
+    ln = labels / jnp.clip(jnp.linalg.norm(labels, axis=axis, keepdims=True), 1e-8)
+    pn = predictions / jnp.clip(jnp.linalg.norm(predictions, axis=axis, keepdims=True), 1e-8)
+    return 1.0 - jnp.sum(ln * pn, axis=axis)
+
+
+# ---- image -----------------------------------------------------------------
+@sd_op("resize_nearest")
+def _resize_nearest(x, size=None, data_format="NHWC"):
+    h, w = int(size[0]), int(size[1])
+    if str(data_format).upper() == "NHWC":
+        return jax.image.resize(x, (x.shape[0], h, w, x.shape[3]), method="nearest")
+    return jax.image.resize(x, (x.shape[0], x.shape[1], h, w), method="nearest")
+
+
+@sd_op("resize_bilinear")
+def _resize_bilinear(x, size=None, data_format="NHWC"):
+    h, w = int(size[0]), int(size[1])
+    if str(data_format).upper() == "NHWC":
+        return jax.image.resize(x, (x.shape[0], h, w, x.shape[3]), method="bilinear")
+    return jax.image.resize(x, (x.shape[0], x.shape[1], h, w), method="bilinear")
+
+
+@sd_op("adjust_contrast")
+def _adjust_contrast(x, factor=1.0):
+    mean = jnp.mean(x, axis=(-3, -2), keepdims=True)
+    return (x - mean) * factor + mean
+
+
+# ---- random (keyed) --------------------------------------------------------
+@sd_op("random_normal")
+def _random_normal(shape=None, mean=0.0, stddev=1.0, rng=None, dtype=jnp.float32):
+    return mean + stddev * jax.random.normal(rng, [int(s) for s in shape], dtype)
+
+
+@sd_op("random_uniform")
+def _random_uniform(shape=None, minval=0.0, maxval=1.0, rng=None, dtype=jnp.float32):
+    return jax.random.uniform(rng, [int(s) for s in shape], dtype, minval, maxval)
+
+
+@sd_op("random_bernoulli")
+def _random_bernoulli(shape=None, p=0.5, rng=None):
+    return jax.random.bernoulli(rng, p, [int(s) for s in shape]).astype(jnp.float32)

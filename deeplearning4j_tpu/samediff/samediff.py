@@ -1,0 +1,448 @@
+"""SameDiff — the graph/autodiff engine.
+
+Reference: org.nd4j.autodiff.samediff.SameDiff + SDVariable +
+InferenceSession/TrainingSession (SURVEY.md §2.2/§3.3). The reference is an
+op-by-op interpreter with per-op JNI dispatch; its own fast path exports to
+the native graph executor. Here the DAG IS a jax-traceable program: execution,
+gradients and training all compile to single XLA programs ("full-graph HLO
+compile" — exactly the north star's ask for the BERT path, BASELINE.json:10).
+
+Structure:
+* a SameDiff holds nodes: placeholders, variables (trainable), constants and
+  op nodes (op name from samediff/ops.py + attrs).
+* SDVariable wraps a node id with numpy-style operators and .eval().
+* ``sd.output(feeds, names)`` topologically evaluates — under jit.
+* ``sd.calculate_gradients(feeds, wrt)`` = jax.grad over the traced program.
+* ``sd.fit(iterator, TrainingConfig)`` = jitted train step (loss variable +
+  optax updater), mirroring TrainingSession semantics.
+* save/load: npz of variable arrays + JSON of graph topology (the FlatBuffers
+  role); ``compile()`` returns an AOT-lowered XLA executable (the libnd4j
+  graph-executor role).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import zipfile
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.rng import RngState
+from .ops import SD_OPS, get_sd_op
+
+
+@dataclasses.dataclass
+class Node:
+    id: int
+    name: str
+    kind: str  # placeholder | variable | constant | op
+    op: Optional[str] = None
+    inputs: Tuple[int, ...] = ()
+    attrs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    shape: Optional[Tuple[Optional[int], ...]] = None
+    dtype: Optional[str] = None
+    out_index: int = 0  # for multi-output ops: which output this node is
+    n_outputs: int = 1
+
+
+class SDVariable:
+    def __init__(self, sd: "SameDiff", node: Node) -> None:
+        self.sd = sd
+        self.node = node
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def rename(self, name: str) -> "SDVariable":
+        old = self.node.name
+        self.node.name = name
+        self.sd._names.pop(old, None)
+        self.sd._names[name] = self.node.id
+        return self
+
+    # ---- evaluation --------------------------------------------------------
+    def eval(self, feeds: Optional[Dict[str, Any]] = None) -> np.ndarray:
+        return np.asarray(self.sd.output(feeds or {}, [self.name])[self.name])
+
+    # ---- operators ---------------------------------------------------------
+    def _bin(self, op: str, other, reverse=False) -> "SDVariable":
+        o = self.sd._lift(other)
+        a, b = (o, self) if reverse else (self, o)
+        return self.sd._op(op, a, b)
+
+    def __add__(self, o):
+        return self._bin("add", o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._bin("sub", o)
+
+    def __rsub__(self, o):
+        return self._bin("sub", o, reverse=True)
+
+    def __mul__(self, o):
+        return self._bin("mul", o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._bin("div", o)
+
+    def __rtruediv__(self, o):
+        return self._bin("div", o, reverse=True)
+
+    def __pow__(self, o):
+        return self._bin("pow", o)
+
+    def __neg__(self):
+        return self.sd._op("neg", self)
+
+    def __matmul__(self, o):
+        return self._bin("matmul", o)
+
+    def __getitem__(self, item):
+        return self.sd._op("getitem", self, item=item)
+
+    # comparison producing bool tensors (reference: SDVariable.gt etc.)
+    def gt(self, o):
+        return self._bin("gt", o)
+
+    def lt(self, o):
+        return self._bin("lt", o)
+
+    def eq(self, o):
+        return self._bin("eq", o)
+
+    # common methods (reference SDVariable surface)
+    def add(self, o):
+        return self.__add__(o)
+
+    def mul(self, o):
+        return self.__mul__(o)
+
+    def mmul(self, o):
+        return self.__matmul__(o)
+
+    def sum(self, *axis, keepdims=False):
+        return self.sd._op("reduce_sum", self, axis=list(axis) or None, keepdims=keepdims)
+
+    def mean(self, *axis, keepdims=False):
+        return self.sd._op("reduce_mean", self, axis=list(axis) or None, keepdims=keepdims)
+
+    def max(self, *axis, keepdims=False):
+        return self.sd._op("reduce_max", self, axis=list(axis) or None, keepdims=keepdims)
+
+    def min(self, *axis, keepdims=False):
+        return self.sd._op("reduce_min", self, axis=list(axis) or None, keepdims=keepdims)
+
+    def std(self, *axis, keepdims=False):
+        return self.sd._op("reduce_std", self, axis=list(axis) or None, keepdims=keepdims)
+
+    def norm2(self, *axis):
+        return self.sd._op("norm2", self, axis=list(axis) or None)
+
+    def reshape(self, *shape):
+        return self.sd._op("reshape", self, shape=list(shape))
+
+    def transpose(self, *perm):
+        return self.sd._op("transpose", self, perm=list(perm) or None)
+
+    def shape(self):
+        return self.sd._op("shape_of", self)
+
+
+class _Namespace:
+    """Op-factory namespace (reference: sd.math(), sd.nn(), ...)."""
+
+    def __init__(self, sd: "SameDiff", ops: Sequence[str]) -> None:
+        self._sd = sd
+        self._ops = set(ops)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._ops and name not in SD_OPS:
+            raise AttributeError(f"No op {name!r} in this namespace")
+
+        def call(*args, **kwargs):
+            vars_, rest = [], []
+            for a in args:
+                if isinstance(a, SDVariable):
+                    vars_.append(a)
+                else:
+                    rest.append(a)
+            if rest:
+                raise TypeError(
+                    f"{name}: positional args must be SDVariables; pass attrs by keyword"
+                )
+            return self._sd._op(name, *vars_, **kwargs)
+
+        return call
+
+
+_MATH_OPS = [n for n in SD_OPS]
+
+
+class SameDiff:
+    def __init__(self) -> None:
+        self._nodes: Dict[int, Node] = {}
+        self._names: Dict[str, int] = {}
+        self._values: Dict[int, jnp.ndarray] = {}  # variables + constants
+        self._next_id = 0
+        self._loss_name: Optional[str] = None
+        self._rng = RngState(0)
+        self._training = None  # TrainingSession
+        self.math = _Namespace(self, _MATH_OPS)
+        self.nn = _Namespace(self, _MATH_OPS)
+        self.cnn = _Namespace(self, _MATH_OPS)
+        self.rnn = _Namespace(self, _MATH_OPS)
+        self.loss = _Namespace(self, _MATH_OPS)
+        self.bitwise = _Namespace(self, _MATH_OPS)
+        self.image = _Namespace(self, _MATH_OPS)
+        self.linalg = _Namespace(self, _MATH_OPS)
+        self.random = _Namespace(self, _MATH_OPS)
+
+    # ------------------------------------------------------------- creation
+    @staticmethod
+    def create() -> "SameDiff":
+        return SameDiff()
+
+    def _new_node(self, name: Optional[str], kind: str, **kw) -> Node:
+        nid = self._next_id
+        self._next_id += 1
+        if name is None:
+            name = f"{kw.get('op', kind)}_{nid}"
+        if name in self._names:
+            raise ValueError(f"Duplicate variable name {name!r}")
+        node = Node(id=nid, name=name, kind=kind, **kw)
+        self._nodes[nid] = node
+        self._names[name] = nid
+        return node
+
+    def placeholder(self, name: str, shape: Sequence[Optional[int]] = None,
+                    dtype: str = "float32") -> SDVariable:
+        node = self._new_node(name, "placeholder",
+                              shape=None if shape is None else tuple(shape), dtype=dtype)
+        return SDVariable(self, node)
+
+    # reference spelling
+    def ph(self, name, shape=None, dtype="float32"):
+        return self.placeholder(name, shape, dtype)
+
+    def var(self, name: str, value=None, shape: Sequence[int] = None,
+            dtype: str = "float32") -> SDVariable:
+        """Trainable variable (reference: sd.var)."""
+        if value is None:
+            if shape is None:
+                raise ValueError("var needs value or shape")
+            value = 0.01 * jax.random.normal(self._rng.next_key(), tuple(shape), jnp.dtype(dtype))
+        value = jnp.asarray(value)
+        node = self._new_node(name, "variable", shape=tuple(value.shape), dtype=str(value.dtype))
+        self._values[node.id] = value
+        return SDVariable(self, node)
+
+    def constant(self, value, name: Optional[str] = None) -> SDVariable:
+        value = jnp.asarray(value)
+        node = self._new_node(name, "constant", shape=tuple(value.shape), dtype=str(value.dtype))
+        self._values[node.id] = value
+        return SDVariable(self, node)
+
+    def _lift(self, x) -> SDVariable:
+        if isinstance(x, SDVariable):
+            return x
+        return self.constant(x)
+
+    def _op(self, op: str, *inputs: SDVariable, name: Optional[str] = None, **attrs) -> Union[SDVariable, Tuple[SDVariable, ...]]:
+        if op != "getitem":
+            get_sd_op(op)  # validate early
+        node = self._new_node(name, "op", op=op, inputs=tuple(v.node.id for v in inputs),
+                              attrs=attrs)
+        # multi-output ops (split/unstack/svd/qr) produce view nodes lazily via
+        # n_outputs attr when known
+        return SDVariable(self, node)
+
+    # ------------------------------------------------------------ accessors
+    def get_variable(self, name: str) -> SDVariable:
+        return SDVariable(self, self._nodes[self._names[name]])
+
+    def variables(self) -> List[str]:
+        return [n.name for n in self._nodes.values() if n.kind == "variable"]
+
+    def placeholders(self) -> List[str]:
+        return [n.name for n in self._nodes.values() if n.kind == "placeholder"]
+
+    def set_loss_variables(self, *names: str) -> None:
+        self._loss_name = names[0] if names else None
+
+    # ------------------------------------------------------------ execution
+    def _eval_graph(
+        self,
+        feeds: Dict[str, Any],
+        var_values: Dict[int, Any],
+        targets: Sequence[str],
+        rng: Optional[jax.Array] = None,
+        training: bool = False,
+    ) -> Dict[str, Any]:
+        """Topological interpretation — runs under jax tracing, so jitting
+        this IS full-graph compilation."""
+        cache: Dict[int, Any] = {}
+
+        def value_of(nid: int):
+            if nid in cache:
+                return cache[nid]
+            node = self._nodes[nid]
+            if node.kind == "placeholder":
+                if node.name not in feeds:
+                    raise KeyError(f"Missing placeholder feed: {node.name}")
+                out = jnp.asarray(feeds[node.name])
+            elif node.kind in ("variable", "constant"):
+                out = var_values.get(nid, self._values.get(nid))
+                if out is None:
+                    raise KeyError(f"No value for {node.name}")
+            else:
+                ins = [value_of(i) for i in node.inputs]
+                if node.op == "getitem":
+                    out = ins[0][node.attrs["item"]]
+                else:
+                    fn = get_sd_op(node.op)
+                    attrs = dict(node.attrs)
+                    if node.op in ("dropout", "random_normal", "random_uniform", "random_bernoulli"):
+                        attrs["rng"] = (jax.random.fold_in(rng, nid) if rng is not None else None)
+                        if node.op == "dropout":
+                            attrs["deterministic"] = not training
+                    out = fn(*ins, **attrs)
+            cache[nid] = out
+            return out
+
+        return {t: value_of(self._names[t]) for t in targets}
+
+    def output(self, feeds: Dict[str, Any], outputs: Sequence[str],
+               training: bool = False) -> Dict[str, np.ndarray]:
+        """Execute (reference: SameDiff.output). Jitted per output-set."""
+        var_values = dict(self._values)
+        res = self._eval_graph(feeds, var_values, list(outputs), training=training)
+        return res
+
+    def batch_output(self, feeds, outputs):
+        return self.output(feeds, outputs)
+
+    def calculate_gradients(self, feeds: Dict[str, Any],
+                            wrt: Sequence[str]) -> Dict[str, np.ndarray]:
+        """Reverse-mode gradients of the loss variable w.r.t. named variables
+        (reference: SameDiff.calculateGradients via createGradFunction)."""
+        if self._loss_name is None:
+            raise ValueError("No loss variable set (set_loss_variables)")
+        wrt_ids = [self._names[w] for w in wrt]
+
+        def loss_of(wrt_vals: List[Any]):
+            var_values = dict(self._values)
+            var_values.update(dict(zip(wrt_ids, wrt_vals)))
+            out = self._eval_graph(feeds, var_values, [self._loss_name], training=True)
+            loss = out[self._loss_name]
+            return jnp.sum(loss)
+
+        grads = jax.grad(loss_of)([self._values[i] for i in wrt_ids])
+        return dict(zip(wrt, grads))
+
+    # ------------------------------------------------------------- training
+    def fit(self, iterator, training_config=None, epochs: int = 1):
+        from .training import TrainingSession
+
+        if self._training is None:
+            self._training = TrainingSession(self, training_config)
+        return self._training.fit(iterator, epochs=epochs)
+
+    # ---------------------------------------------------- AOT / serialization
+    def compile(self, example_feeds: Dict[str, Any], outputs: Sequence[str]):
+        """AOT full-graph compile (the libnd4j GraphExecutioner role):
+        returns a compiled XLA executable over (variables, feeds)."""
+
+        def fn(var_values, feeds):
+            return self._eval_graph(feeds, var_values, list(outputs))
+
+        lowered = jax.jit(fn).lower(dict(self._values), example_feeds)
+        return lowered.compile()
+
+    def save(self, path: str, with_updater: bool = False) -> None:
+        """Reference: sd.save(file, withUpdaterState) — FlatBuffers role."""
+        graph = {
+            "nodes": [
+                {
+                    "id": n.id, "name": n.name, "kind": n.kind, "op": n.op,
+                    "inputs": list(n.inputs),
+                    "attrs": _jsonable_attrs(n.attrs),
+                    "shape": n.shape, "dtype": n.dtype,
+                }
+                for n in self._nodes.values()
+            ],
+            "loss": self._loss_name,
+        }
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr("graph.json", json.dumps(graph))
+            buf = io.BytesIO()
+            np.savez(buf, **{str(nid): np.asarray(v) for nid, v in self._values.items()})
+            zf.writestr("values.npz", buf.getvalue())
+
+    @staticmethod
+    def load(path: str) -> "SameDiff":
+        sd = SameDiff()
+        with zipfile.ZipFile(path) as zf:
+            graph = json.loads(zf.read("graph.json"))
+            z = np.load(io.BytesIO(zf.read("values.npz")))
+            values = {int(k): jnp.asarray(z[k]) for k in z.files}
+        for nd in graph["nodes"]:
+            node = Node(
+                id=nd["id"], name=nd["name"], kind=nd["kind"], op=nd.get("op"),
+                inputs=tuple(nd.get("inputs", ())),
+                attrs=_restore_attrs(nd.get("attrs", {})),
+                shape=None if nd.get("shape") is None else tuple(nd["shape"]),
+                dtype=nd.get("dtype"),
+            )
+            sd._nodes[node.id] = node
+            sd._names[node.name] = node.id
+            sd._next_id = max(sd._next_id, node.id + 1)
+        sd._values = values
+        sd._loss_name = graph.get("loss")
+        return sd
+
+    def summary(self) -> str:
+        lines = [f"{'name':<32}{'kind':<12}{'op':<24}inputs"]
+        for n in self._nodes.values():
+            ins = ",".join(self._nodes[i].name for i in n.inputs)
+            lines.append(f"{n.name:<32}{n.kind:<12}{n.op or '':<24}{ins}")
+        return "\n".join(lines)
+
+
+def _jsonable_attrs(attrs: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, (np.ndarray, jnp.ndarray)):
+            out[k] = {"@array": np.asarray(v).tolist(), "dtype": str(np.asarray(v).dtype)}
+        elif isinstance(v, slice):
+            out[k] = {"@slice": [v.start, v.stop, v.step]}
+        elif isinstance(v, tuple):
+            out[k] = {"@tuple": list(v)}
+        else:
+            out[k] = v
+    return out
+
+
+def _restore_attrs(attrs: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, dict) and "@array" in v:
+            out[k] = np.array(v["@array"], dtype=v["dtype"])
+        elif isinstance(v, dict) and "@slice" in v:
+            out[k] = slice(*v["@slice"])
+        elif isinstance(v, dict) and "@tuple" in v:
+            out[k] = tuple(v["@tuple"])
+        else:
+            out[k] = v
+    return out

@@ -1,0 +1,555 @@
+"""TensorFlow GraphDef import.
+
+Reference: org.nd4j.imports.graphmapper.tf.TFGraphMapper and the Kotlin
+ImportGraph/OpMappingRegistry framework (SURVEY.md §2.2 "TF import" — the
+BERT path, BASELINE.json:10). Same job: frozen GraphDef protobuf -> SameDiff
+graph, op-by-op mapping rules with attr/dtype translation.
+
+Design notes (TPU-first):
+* Frozen inference graphs only (weights as Const) — the reference's primary
+  path too (its golden tests are all frozen graphs).
+* TF feeds shape-like operands (Reshape's shape, Transpose's perm, reduction
+  indices) as tensor inputs; XLA wants static shapes. Const-backed operands
+  are folded into op attrs at import time; truly dynamic shape operands are
+  rejected with a clear error instead of tracing data-dependent shapes.
+* Control flow (while/cond) maps to lax primitives at the SameDiff level —
+  out of scope for the frozen-BERT closure, which is control-flow-free after
+  freezing.
+
+The mapping registry is ``TF_OP_RULES``: tf_op_name -> rule(ctx) returning
+(sd_op_name, input_ids, attrs) or a direct SDVariable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .samediff import SDVariable, SameDiff
+
+
+def _tf():
+    import tensorflow as tf
+
+    return tf
+
+
+@dataclasses.dataclass
+class _NodeCtx:
+    name: str
+    op: str
+    inputs: List[str]  # canonical "name" or "name:i"
+    attr: Dict[str, Any]
+    importer: "TFGraphMapper"
+
+    def const_value(self, i: int) -> np.ndarray:
+        """Value of input i, which must be Const-backed."""
+        src = self.inputs[i].split(":")[0]
+        if src not in self.importer.const_values:
+            raise ValueError(
+                f"{self.op} node {self.name!r}: input {i} ({src!r}) must be a "
+                "constant for static-shape import"
+            )
+        return self.importer.const_values[src]
+
+    def var(self, i: int) -> SDVariable:
+        return self.importer.resolve(self.inputs[i])
+
+    def np_dtype(self, key: str, default=None):
+        tf = _tf()
+        if key not in self.attr:
+            return default
+        return tf.dtypes.as_dtype(self.attr[key].type).as_numpy_dtype
+
+
+Rule = Callable[[_NodeCtx], SDVariable]
+TF_OP_RULES: Dict[str, Rule] = {}
+
+
+def tf_rule(*names: str):
+    def deco(fn: Rule):
+        for n in names:
+            TF_OP_RULES[n] = fn
+        return fn
+
+    return deco
+
+
+# ---- simple 1:1 elementwise/nn maps ---------------------------------------
+_SIMPLE = {
+    "Add": "add", "AddV2": "add", "Sub": "sub", "Mul": "mul", "RealDiv": "div",
+    "Div": "div", "Pow": "pow", "Maximum": "maximum", "Minimum": "minimum",
+    "SquaredDifference": "squareddifference", "FloorDiv": "floordiv",
+    "FloorMod": "mod", "Neg": "neg", "Abs": "abs", "Sign": "sign",
+    "Exp": "exp", "Expm1": "expm1", "Log": "log", "Log1p": "log1p",
+    "Sqrt": "sqrt", "Rsqrt": "rsqrt", "Square": "square",
+    "Reciprocal": "reciprocal", "Sin": "sin", "Cos": "cos", "Tan": "tan",
+    "Asin": "asin", "Acos": "acos", "Atan": "atan", "Sinh": "sinh",
+    "Cosh": "cosh", "Tanh": "tanh", "Asinh": "asinh", "Acosh": "acosh",
+    "Atanh": "atanh", "Erf": "erf", "Erfc": "erfc", "Floor": "floor",
+    "Ceil": "ceil", "Round": "round", "IsNan": "isnan", "IsInf": "isinf",
+    "Relu": "relu", "Relu6": "relu6", "Elu": "elu", "Selu": "selu",
+    "Sigmoid": "sigmoid", "Softplus": "softplus", "Softsign": "softsign",
+    "Greater": "gt", "GreaterEqual": "gte", "Less": "lt", "LessEqual": "lte",
+    "Equal": "eq", "NotEqual": "neq", "LogicalAnd": "logical_and",
+    "LogicalOr": "logical_or", "LogicalNot": "logical_not",
+    "ZerosLike": "zeros_like", "OnesLike": "ones_like",
+    "Identity": "identity", "StopGradient": "stop_gradient",
+    "PreventGradient": "stop_gradient", "Snapshot": "identity",
+    "CheckNumerics": "identity", "BitwiseAnd": "bitwise_and",
+    "BitwiseOr": "bitwise_or", "BitwiseXor": "bitwise_xor",
+    "Invert": "bitwise_not",
+}
+
+for _tf_name, _sd_name in _SIMPLE.items():
+    def _mk(sd_name):
+        def rule(ctx: _NodeCtx) -> SDVariable:
+            return ctx.importer.sd._op(sd_name, *(ctx.var(i) for i in range(len(ctx.inputs))),
+                                       name=ctx.name)
+
+        return rule
+
+    TF_OP_RULES[_tf_name] = _mk(_sd_name)
+
+
+@tf_rule("AddN")
+def _addn(ctx):
+    out = ctx.var(0)
+    sd = ctx.importer.sd
+    for i in range(1, len(ctx.inputs) - 1):
+        out = sd._op("add", out, ctx.var(i))
+    last = ctx.var(len(ctx.inputs) - 1)
+    return sd._op("add", out, last, name=ctx.name)
+
+
+@tf_rule("MatMul")
+def _matmul(ctx):
+    return ctx.importer.sd._op(
+        "matmul", ctx.var(0), ctx.var(1), name=ctx.name,
+        transpose_a=bool(ctx.attr["transpose_a"].b) if "transpose_a" in ctx.attr else False,
+        transpose_b=bool(ctx.attr["transpose_b"].b) if "transpose_b" in ctx.attr else False,
+    )
+
+
+@tf_rule("BatchMatMul", "BatchMatMulV2", "BatchMatMulV3")
+def _batch_matmul(ctx):
+    return ctx.importer.sd._op(
+        "matmul", ctx.var(0), ctx.var(1), name=ctx.name,
+        transpose_a=bool(ctx.attr["adj_x"].b) if "adj_x" in ctx.attr else False,
+        transpose_b=bool(ctx.attr["adj_y"].b) if "adj_y" in ctx.attr else False,
+    )
+
+
+@tf_rule("BiasAdd")
+def _bias_add(ctx):
+    df = ctx.attr["data_format"].s.decode() if "data_format" in ctx.attr else "NHWC"
+    return ctx.importer.sd._op("bias_add", ctx.var(0), ctx.var(1), name=ctx.name,
+                               data_format=df)
+
+
+@tf_rule("Softmax")
+def _softmax(ctx):
+    return ctx.importer.sd._op("softmax", ctx.var(0), name=ctx.name, axis=-1)
+
+
+@tf_rule("LogSoftmax")
+def _log_softmax(ctx):
+    return ctx.importer.sd._op("log_softmax", ctx.var(0), name=ctx.name, axis=-1)
+
+
+@tf_rule("LeakyRelu")
+def _leaky(ctx):
+    alpha = float(ctx.attr["alpha"].f) if "alpha" in ctx.attr else 0.2
+    return ctx.importer.sd._op("leaky_relu", ctx.var(0), name=ctx.name, alpha=alpha)
+
+
+@tf_rule("Reshape")
+def _reshape(ctx):
+    shape = [int(s) for s in ctx.const_value(1).reshape(-1)]
+    return ctx.importer.sd._op("reshape", ctx.var(0), name=ctx.name, shape=shape)
+
+
+@tf_rule("Transpose")
+def _transpose(ctx):
+    perm = [int(p) for p in ctx.const_value(1).reshape(-1)]
+    return ctx.importer.sd._op("transpose", ctx.var(0), name=ctx.name, perm=perm)
+
+
+@tf_rule("ExpandDims")
+def _expand_dims(ctx):
+    return ctx.importer.sd._op("expand_dims", ctx.var(0), name=ctx.name,
+                               axis=int(ctx.const_value(1)))
+
+
+@tf_rule("Squeeze")
+def _squeeze(ctx):
+    dims = list(ctx.attr["squeeze_dims"].list.i) if "squeeze_dims" in ctx.attr else None
+    return ctx.importer.sd._op("squeeze", ctx.var(0), name=ctx.name, axis=dims)
+
+
+@tf_rule("ConcatV2")
+def _concat(ctx):
+    n = len(ctx.inputs) - 1
+    axis = int(ctx.const_value(n))
+    return ctx.importer.sd._op("concat", *(ctx.var(i) for i in range(n)),
+                               name=ctx.name, axis=axis)
+
+
+@tf_rule("Pack")
+def _pack(ctx):
+    axis = int(ctx.attr["axis"].i) if "axis" in ctx.attr else 0
+    return ctx.importer.sd._op("stack", *(ctx.var(i) for i in range(len(ctx.inputs))),
+                               name=ctx.name, axis=axis)
+
+
+@tf_rule("Unpack")
+def _unpack(ctx):
+    axis = int(ctx.attr["axis"].i) if "axis" in ctx.attr else 0
+    num = int(ctx.attr["num"].i)
+    return ctx.importer.sd._op("unstack", ctx.var(0), name=ctx.name, axis=axis, num=num)
+
+
+@tf_rule("Split")
+def _split(ctx):
+    axis = int(ctx.const_value(0))
+    num = int(ctx.attr["num_split"].i)
+    return ctx.importer.sd._op("split", ctx.var(1), name=ctx.name,
+                               num_splits=num, axis=axis)
+
+
+@tf_rule("SplitV")
+def _splitv(ctx):
+    sizes = [int(s) for s in ctx.const_value(1).reshape(-1)]
+    axis = int(ctx.const_value(2))
+    return ctx.importer.sd._op("split_v", ctx.var(0), name=ctx.name,
+                               size_splits=sizes, axis=axis)
+
+
+@tf_rule("StridedSlice")
+def _strided_slice(ctx):
+    return ctx.importer.sd._op(
+        "strided_slice", ctx.var(0), name=ctx.name,
+        begin=[int(v) for v in ctx.const_value(1).reshape(-1)],
+        end=[int(v) for v in ctx.const_value(2).reshape(-1)],
+        strides=[int(v) for v in ctx.const_value(3).reshape(-1)],
+        begin_mask=int(ctx.attr["begin_mask"].i) if "begin_mask" in ctx.attr else 0,
+        end_mask=int(ctx.attr["end_mask"].i) if "end_mask" in ctx.attr else 0,
+        shrink_axis_mask=int(ctx.attr["shrink_axis_mask"].i) if "shrink_axis_mask" in ctx.attr else 0,
+        new_axis_mask=int(ctx.attr["new_axis_mask"].i) if "new_axis_mask" in ctx.attr else 0,
+        ellipsis_mask=int(ctx.attr["ellipsis_mask"].i) if "ellipsis_mask" in ctx.attr else 0,
+    )
+
+
+@tf_rule("Slice")
+def _slice(ctx):
+    return ctx.importer.sd._op(
+        "slice", ctx.var(0), name=ctx.name,
+        begin=[int(v) for v in ctx.const_value(1).reshape(-1)],
+        size=[int(v) for v in ctx.const_value(2).reshape(-1)],
+    )
+
+
+@tf_rule("Gather", "GatherV2")
+def _gather(ctx):
+    axis = 0
+    if ctx.op == "GatherV2" and len(ctx.inputs) > 2:
+        axis = int(ctx.const_value(2))
+    return ctx.importer.sd._op("gather", ctx.var(0), ctx.var(1), name=ctx.name, axis=axis)
+
+
+@tf_rule("GatherNd")
+def _gather_nd(ctx):
+    return ctx.importer.sd._op("gather_nd", ctx.var(0), ctx.var(1), name=ctx.name)
+
+
+@tf_rule("OneHot")
+def _one_hot(ctx):
+    return ctx.importer.sd._op(
+        "one_hot", ctx.var(0), name=ctx.name,
+        depth=int(ctx.const_value(1)),
+        on_value=float(ctx.const_value(2)),
+        off_value=float(ctx.const_value(3)),
+        axis=int(ctx.attr["axis"].i) if "axis" in ctx.attr else -1,
+    )
+
+
+@tf_rule("Cast")
+def _cast(ctx):
+    return ctx.importer.sd._op("cast", ctx.var(0), name=ctx.name,
+                               dtype=np.dtype(ctx.np_dtype("DstT")).name)
+
+
+@tf_rule("Shape")
+def _shape(ctx):
+    return ctx.importer.sd._op("shape_of", ctx.var(0), name=ctx.name)
+
+
+@tf_rule("Rank")
+def _rank(ctx):
+    return ctx.importer.sd._op("rank", ctx.var(0), name=ctx.name)
+
+
+@tf_rule("Size")
+def _size(ctx):
+    return ctx.importer.sd._op("size", ctx.var(0), name=ctx.name)
+
+
+def _reduction(sd_name: str):
+    def rule(ctx: _NodeCtx):
+        axis = [int(v) for v in np.atleast_1d(ctx.const_value(1))]
+        keep = bool(ctx.attr["keep_dims"].b) if "keep_dims" in ctx.attr else False
+        return ctx.importer.sd._op(sd_name, ctx.var(0), name=ctx.name,
+                                   axis=axis, keepdims=keep)
+
+    return rule
+
+
+TF_OP_RULES["Sum"] = _reduction("reduce_sum")
+TF_OP_RULES["Mean"] = _reduction("reduce_mean")
+TF_OP_RULES["Max"] = _reduction("reduce_max")
+TF_OP_RULES["Min"] = _reduction("reduce_min")
+TF_OP_RULES["Prod"] = _reduction("reduce_prod")
+TF_OP_RULES["Any"] = _reduction("reduce_any")
+TF_OP_RULES["All"] = _reduction("reduce_all")
+
+
+@tf_rule("ArgMax")
+def _argmax(ctx):
+    return ctx.importer.sd._op("argmax", ctx.var(0), name=ctx.name,
+                               axis=int(ctx.const_value(1)))
+
+
+@tf_rule("ArgMin")
+def _argmin(ctx):
+    return ctx.importer.sd._op("argmin", ctx.var(0), name=ctx.name,
+                               axis=int(ctx.const_value(1)))
+
+
+@tf_rule("Tile")
+def _tile(ctx):
+    return ctx.importer.sd._op("tile", ctx.var(0), name=ctx.name,
+                               reps=[int(v) for v in ctx.const_value(1).reshape(-1)])
+
+
+@tf_rule("Fill")
+def _fill(ctx):
+    return ctx.importer.sd._op(
+        "fill", name=ctx.name,
+        shape=[int(v) for v in ctx.const_value(0).reshape(-1)],
+        value=float(ctx.const_value(1)),
+    )
+
+
+@tf_rule("Range")
+def _range(ctx):
+    return ctx.importer.sd._op(
+        "range", name=ctx.name,
+        start=int(ctx.const_value(0)), limit=int(ctx.const_value(1)),
+        delta=int(ctx.const_value(2)),
+    )
+
+
+@tf_rule("Select", "SelectV2")
+def _select(ctx):
+    return ctx.importer.sd._op("select", ctx.var(0), ctx.var(1), ctx.var(2), name=ctx.name)
+
+
+@tf_rule("Pad", "PadV2")
+def _pad(ctx):
+    pads = [(int(a), int(b)) for a, b in ctx.const_value(1)]
+    val = float(ctx.const_value(2)) if ctx.op == "PadV2" else 0.0
+    return ctx.importer.sd._op("pad", ctx.var(0), name=ctx.name,
+                               paddings=pads, constant_value=val)
+
+
+@tf_rule("MirrorPad")
+def _mirror_pad(ctx):
+    pads = [(int(a), int(b)) for a, b in ctx.const_value(1)]
+    mode = ctx.attr["mode"].s.decode() if "mode" in ctx.attr else "REFLECT"
+    return ctx.importer.sd._op("pad", ctx.var(0), name=ctx.name, paddings=pads, mode=mode)
+
+
+@tf_rule("L2Loss")
+def _l2loss(ctx):
+    sd = ctx.importer.sd
+    sq = sd._op("square", ctx.var(0))
+    s = sd._op("reduce_sum", sq)
+    return sd._op("mul", s, sd.constant(np.float32(0.5)), name=ctx.name)
+
+
+@tf_rule("Cumsum")
+def _cumsum(ctx):
+    return ctx.importer.sd._op(
+        "cumsum", ctx.var(0), name=ctx.name, axis=int(ctx.const_value(1)),
+        exclusive=bool(ctx.attr["exclusive"].b) if "exclusive" in ctx.attr else False,
+        reverse=bool(ctx.attr["reverse"].b) if "reverse" in ctx.attr else False,
+    )
+
+
+@tf_rule("Einsum")
+def _einsum(ctx):
+    eq = ctx.attr["equation"].s.decode()
+    return ctx.importer.sd._op("einsum", *(ctx.var(i) for i in range(len(ctx.inputs))),
+                               name=ctx.name, equation=eq)
+
+
+@tf_rule("Conv2D")
+def _conv2d(ctx):
+    strides = list(ctx.attr["strides"].list.i)
+    df = ctx.attr["data_format"].s.decode() if "data_format" in ctx.attr else "NHWC"
+    if df == "NHWC":
+        s = (strides[1], strides[2])
+    else:
+        s = (strides[2], strides[3])
+    dil = (1, 1)
+    if "dilations" in ctx.attr:
+        d = list(ctx.attr["dilations"].list.i)
+        dil = (d[1], d[2]) if df == "NHWC" else (d[2], d[3])
+    pad = ctx.attr["padding"].s.decode()
+    return ctx.importer.sd._op("conv2d", ctx.var(0), ctx.var(1), name=ctx.name,
+                               strides=s, padding=pad, data_format=df, dilations=dil)
+
+
+@tf_rule("MaxPool", "AvgPool")
+def _pool(ctx):
+    k = list(ctx.attr["ksize"].list.i)
+    strides = list(ctx.attr["strides"].list.i)
+    df = ctx.attr["data_format"].s.decode() if "data_format" in ctx.attr else "NHWC"
+    if df == "NHWC":
+        kernel, s = (k[1], k[2]), (strides[1], strides[2])
+    else:
+        kernel, s = (k[2], k[3]), (strides[2], strides[3])
+    op = "max_pool2d" if ctx.op == "MaxPool" else "avg_pool2d"
+    return ctx.importer.sd._op(op, ctx.var(0), name=ctx.name, kernel=kernel,
+                               strides=s, padding=ctx.attr["padding"].s.decode(),
+                               data_format=df)
+
+
+@tf_rule("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3")
+def _fused_bn(ctx):
+    eps = float(ctx.attr["epsilon"].f) if "epsilon" in ctx.attr else 1e-3
+    df = ctx.attr["data_format"].s.decode() if "data_format" in ctx.attr else "NHWC"
+    axis = 3 if df == "NHWC" else 1
+    # inputs: x, scale, offset, mean, variance (inference mode)
+    return ctx.importer.sd._op(
+        "batch_norm", ctx.var(0), ctx.var(3), ctx.var(4),
+        ctx.var(1), ctx.var(2), name=ctx.name, eps=eps, axis=axis,
+    )
+
+
+class TFGraphMapper:
+    """Reference spelling: TFGraphMapper.importGraph(graphDef)."""
+
+    def __init__(self) -> None:
+        self.sd = SameDiff.create()
+        self.const_values: Dict[str, np.ndarray] = {}
+        self._produced: Dict[str, SDVariable] = {}
+        self._multi_outputs: Dict[str, Dict[int, SDVariable]] = {}
+
+    # ---- public entry points ----------------------------------------------
+    @staticmethod
+    def import_graph(graph_def_or_path, outputs: Optional[Sequence[str]] = None) -> SameDiff:
+        return TFGraphMapper().run(graph_def_or_path, outputs)
+
+    importGraph = import_graph
+
+    def run(self, graph_def_or_path, outputs: Optional[Sequence[str]] = None) -> SameDiff:
+        tf = _tf()
+        if isinstance(graph_def_or_path, (str, bytes)):
+            gd = tf.compat.v1.GraphDef()
+            with open(graph_def_or_path, "rb") as f:
+                gd.ParseFromString(f.read())
+        else:
+            gd = graph_def_or_path
+
+        from tensorflow.python.framework import tensor_util
+
+        needed = None
+        if outputs:
+            needed = self._dependency_closure(gd, outputs)
+
+        for node in gd.node:
+            if needed is not None and node.name not in needed:
+                continue
+            self._import_node(node, tensor_util)
+        return self.sd
+
+    # ---- internals --------------------------------------------------------
+    @staticmethod
+    def _canon(inp: str) -> str:
+        inp = inp.lstrip("^")
+        return inp
+
+    def _dependency_closure(self, gd, outputs: Sequence[str]) -> set:
+        by_name = {n.name: n for n in gd.node}
+        seen: set = set()
+        stack = [o.split(":")[0] for o in outputs]
+        while stack:
+            name = stack.pop()
+            if name in seen or name not in by_name:
+                continue
+            seen.add(name)
+            for i in by_name[name].input:
+                if i.startswith("^"):
+                    continue  # control deps are ordering-only; execution is functional
+                stack.append(self._canon(i).split(":")[0])
+        return seen
+
+    def resolve(self, ref: str) -> SDVariable:
+        ref = self._canon(ref)
+        if ":" in ref:
+            base, idx = ref.rsplit(":", 1)
+            idx = int(idx)
+        else:
+            base, idx = ref, 0
+        if idx > 0:
+            multi = self._multi_outputs.get(base)
+            if multi is None or idx not in multi:
+                src = self._produced[base]
+                out = self.sd._op("getitem", src, item=idx)
+                self._multi_outputs.setdefault(base, {})[idx] = out
+                return out
+            return multi[idx]
+        return self._produced[base]
+
+    def _import_node(self, node, tensor_util) -> None:
+        name = node.name
+        op = node.op
+        if op == "NoOp":
+            return
+        if op == "Const":
+            value = tensor_util.MakeNdarray(node.attr["value"].tensor)
+            self.const_values[name] = value
+            if value.dtype == object:
+                return  # string consts (asset paths) are not tensors we carry
+            self._produced[name] = self.sd.constant(value, name=name)
+            return
+        if op in ("Placeholder", "PlaceholderWithDefault"):
+            tf = _tf()
+            dtype = tf.dtypes.as_dtype(node.attr["dtype"].type).as_numpy_dtype
+            shape = None
+            if "shape" in node.attr:
+                dims = node.attr["shape"].shape.dim
+                shape = tuple(d.size if d.size >= 0 else None for d in dims)
+            self._produced[name] = self.sd.placeholder(
+                name, shape=shape, dtype=np.dtype(dtype).name
+            )
+            return
+        if op in ("VariableV2", "VarHandleOp", "ReadVariableOp", "Variable"):
+            raise ValueError(
+                f"Node {name!r} is an unfrozen variable ({op}); freeze the graph "
+                "first (convert_variables_to_constants_v2)"
+            )
+        rule = TF_OP_RULES.get(op)
+        if rule is None:
+            raise NotImplementedError(
+                f"TF op {op!r} (node {name!r}) has no import rule; "
+                f"{len(TF_OP_RULES)} ops are mapped"
+            )
+        data_inputs = [self._canon(i) for i in node.input if not i.startswith("^")]
+        ctx = _NodeCtx(name=name, op=op, inputs=data_inputs, attr=dict(node.attr),
+                       importer=self)
+        result = rule(ctx)
+        self._produced[name] = result

@@ -1,0 +1,73 @@
+"""BERT TF-import golden test.
+
+The reference's flagship import scenario (BASELINE.json:10: "BERT-base via
+SameDiff TF import, full-graph HLO compile"). No network: a random-initialized
+TFBertModel (transformers) is frozen in-process and imported; outputs compared
+against TF execution. A small config keeps CI fast; bench.py measures the
+full-size variant on TPU.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+pytest.importorskip("transformers")
+
+
+def make_frozen_bert(batch=2, seq=16, hidden=64, layers=2, heads=2, vocab=500):
+    from transformers import BertConfig, TFBertModel
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    cfg = BertConfig(
+        vocab_size=vocab, hidden_size=hidden, num_hidden_layers=layers,
+        num_attention_heads=heads, intermediate_size=hidden * 4,
+        max_position_embeddings=64,
+    )
+    model = TFBertModel(cfg)
+
+    @tf.function
+    def fwd(input_ids):
+        return model(input_ids, training=False).last_hidden_state
+
+    cf = fwd.get_concrete_function(tf.TensorSpec((batch, seq), tf.int32))
+    frozen = convert_variables_to_constants_v2(cf)
+    return frozen
+
+
+class TestBertImport:
+    def test_bert_import_matches_tf(self):
+        from deeplearning4j_tpu.samediff.tf_import import TFGraphMapper
+
+        frozen = make_frozen_bert()
+        gd = frozen.graph.as_graph_def()
+        ids = np.random.default_rng(0).integers(0, 500, size=(2, 16)).astype(np.int32)
+        tf_out = frozen(tf.constant(ids))
+        if isinstance(tf_out, (list, tuple)):
+            tf_out = tf_out[0]
+        tf_out = tf_out.numpy()
+
+        in_name = frozen.inputs[0].name.split(":")[0]
+        out_name = frozen.outputs[0].name.split(":")[0]
+        sd = TFGraphMapper.import_graph(gd, outputs=[out_name])
+        ours = np.asarray(sd.output({in_name: ids}, [out_name])[out_name])
+        assert ours.shape == tf_out.shape
+        np.testing.assert_allclose(ours, tf_out, rtol=1e-4, atol=1e-4)
+
+    def test_bert_full_graph_jit_compiles(self):
+        """The north-star property: the imported graph compiles to ONE XLA
+        program (full-graph HLO compile)."""
+        import jax
+
+        from deeplearning4j_tpu.samediff.tf_import import TFGraphMapper
+
+        frozen = make_frozen_bert()
+        gd = frozen.graph.as_graph_def()
+        out_name = frozen.outputs[0].name.split(":")[0]
+        in_name = frozen.inputs[0].name.split(":")[0]
+        sd = TFGraphMapper.import_graph(gd, outputs=[out_name])
+        ids = np.random.default_rng(1).integers(0, 500, size=(2, 16)).astype(np.int32)
+        compiled = sd.compile({in_name: ids}, [out_name])
+        out = compiled(dict(sd._values), {in_name: ids})
+        assert np.asarray(out[out_name]).shape == (2, 16, 64)

@@ -1,0 +1,168 @@
+"""SameDiff graph engine tests: build, execute, autodiff, train, save/load,
+AOT compile."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.samediff import SameDiff, TrainingConfig
+from deeplearning4j_tpu.train.updaters import Adam
+
+
+class TestBasic:
+    def test_arith_and_eval(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (None, 3))
+        w = sd.var("w", np.ones((3, 2), np.float32))
+        b = sd.var("b", np.zeros((2,), np.float32))
+        y = (x @ w + b).rename("y")
+        out = y.eval({"x": np.ones((4, 3), np.float32)})
+        np.testing.assert_allclose(out, 3.0)
+
+    def test_namespaced_ops(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (2, 4))
+        sm = sd.nn.softmax(x).rename("sm")
+        out = sm.eval({"x": np.zeros((2, 4), np.float32)})
+        np.testing.assert_allclose(out, 0.25)
+
+    def test_reductions_and_chaining(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (2, 3))
+        total = x.mul(2.0).sum().rename("total")
+        assert total.eval({"x": np.ones((2, 3), np.float32)}) == pytest.approx(12.0)
+
+    def test_multi_output_reuse(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (2, 2))
+        a = (x + 1.0).rename("a")
+        b = (a * a).rename("b")
+        res = sd.output({"x": np.zeros((2, 2), np.float32)}, ["a", "b"])
+        np.testing.assert_allclose(np.asarray(res["a"]), 1.0)
+        np.testing.assert_allclose(np.asarray(res["b"]), 1.0)
+
+
+class TestGradients:
+    def test_simple_grad(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (3,))
+        w = sd.var("w", np.array([1.0, 2.0, 3.0], np.float32))
+        loss = (x * w).sum().rename("loss")
+        sd.set_loss_variables("loss")
+        g = sd.calculate_gradients({"x": np.array([1.0, 1.0, 2.0], np.float32)}, ["w"])
+        np.testing.assert_allclose(np.asarray(g["w"]), [1.0, 1.0, 2.0])
+
+    def test_matmul_grad_matches_numeric(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (4, 3))
+        w = sd.var("w", np.random.default_rng(0).normal(size=(3, 2)).astype(np.float64))
+        loss = sd.nn.softmax(x @ w).sum().rename("loss")
+        sd.set_loss_variables("loss")
+        feeds = {"x": np.random.default_rng(1).normal(size=(4, 3))}
+        g = np.asarray(sd.calculate_gradients(feeds, ["w"])["w"])
+        # numeric check
+        w0 = np.asarray(sd._values[sd._names["w"]]).copy()
+        eps = 1e-6
+        num = np.zeros_like(w0)
+        for i in range(w0.shape[0]):
+            for j in range(w0.shape[1]):
+                for sgn in (1, -1):
+                    w0[i, j] += sgn * eps
+                    sd._values[sd._names["w"]] = w0.copy()
+                    val = float(sd.output(feeds, ["loss"])["loss"].sum())
+                    num[i, j] += sgn * val / (2 * eps)
+                    w0[i, j] -= sgn * eps
+        sd._values[sd._names["w"]] = w0
+        np.testing.assert_allclose(g, num, rtol=1e-4, atol=1e-6)
+
+
+class TestTraining:
+    def test_linear_regression_converges(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(128, 3)).astype(np.float32)
+        true_w = np.array([[1.5], [-2.0], [0.5]], np.float32)
+        Y = X @ true_w + 0.01 * rng.normal(size=(128, 1)).astype(np.float32)
+
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (None, 3))
+        label = sd.placeholder("label", (None, 1))
+        w = sd.var("w", np.zeros((3, 1), np.float32))
+        b = sd.var("b", np.zeros((1,), np.float32))
+        pred = (x @ w + b).rename("pred")
+        loss = sd.loss.mean_squared_error(label, pred).rename("loss")
+        sd.set_loss_variables("loss")
+
+        cfg = TrainingConfig(
+            updater=Adam(1e-1),
+            data_set_feature_mapping=("x",),
+            data_set_label_mapping=("label",),
+        )
+        it = ListDataSetIterator(DataSet(X, Y), batch=32)
+        hist = sd.fit(it, cfg, epochs=50)
+        assert hist.loss_curve[-1] < 0.01
+        np.testing.assert_allclose(
+            np.asarray(sd._values[sd._names["w"]]), true_w, atol=0.1
+        )
+
+
+class TestSerde:
+    def test_save_load_round_trip(self, tmp_path):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (None, 3))
+        w = sd.var("w", np.random.default_rng(2).normal(size=(3, 2)).astype(np.float32))
+        y = sd.nn.softmax(x @ w).rename("y")
+        feeds = {"x": np.random.default_rng(3).normal(size=(5, 3)).astype(np.float32)}
+        before = y.eval(feeds)
+
+        path = str(tmp_path / "graph.sdz")
+        sd.save(path)
+        sd2 = SameDiff.load(path)
+        after = sd2.get_variable("y").eval(feeds)
+        np.testing.assert_allclose(before, after, rtol=1e-6)
+
+    def test_aot_compile(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (4, 3))
+        w = sd.var("w", np.ones((3, 2), np.float32))
+        (x @ w).rename("y")
+        feeds = {"x": np.ones((4, 3), np.float32)}
+        compiled = sd.compile(feeds, ["y"])
+        out = compiled(dict(sd._values), feeds)
+        np.testing.assert_allclose(np.asarray(out["y"]), 3.0)
+
+
+class TestOpsCoverage:
+    def test_shape_ops(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (2, 6))
+        r = sd.math.reshape(x, shape=[2, 2, 3]).rename("r")
+        t = sd.math.transpose(r, perm=[0, 2, 1]).rename("t")
+        out = sd.output({"x": np.arange(12, dtype=np.float32).reshape(2, 6)}, ["t"])
+        assert out["t"].shape == (2, 3, 2)
+
+    def test_gather_onehot(self):
+        sd = SameDiff.create()
+        idx = sd.placeholder("idx", (3,), dtype="int32")
+        table = sd.var("table", np.arange(12, dtype=np.float32).reshape(4, 3))
+        g = sd.math.gather(table, idx, axis=0).rename("g")
+        oh = sd.math.one_hot(idx, depth=4).rename("oh")
+        out = sd.output({"idx": np.array([0, 2, 3], np.int32)}, ["g", "oh"])
+        np.testing.assert_allclose(np.asarray(out["g"])[1], [6, 7, 8])
+        assert np.asarray(out["oh"]).shape == (3, 4)
+
+    def test_strided_slice(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (4, 5))
+        s = sd.math.strided_slice(x, begin=[1, 0], end=[3, 4], strides=[1, 2]).rename("s")
+        out = s.eval({"x": np.arange(20, dtype=np.float32).reshape(4, 5)})
+        assert out.shape == (2, 2)
+        np.testing.assert_allclose(out, [[5, 7], [10, 12]])
+
+    def test_layer_norm_and_erf(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (2, 8))
+        ln = sd.nn.layer_norm(x).rename("ln")
+        e = sd.math.erf(x).rename("e")
+        out = sd.output({"x": np.random.default_rng(4).normal(size=(2, 8)).astype(np.float32)}, ["ln", "e"])
+        assert abs(float(np.asarray(out["ln"]).mean())) < 1e-5

@@ -1,0 +1,156 @@
+"""TF GraphDef import golden tests.
+
+Mirrors the reference's TFGraphTestAllSameDiff (SURVEY.md §4): build a TF
+graph, freeze it, import to SameDiff, execute both, compare within tolerance.
+No network: graphs are built in-process with random weights.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.samediff.tf_import import TFGraphMapper
+
+
+def freeze(fn, *specs):
+    """concrete function -> frozen GraphDef + input/output names."""
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    cf = tf.function(fn).get_concrete_function(*specs)
+    frozen = convert_variables_to_constants_v2(cf)
+    gd = frozen.graph.as_graph_def()
+    in_names = [t.name.split(":")[0] for t in frozen.inputs]
+    out_names = [t.name for t in frozen.outputs]
+    return gd, in_names, out_names, frozen
+
+
+def import_and_compare(fn, feeds_np, rtol=1e-5, atol=1e-6):
+    specs = [tf.TensorSpec(v.shape, tf.as_dtype(v.dtype)) for v in feeds_np.values()]
+    gd, in_names, out_names, frozen = freeze(fn, *specs)
+    tf_out = frozen(**{
+        t.name.split(":")[0]: tf.constant(v)
+        for t, v in zip(frozen.inputs, feeds_np.values())
+    })
+    if isinstance(tf_out, (list, tuple)):
+        tf_out = tf_out[0]
+    sd = TFGraphMapper.import_graph(gd, outputs=out_names)
+    sd_feeds = dict(zip(in_names, feeds_np.values()))
+    target = out_names[0].split(":")[0]
+    ours = np.asarray(sd.output(sd_feeds, [target])[target])
+    np.testing.assert_allclose(ours, tf_out.numpy(), rtol=rtol, atol=atol)
+    return sd
+
+
+rng = np.random.default_rng(0)
+
+
+class TestBasicGraphs:
+    def test_mlp(self):
+        w1 = tf.constant(rng.normal(size=(8, 16)).astype(np.float32))
+        b1 = tf.constant(rng.normal(size=(16,)).astype(np.float32))
+        w2 = tf.constant(rng.normal(size=(16, 4)).astype(np.float32))
+
+        def mlp(x):
+            h = tf.nn.relu(tf.matmul(x, w1) + b1)
+            return tf.nn.softmax(tf.matmul(h, w2))
+
+        import_and_compare(mlp, {"x": rng.normal(size=(5, 8)).astype(np.float32)})
+
+    def test_reductions_and_shapes(self):
+        def fn(x):
+            y = tf.reshape(x, [2, 3, 4])
+            y = tf.transpose(y, [0, 2, 1])
+            y = tf.reduce_mean(y, axis=2, keepdims=True)
+            return tf.squeeze(y, axis=2)
+
+        import_and_compare(fn, {"x": rng.normal(size=(2, 12)).astype(np.float32)})
+
+    def test_strided_slice_and_concat(self):
+        def fn(x):
+            a = x[:, 1:3]
+            b = x[:, :2]
+            return tf.concat([a, b], axis=1)
+
+        import_and_compare(fn, {"x": rng.normal(size=(4, 6)).astype(np.float32)})
+
+    def test_gather_embedding(self):
+        table = tf.constant(rng.normal(size=(30, 8)).astype(np.float32))
+
+        def fn(ids):
+            return tf.gather(table, ids)
+
+        import_and_compare(fn, {"ids": rng.integers(0, 30, size=(4, 7)).astype(np.int32)})
+
+    def test_layernorm_decomposition(self):
+        gamma = tf.constant(rng.normal(size=(16,)).astype(np.float32))
+        beta = tf.constant(rng.normal(size=(16,)).astype(np.float32))
+
+        def fn(x):
+            mean = tf.reduce_mean(x, axis=-1, keepdims=True)
+            var = tf.reduce_mean(tf.math.squared_difference(x, mean), axis=-1, keepdims=True)
+            return (x - mean) * tf.math.rsqrt(var + 1e-6) * gamma + beta
+
+        import_and_compare(fn, {"x": rng.normal(size=(3, 16)).astype(np.float32)},
+                           rtol=1e-4, atol=1e-5)
+
+    def test_gelu_erf_decomposition(self):
+        def fn(x):
+            return 0.5 * x * (1.0 + tf.math.erf(x / tf.sqrt(2.0)))
+
+        import_and_compare(fn, {"x": rng.normal(size=(4, 8)).astype(np.float32)})
+
+    def test_conv2d_maxpool(self):
+        w = tf.constant(rng.normal(size=(3, 3, 2, 4)).astype(np.float32) * 0.1)
+
+        def fn(x):
+            y = tf.nn.conv2d(x, w, strides=1, padding="SAME")
+            y = tf.nn.relu(y)
+            return tf.nn.max_pool2d(y, 2, 2, padding="VALID")
+
+        import_and_compare(fn, {"x": rng.normal(size=(2, 8, 8, 2)).astype(np.float32)},
+                           rtol=1e-4, atol=1e-5)
+
+    def test_onehot_and_cast(self):
+        def fn(ids):
+            oh = tf.one_hot(ids, depth=5)
+            return tf.cast(oh, tf.float32) * 2.0
+
+        import_and_compare(fn, {"ids": rng.integers(0, 5, size=(6,)).astype(np.int32)})
+
+    def test_einsum(self):
+        def fn(x):
+            w = tf.reshape(tf.range(24, dtype=tf.float32), (4, 6))
+            return tf.einsum("bi,ij->bj", x, w)
+
+        import_and_compare(fn, {"x": rng.normal(size=(3, 4)).astype(np.float32)},
+                           rtol=1e-4, atol=1e-4)
+
+
+class TestAttentionGraph:
+    def test_mini_self_attention(self):
+        """Transformer attention block — the core BERT computation."""
+        d, h = 16, 4
+        wq = tf.constant(rng.normal(size=(d, d)).astype(np.float32) * 0.1)
+        wk = tf.constant(rng.normal(size=(d, d)).astype(np.float32) * 0.1)
+        wv = tf.constant(rng.normal(size=(d, d)).astype(np.float32) * 0.1)
+        wo = tf.constant(rng.normal(size=(d, d)).astype(np.float32) * 0.1)
+
+        def attn(x):
+            b, t = 2, 6
+            q = tf.reshape(tf.matmul(tf.reshape(x, [-1, d]), wq), [b, t, h, d // h])
+            k = tf.reshape(tf.matmul(tf.reshape(x, [-1, d]), wk), [b, t, h, d // h])
+            v = tf.reshape(tf.matmul(tf.reshape(x, [-1, d]), wv), [b, t, h, d // h])
+            q = tf.transpose(q, [0, 2, 1, 3])
+            k = tf.transpose(k, [0, 2, 1, 3])
+            v = tf.transpose(v, [0, 2, 1, 3])
+            scores = tf.matmul(q, k, transpose_b=True) / tf.sqrt(tf.cast(d // h, tf.float32))
+            w = tf.nn.softmax(scores, axis=-1)
+            o = tf.transpose(tf.matmul(w, v), [0, 2, 1, 3])
+            o = tf.reshape(o, [b, t, d])
+            return tf.matmul(tf.reshape(o, [-1, d]), wo)
+
+        import_and_compare(attn, {"x": rng.normal(size=(2, 6, 16)).astype(np.float32)},
+                           rtol=1e-4, atol=1e-5)
