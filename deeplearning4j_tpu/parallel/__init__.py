@@ -1,0 +1,44 @@
+"""Distributed training & inference — the TPU-native replacement for the
+reference's scale-out tier.
+
+Reference capabilities covered (SURVEY.md §2.3/§2.4, §3.4/§3.5):
+
+* ``ParallelWrapper`` (single-node multi-device data parallelism) and
+  ``SharedTrainingMaster`` (multi-node gradient sharing over Aeron UDP)
+  → :class:`DistributedTrainer`: one jitted SPMD train step over a
+  ``jax.sharding.Mesh``; gradient sync is a compiler-emitted collective over
+  ICI instead of a hand-rolled transport.
+* ``EncodedGradientsAccumulator`` / threshold compression (Strom 2015)
+  → :class:`ThresholdCompressedSync` strategy (residual error feedback +
+  adaptive threshold), kept as an explicit, optional strategy for
+  DCN-bandwidth experiments; the default is synchronous all-reduce.
+* ``ParameterAveragingTrainingMaster`` → :class:`ParameterAveragingSync`
+  strategy (N local steps, then mean of params across the data axis).
+* ``ParallelInference`` → :class:`ParallelInference` (dynamic batching over a
+  jitted forward).
+* Aeron/Spark control plane → ``jax.distributed`` (coordination service),
+  see :func:`initialize_distributed`.
+"""
+
+from .mesh import MeshSpec, initialize_distributed, make_mesh
+from .strategies import (
+    GradientSyncStrategy,
+    ParameterAveragingSync,
+    SyncAllReduce,
+    ThresholdCompressedSync,
+)
+from .trainer import DistributedTrainer
+from .inference import InferenceMode, ParallelInference
+
+__all__ = [
+    "DistributedTrainer",
+    "GradientSyncStrategy",
+    "InferenceMode",
+    "MeshSpec",
+    "ParallelInference",
+    "ParameterAveragingSync",
+    "SyncAllReduce",
+    "ThresholdCompressedSync",
+    "initialize_distributed",
+    "make_mesh",
+]

@@ -1,0 +1,135 @@
+"""ParallelInference — dynamically batched serving over a jitted forward.
+
+Reference (SURVEY.md §3.5): ``ParallelInference`` keeps a pool of model
+replicas, worker threads with device affinity, and a batching observable
+that concatenates up to ``batchLimit`` pending requests before each
+forward. On TPU the replica pool is unnecessary — one compiled forward
+serves all threads — so the valuable part is the dynamic batcher:
+requests queue up, a worker drains up to ``batch_limit`` of them,
+pads to a bucketed batch size (stable shapes → no recompiles), runs one
+forward, and scatters results back to the callers' futures.
+"""
+
+from __future__ import annotations
+
+import enum
+import queue
+import threading
+from concurrent.futures import Future
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class InferenceMode(enum.Enum):
+    SEQUENTIAL = "sequential"  # one request per forward
+    BATCHED = "batched"        # concatenate pending requests
+
+
+def _bucket(n: int, limit: int) -> int:
+    b = 1
+    while b < n and b < limit:
+        b <<= 1
+    return min(b, limit)
+
+
+class ParallelInference:
+    def __init__(
+        self,
+        model,
+        *,
+        inference_mode: InferenceMode = InferenceMode.BATCHED,
+        batch_limit: int = 32,
+        workers: int = 2,
+        queue_limit: int = 256,
+    ) -> None:
+        self.model = model
+        self.mode = inference_mode
+        self.batch_limit = int(batch_limit)
+        self._queue: "queue.Queue[Optional[Tuple[np.ndarray, Future]]]" = queue.Queue(queue_limit)
+        self._lock = threading.Lock()
+
+        params, state = model.params, model.state
+
+        def fwd(x):
+            out, _, _ = model.forward_pure(params, state, x, train=False, rng=None)
+            return out
+
+        self._fwd = jax.jit(fwd)
+        self._threads: List[threading.Thread] = []
+        self._shutdown = False
+        for i in range(max(1, workers)):
+            t = threading.Thread(target=self._worker, name=f"pi-worker-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    # ----- client side ------------------------------------------------
+    def output(self, x) -> np.ndarray:
+        """Blocking single-request inference (reference API shape)."""
+        return self.output_async(x).result()
+
+    def output_async(self, x) -> Future:
+        if self._shutdown:
+            raise RuntimeError("ParallelInference is shut down")
+        fut: Future = Future()
+        self._queue.put((np.asarray(x), fut))
+        return fut
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        for _ in self._threads:
+            self._queue.put(None)
+        for t in self._threads:
+            t.join(timeout=5)
+
+    # ----- worker side ------------------------------------------------
+    def _drain(self, first) -> List[Tuple[np.ndarray, Future]]:
+        items = [first]
+        if self.mode is InferenceMode.BATCHED:
+            budget = self.batch_limit - first[0].shape[0] if first[0].ndim > 1 else self.batch_limit - 1
+            while budget > 0:
+                try:
+                    nxt = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._queue.put(None)
+                    break
+                items.append(nxt)
+                budget -= nxt[0].shape[0] if nxt[0].ndim > 1 else 1
+        return items
+
+    def _worker(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            batch = self._drain(item)
+            try:
+                arrays = []
+                sizes = []
+                for x, _ in batch:
+                    a = x if x.ndim > 1 else x[None, ...]
+                    arrays.append(a)
+                    sizes.append(a.shape[0])
+                cat = np.concatenate(arrays, axis=0)
+                n = cat.shape[0]
+                padded_n = _bucket(n, max(self.batch_limit, n))
+                if padded_n > n:
+                    pad = np.repeat(cat[-1:], padded_n - n, axis=0)
+                    cat = np.concatenate([cat, pad], axis=0)
+                out = np.asarray(self._fwd(jnp.asarray(cat, self.model.dtype)))[:n]
+                off = 0
+                for (x, fut), sz in zip(batch, sizes):
+                    res = out[off : off + sz]
+                    if x.ndim == out.ndim - 1 and sz == 1:
+                        res = res[0]
+                    fut.set_result(res)
+                    off += sz
+            except Exception as e:  # propagate to all waiting callers
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)

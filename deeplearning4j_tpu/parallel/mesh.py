@@ -1,0 +1,146 @@
+"""Device-mesh construction and multi-host bring-up.
+
+Reference: the Aeron ``MeshOrganizer`` built a bounded-degree tree of UDP
+peers and Spark supplied the control plane (SURVEY.md §2.4). On TPU both
+jobs are already solved: the mesh is ``jax.sharding.Mesh`` over the ICI
+torus, and the control plane is the JAX coordination service
+(``jax.distributed.initialize``). This module is the thin, explicit entry
+point for both, so user code never touches raw device lists.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Named mesh axes and sizes, e.g. ``MeshSpec(data=4, model=2)``.
+
+    Axis vocabulary (used by DistributedTrainer sharding rules):
+      * ``data``  — batch (data parallel; DP)
+      * ``model`` — hidden/feature (tensor parallel; TP)
+      * ``seq``   — sequence/context (ring attention; SP/CP)
+    A size of -1 means "all remaining devices".
+    """
+
+    axes: Tuple[Tuple[str, int], ...]
+
+    def __init__(self, axes: Optional[Dict[str, int]] = None, **kw: int) -> None:
+        merged = dict(axes or {})
+        merged.update(kw)
+        if not merged:
+            merged = {"data": -1}
+        object.__setattr__(self, "axes", tuple(merged.items()))
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = dict(self.axes)
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = int(np.prod([v for v in sizes.values() if v != -1])) if sizes else 1
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(f"{n_devices} devices not divisible by {fixed}")
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(f"mesh {sizes} wants {fixed} devices, have {n_devices}")
+        return sizes
+
+
+def make_mesh(
+    spec: Optional[MeshSpec] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    **axes: int,
+) -> Mesh:
+    """Build a ``Mesh``. ``make_mesh(data=4, model=2)`` or ``make_mesh()``
+    for all-devices data parallel.
+
+    On real TPU slices ``jax.make_mesh`` picks an ICI-friendly device order
+    (collectives ride neighbor links, not hops); we delegate to it whenever
+    we're using the full default device set.
+    """
+    spec = spec or MeshSpec(axes or None)
+    devs = list(devices) if devices is not None else jax.devices()
+    sizes = spec.resolve(len(devs))
+    names = tuple(sizes)
+    shape = tuple(sizes[n] for n in names)
+    if devices is None:
+        try:
+            # Auto axis types: shardings propagate GSPMD-style and XLA
+            # derives the collectives (jax 0.9's make_mesh defaults to
+            # Explicit, which demands out_sharding annotations everywhere).
+            auto = (jax.sharding.AxisType.Auto,) * len(names)
+            return jax.make_mesh(shape, names, axis_types=auto)
+        except Exception:  # older jaxlib or restricted device sets
+            pass
+    arr = np.array(devs).reshape(shape)
+    return Mesh(arr, axis_names=names)
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bring-up (reference: Aeron media driver + MeshOrganizer
+    handshake, SURVEY.md §3.4 — here it is one call into the JAX
+    coordination service; on Cloud TPU the arguments are auto-detected).
+
+    Safe to call when already initialized (no-op) or single-process
+    (when no coordinator can be inferred).
+    """
+    if getattr(jax.distributed, "is_initialized", lambda: False)():
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except Exception:
+        # Single-process / no cluster env: run standalone, like the
+        # reference running ParallelWrapper without Spark.
+        if num_processes not in (None, 1):
+            raise
+
+
+def local_batch_slice(global_batch: int, mesh: Mesh, axis: str = "data") -> slice:
+    """The slice of a global batch this process owns (multi-host input
+    pipelines feed per-host shards; reference: Spark partitioned the RDD).
+
+    Requires the shard count to divide evenly across processes and the batch
+    across shards — a real constraint of SPMD input feeding, surfaced as an
+    error instead of silently overlapping/dropping rows.
+    """
+    n = mesh.shape[axis]
+    procs = jax.process_count()
+    if global_batch % n:
+        raise ValueError(f"global batch {global_batch} not divisible by {n} data shards")
+    if n % procs:
+        raise ValueError(f"{n} data shards not divisible across {procs} processes")
+    per = global_batch // n
+    shards_per_proc = n // procs
+    start = jax.process_index() * shards_per_proc * per
+    return slice(start, start + shards_per_proc * per)
+
+
+_ENV_FLAG = "DL4J_TPU_FORCE_HOST_DEVICES"
+
+
+def force_host_device_count(n: int) -> None:
+    """Testing aid: simulate ``n`` devices on CPU (must run before first JAX
+    use). Mirrors the reference's 'multi-node ≈ multi-thread + loopback'
+    test strategy (SURVEY.md §4)."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={n}"
+    ).strip()
+    os.environ[_ENV_FLAG] = str(n)

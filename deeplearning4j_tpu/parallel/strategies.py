@@ -1,0 +1,160 @@
+"""Pluggable gradient synchronization strategies.
+
+Reference semantics being covered (SURVEY.md §2.3, §3.4):
+
+* ``ParallelWrapper`` / ``SharedTrainingMaster`` sync modes — parameter
+  averaging every N iterations, or per-iteration encoded-gradient sharing
+  (Strom 2015: threshold quantization + residual error feedback + adaptive
+  threshold, `EncodedGradientsAccumulator`/`AdaptiveThresholdAlgorithm`).
+* The reference is asynchronous over UDP; on TPU the strategies here are
+  synchronous collectives inside the jitted SPMD step — a documented
+  divergence (SURVEY.md §3.4): at ICI bandwidth, async staleness and
+  compression only cost accuracy. ``ThresholdCompressedSync`` keeps the
+  compression *semantics* (what reaches other replicas is the thresholded
+  signal; the remainder feeds back as residual) for DCN-path experiments
+  and parity testing.
+
+Each strategy runs inside ``shard_map`` — ``grads`` are this replica's raw
+gradients, and ``jax.lax.p*`` collectives see the named mesh axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class GradientSyncStrategy:
+    """SPI: how per-replica gradients become the applied update."""
+
+    #: strategies that need an explicit shard_map step set this
+    explicit = True
+    #: True when replicas' params may disagree between sync points, so the
+    #: trainer must all-reduce params before exporting/serving them
+    params_diverge = False
+
+    def init_state(self, params: Any) -> Any:
+        return ()
+
+    def sync(self, grads: Any, state: Any, axis: str) -> Tuple[Any, Any]:
+        raise NotImplementedError
+
+    def sync_params(self, params: Any, iteration: jax.Array, axis: str) -> Any:
+        """Hook applied to params after the local update (used by
+        parameter averaging). Default: identity."""
+        return params
+
+
+class SyncAllReduce(GradientSyncStrategy):
+    """Default: mean of gradients across the data axis every step — the
+    compiler emits one fused all-reduce over ICI. With the implicit-pjit
+    trainer path this strategy needs no explicit collective at all (XLA
+    derives the psum from the shardings); ``explicit=False`` lets the
+    trainer use that path, which also composes with tensor parallelism."""
+
+    explicit = False
+
+    def sync(self, grads, state, axis):  # pragma: no cover - implicit path skips this
+        return jax.tree_util.tree_map(lambda g: jax.lax.pmean(g, axis), grads), state
+
+
+class ThresholdCompressedSync(GradientSyncStrategy):
+    """Strom-style threshold encoding with residual error feedback.
+
+    Per element: accumulate gradient into the residual; where ``|r| >= t``
+    emit ``sign(r) * t`` and subtract it from the residual; the emitted
+    (sparse-in-spirit) tensor is what crosses the wire — here, the psum.
+    The threshold adapts toward a target update density, mirroring
+    ``AdaptiveThresholdAlgorithm``.
+
+    Note: on TPU the "encoded" tensor stays dense inside XLA — the value of
+    this strategy is semantic parity (convergence behavior of compressed
+    sharing) and as the seam where a real DCN-path sparse codec
+    (native/threshold_codec.cpp) plugs in for multi-slice meshes.
+    """
+
+    def __init__(
+        self,
+        threshold: float = 1e-3,
+        target_density: float = 1e-3,
+        adapt_rate: float = 1.05,
+        min_threshold: float = 1e-11,
+        max_threshold: float = 1.0,
+    ) -> None:
+        self.threshold = float(threshold)
+        self.target_density = float(target_density)
+        self.adapt_rate = float(adapt_rate)
+        self.min_threshold = float(min_threshold)
+        self.max_threshold = float(max_threshold)
+
+    def init_state(self, params):
+        return {
+            "residual": jax.tree_util.tree_map(jnp.zeros_like, params),
+            "threshold": jnp.asarray(self.threshold, jnp.float32),
+        }
+
+    def sync(self, grads, state, axis):
+        t = state["threshold"]
+
+        def encode(g, r):
+            acc = g + r
+            enc = jnp.where(jnp.abs(acc) >= t, jnp.sign(acc) * t, 0.0).astype(g.dtype)
+            return enc, acc - enc
+
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_r = treedef.flatten_up_to(state["residual"])
+        encoded, new_residual = [], []
+        n_set = jnp.zeros((), jnp.float32)
+        n_total = 0
+        for g, r in zip(flat_g, flat_r):
+            e, nr = encode(g, r)
+            encoded.append(e)
+            new_residual.append(nr)
+            n_set = n_set + jnp.sum((e != 0).astype(jnp.float32))
+            n_total += e.size
+        # global density: replicas must agree on the threshold trajectory or
+        # they would quantize at inconsistent magnitudes (and the reported
+        # threshold would be device-0's only)
+        density = jax.lax.pmean(n_set / max(n_total, 1), axis)
+        new_t = jnp.where(
+            density > self.target_density, t * self.adapt_rate, t / self.adapt_rate
+        )
+        new_t = jnp.clip(new_t, self.min_threshold, self.max_threshold)
+        synced = [jax.lax.pmean(e, axis) for e in encoded]
+        new_state = {
+            "residual": jax.tree_util.tree_unflatten(treedef, new_residual),
+            "threshold": new_t,
+        }
+        return jax.tree_util.tree_unflatten(treedef, synced), new_state
+
+
+class ParameterAveragingSync(GradientSyncStrategy):
+    """``ParameterAveragingTrainingMaster`` semantics: each replica takes
+    ``frequency`` purely-local steps, then parameters are averaged across
+    the data axis (tree-reduce in Spark; one all-reduce here).
+
+    Implementation note: the averaging runs every step but is blended with
+    ``where(step % frequency == 0, mean, local)`` so the compiled program is
+    branch-free (collectives inside ``lax.cond`` would require non-uniform
+    communication schedules XLA cannot emit).
+    """
+
+    params_diverge = True
+
+    def __init__(self, frequency: int = 5) -> None:
+        if frequency < 1:
+            raise ValueError("frequency must be >= 1")
+        self.frequency = int(frequency)
+
+    def sync(self, grads, state, axis):
+        return grads, state  # local update, no gradient exchange
+
+    def sync_params(self, params, iteration, axis):
+        do_avg = (iteration % self.frequency) == 0
+
+        def blend(p):
+            return jnp.where(do_avg, jax.lax.pmean(p, axis), p)
+
+        return jax.tree_util.tree_map(blend, params)

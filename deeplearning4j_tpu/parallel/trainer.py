@@ -1,0 +1,290 @@
+"""DistributedTrainer — SPMD training over a device mesh.
+
+Replaces (SURVEY.md §2.3): ``ParallelWrapper`` (single-node multi-device DP),
+``SharedTrainingMaster``/``ModelParameterServer`` (multi-node gradient
+sharing), and ``ParameterAveragingTrainingMaster`` (periodic averaging) with
+ONE jitted step over a ``jax.sharding.Mesh``. Where the reference replicated
+the model per device and moved gradients through host-side accumulators and
+Aeron UDP (SURVEY.md §3.4), here the batch is sharded over the ``data`` axis
+and the gradient exchange is a compiler-scheduled all-reduce over ICI —
+or an explicit strategy (threshold-compressed / parameter averaging) run
+inside ``shard_map``.
+
+Tensor parallelism (absent in the reference, §2.3) comes from
+``param_sharding_rules``: regex → PartitionSpec over a ``model`` axis; XLA
+inserts the activation collectives. Multi-host: call
+``initialize_distributed()`` first and feed per-host batch shards.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.6 public API
+    from jax import shard_map as _shard_map  # type: ignore
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore
+
+from ..train.solver import LayerOptimizers, _normalize_gradients
+from .mesh import make_mesh
+from .strategies import GradientSyncStrategy, SyncAllReduce
+
+
+def _shmap(fn, mesh, in_specs, out_specs):
+    try:
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                          check_rep=False)
+    except TypeError:  # newer jax renamed/removed check_rep
+        try:
+            return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                              check_vma=False)
+        except TypeError:
+            return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+class DistributedTrainer:
+    """Data-/tensor-parallel trainer for ``MultiLayerNetwork``-style models
+    (anything exposing ``loss_pure``/``forward_pure`` + ``conf`` + params).
+
+    Parameters
+    ----------
+    model: the network (params/state live on it; fit() writes back).
+    mesh: a ``jax.sharding.Mesh``; default = all devices on a ``data`` axis.
+    strategy: gradient sync strategy (default synchronous all-reduce).
+    param_sharding_rules: ``[(regex, PartitionSpec), ...]`` matched against
+        ``"layername/paramname"`` — first hit wins; unmatched params are
+        replicated. Only valid with the default strategy (implicit-pjit
+        path), where XLA derives all collectives from shardings.
+    """
+
+    def __init__(
+        self,
+        model,
+        mesh: Optional[Mesh] = None,
+        strategy: Optional[GradientSyncStrategy] = None,
+        param_sharding_rules: Optional[Sequence[Tuple[str, P]]] = None,
+        data_axis: str = "data",
+    ) -> None:
+        self.model = model
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.strategy = strategy or SyncAllReduce()
+        self.data_axis = data_axis
+        if data_axis not in self.mesh.axis_names:
+            raise ValueError(f"mesh has no {data_axis!r} axis: {self.mesh.axis_names}")
+        if param_sharding_rules and self.strategy.explicit:
+            raise ValueError(
+                "param_sharding_rules (tensor parallelism) requires the default "
+                "SyncAllReduce strategy — explicit strategies replicate params"
+            )
+        self.rules = [(re.compile(pat), spec) for pat, spec in (param_sharding_rules or [])]
+
+        self.optim = LayerOptimizers(model)
+        self._replicated = NamedSharding(self.mesh, P())
+        self._data_sharding = NamedSharding(self.mesh, P(data_axis))  # batch dim sharded
+        self.params = jax.device_put(model.params, self._param_shardings())
+        self.state = jax.device_put(model.state, self._replicated)
+        self.opt_state = jax.device_put(self.optim.init(self.params), self._replicated)
+        self.strat_state = jax.device_put(
+            self.strategy.init_state(self.params), self._replicated
+        )
+        self.iteration = 0
+        self._step = None
+
+    # ----- shardings -------------------------------------------------
+    def _spec_for(self, path: str) -> P:
+        for pat, spec in self.rules:
+            if pat.search(path):
+                return spec
+        return P()
+
+    def _param_shardings(self):
+        if not self.rules:
+            return self._replicated
+
+        def one(layer_params, lname):
+            return {
+                k: NamedSharding(self.mesh, self._spec_for(f"{lname}/{k}"))
+                for k in layer_params
+            }
+
+        return {ln: one(lp, ln) for ln, lp in self.model.params.items()}
+
+    # ----- step compilation ------------------------------------------
+    def _build_step(self):
+        model = self.model
+        conf = model.conf
+        optim = self.optim
+        strategy = self.strategy
+        axis = self.data_axis
+
+        def local_grads(params, state, x, y, rng):
+            def loss_fn(p):
+                return model.loss_pure(p, state, x, y, rng=rng, train=True)
+
+            (score, (new_state, _)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            return score, new_state, grads
+
+        if not strategy.explicit:
+            # Implicit path: sharded batch + (possibly rule-sharded) params;
+            # the mean-loss gradient IS the all-reduced gradient — XLA emits
+            # the psum/all-gathers from the shardings (GSPMD).
+            def step(params, opt_state, state, strat_state, x, y, rng, it):
+                score, new_state, grads = local_grads(params, state, x, y, rng)
+                grads = _normalize_gradients(
+                    grads, conf.gradient_normalization, conf.gradient_normalization_threshold
+                )
+                new_params, new_opt = optim.update(grads, opt_state, params)
+                return new_params, new_opt, new_state, strat_state, score
+
+            return jax.jit(
+                step,
+                in_shardings=(
+                    self._param_shardings(), self._replicated, self._replicated,
+                    self._replicated, self._data_sharding, self._data_sharding,
+                    self._replicated, self._replicated,
+                ),
+                out_shardings=(
+                    self._param_shardings(), self._replicated, self._replicated,
+                    self._replicated, self._replicated,
+                ),
+                donate_argnums=(0, 1, 2, 3),
+            )
+
+        # Explicit path: per-replica grads -> strategy.sync collective.
+        def shard_step(params, opt_state, state, strat_state, x, y, rng, it):
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+            score, new_state, grads = local_grads(params, state, x, y, rng)
+            grads, new_strat = strategy.sync(grads, strat_state, axis)
+            grads = _normalize_gradients(
+                grads, conf.gradient_normalization, conf.gradient_normalization_threshold
+            )
+            new_params, new_opt = optim.update(grads, opt_state, params)
+            new_params = strategy.sync_params(new_params, it, axis)
+            # state (e.g. batchnorm running stats) follows the local shard;
+            # average it so replicas agree, like the reference's param
+            # averaging of each worker's model.
+            new_state = jax.tree_util.tree_map(
+                lambda s: jax.lax.pmean(s, axis) if jnp.issubdtype(s.dtype, jnp.floating) else s,
+                new_state,
+            )
+            score = jax.lax.pmean(score, axis)
+            return new_params, new_opt, new_state, new_strat, score
+
+        rep = P()
+        data = P(self.data_axis)
+        mapped = _shmap(
+            shard_step,
+            self.mesh,
+            in_specs=(rep, rep, rep, rep, data, data, rep, rep),
+            out_specs=(rep, rep, rep, rep, rep),
+        )
+        return jax.jit(mapped, donate_argnums=(0, 1, 2, 3))
+
+    # ----- public API -------------------------------------------------
+    @property
+    def n_data_shards(self) -> int:
+        return self.mesh.shape[self.data_axis]
+
+    def fit_batch(self, x, y) -> float:
+        if self._step is None:
+            self._step = self._build_step()
+        model = self.model
+        # keep host arrays host-side until device_put so each row goes
+        # host->owning-shard once (jnp.asarray first would commit to the
+        # default device and pay a second device->device scatter)
+        x = np.asarray(x, model.dtype)
+        y = np.asarray(y)
+        n = self.n_data_shards
+        if x.shape[0] % n:
+            raise ValueError(f"batch {x.shape[0]} not divisible by data axis {n}")
+        x = jax.device_put(x, self._data_sharding)
+        y = jax.device_put(y, self._data_sharding)
+        rng = model._rng.next_key()
+        self.iteration += 1
+        it = jnp.asarray(self.iteration, jnp.int32)
+        self.params, self.opt_state, self.state, self.strat_state, score = self._step(
+            self.params, self.opt_state, self.state, self.strat_state, x, y, rng, it
+        )
+        return score
+
+    def fit(self, data, labels=None, *, epochs: int = 1) -> float:
+        """Train; accepts (features, labels) arrays or a DataSetIterator.
+        Batches whose size doesn't divide the data axis are dropped (the
+        reference's Spark path likewise repartitioned to uniform shards)."""
+        from ..nn.sequential import _as_batches
+
+        model = self.model
+        n = self.n_data_shards
+        last = None
+        sync = bool(model.listeners.listeners)
+        for _ in range(epochs):
+            model.listeners.epoch_start(model)
+            for feats, labs, _msk, _lmsk in _as_batches(data, labels, None):
+                if np.shape(feats)[0] % n:
+                    continue
+                last = self.fit_batch(feats, labs)
+                model.iteration_count += 1
+                if sync:
+                    model.score_value = float(last)
+                    model.listeners.iteration_done(
+                        model, model.iteration_count, model.epoch_count, model.score_value
+                    )
+            model.listeners.epoch_end(model)
+            model.epoch_count += 1
+        if last is not None:
+            model.score_value = float(last)
+        self.sync_to_model()
+        return model.score_value
+
+    def output(self, x) -> jax.Array:
+        """Sharded forward pass (inference over the data axis)."""
+        model = self.model
+        if not hasattr(self, "_fwd"):
+            def fwd(params, state, x):
+                out, _, _ = model.forward_pure(params, state, x, train=False, rng=None)
+                return out
+
+            self._fwd = jax.jit(
+                fwd,
+                in_shardings=(self._param_shardings(), self._replicated, self._data_sharding),
+                out_shardings=self._data_sharding,
+            )
+        self._reconcile_params()
+        return self._fwd(self.params, self.state, jnp.asarray(x, model.dtype))
+
+    def _reconcile_params(self) -> None:
+        """For strategies whose replicas drift between sync points
+        (parameter averaging), all-reduce params so every replica holds the
+        average — this IS the averaging step, just taken out of schedule,
+        matching the reference master's end-of-epoch aggregation."""
+        if not getattr(self.strategy, "params_diverge", False):
+            return
+        axis = self.data_axis
+
+        def avg(params):
+            return jax.tree_util.tree_map(lambda p: jax.lax.pmean(p, axis), params)
+
+        mapped = _shmap(avg, self.mesh, in_specs=(P(),), out_specs=P())
+        self.params = jax.jit(mapped)(self.params)
+
+    def sync_to_model(self) -> None:
+        """Write trained params/state back onto the wrapped model (the
+        reference's 'aggregate final params to driver' step). Replicas agree
+        already except under parameter averaging, where this first performs
+        the final average."""
+        self._reconcile_params()
+        self.model.params = jax.device_get(self.params)
+        self.model.state = jax.device_get(self.state)
+
+    def threshold_value(self) -> Optional[float]:
+        """Current adaptive threshold (compressed strategy only)."""
+        if isinstance(self.strat_state, dict) and "threshold" in self.strat_state:
+            return float(self.strat_state["threshold"])
+        return None
