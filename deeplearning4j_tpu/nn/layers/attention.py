@@ -36,14 +36,13 @@ def dot_product_attention(
     mask: Optional[jax.Array] = None,  # [b, tk]
     scaled: bool = True,
 ) -> jax.Array:
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
-    if scaled:
-        scores = scores / math.sqrt(q.shape[-1])
-    if mask is not None:
-        neg = jnp.asarray(jnp.finfo(scores.dtype).min, scores.dtype)
-        scores = jnp.where(mask[:, None, None, :] > 0, scores, neg)
-    weights = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bhkv->bhqv", weights, v)
+    # Routed through the helper seam (ops.mha_attention): builtin XLA einsum
+    # path or the Pallas flash kernel, mirroring the reference's per-layer
+    # cuDNN-helper probe (SURVEY.md §2.2 "Helper SPI").
+    from ...ops import mha_attention
+
+    scale = 1.0 / math.sqrt(q.shape[-1]) if scaled else 1.0
+    return mha_attention(q, k, v, mask=mask, scale=scale)
 
 
 def _split_heads(x: jax.Array, n_heads: int) -> jax.Array:

@@ -1,0 +1,25 @@
+"""TPU kernel ops.
+
+The reference accelerates hot layers through per-layer "platform helpers"
+(cuDNN/oneDNN consulted before generic impls — SURVEY.md §2.1). Here XLA is
+the default platform and Pallas kernels are the optional accelerated helper,
+selected through :func:`set_attention_impl` — the same pluggable-seam shape
+as the reference's ``LayerHelper`` SPI, so ValidateCuDNN-style parity tests
+(helper vs builtin) carry over (SURVEY.md §4).
+"""
+
+from .flash_attention import (
+    attention_impl,
+    flash_attention,
+    mha_attention,
+    mha_attention_reference,
+    set_attention_impl,
+)
+
+__all__ = [
+    "attention_impl",
+    "flash_attention",
+    "mha_attention",
+    "mha_attention_reference",
+    "set_attention_impl",
+]

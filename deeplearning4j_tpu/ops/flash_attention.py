@@ -1,0 +1,272 @@
+"""Pallas TPU flash attention.
+
+Blockwise online-softmax attention (Dao et al. flash attention, computed the
+TPU way): the q×k score matrix is never materialised in HBM — each q block
+streams over k/v blocks held in VMEM, carrying running max/denominator, so
+HBM traffic is O(t·d) instead of O(t²). Matmuls hit the MXU via
+``dot_general`` with ``preferred_element_type=float32``.
+
+This is the accelerated "helper" implementation for the attention layers
+(deeplearning4j_tpu.nn.layers.attention); the reference's analogous seam is
+the cuDNN attention/mha helper consulted before the builtin math
+(SURVEY.md §2.1 "platform helpers", §2.2 "Helper SPI").
+
+The backward pass recomputes attention with the reference XLA einsum path
+(flash forward + rematerialised backward): forward memory is what flash
+buys; XLA fuses the backward fine at the sequence lengths the layer zoo
+uses. Inputs [batch, heads, time, head_dim].
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces — absent on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+_NEG = -1e30  # finite "-inf": keeps exp/max well-defined for fully-masked rows
+
+# ---------------------------------------------------------------------------
+# helper-impl seam (reference: LayerHelper SPI — cuDNN vs builtin)
+# ---------------------------------------------------------------------------
+
+_IMPL = "auto"  # "auto" | "flash" | "xla"
+
+
+def set_attention_impl(impl: str) -> None:
+    """Select the attention implementation: "xla" (builtin einsum path),
+    "flash" (Pallas kernel), or "auto" (flash on TPU for long sequences).
+
+    The choice is read at trace time, so already-compiled functions would
+    keep their traced impl; jit caches are cleared here so the toggle takes
+    effect everywhere (recompilation on next call)."""
+    if impl not in ("auto", "flash", "xla"):
+        raise ValueError(f"unknown attention impl {impl!r}")
+    global _IMPL
+    if impl != _IMPL:
+        _IMPL = impl
+        jax.clear_caches()
+
+
+def attention_impl() -> str:
+    return _IMPL
+
+
+# ---------------------------------------------------------------------------
+# reference (builtin) implementation — also the backward path for flash
+# ---------------------------------------------------------------------------
+
+
+def mha_attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Plain XLA attention: softmax(q·kᵀ·scale + bias)·v. Masks are additive
+    large-negative biases so shapes stay static for the compiler."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    neg = jnp.asarray(_NEG, scores.dtype)
+    if mask is not None:
+        scores = jnp.where(mask[:, None, None, :] > 0, scores, neg)
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        qi = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0) + (tk - tq)
+        ki = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        scores = jnp.where(qi >= ki, scores, neg)
+    weights = jax.nn.softmax(scores, axis=-1)
+    if mask is not None or causal:
+        # Rows with no valid key output 0 (matching the flash kernel) rather
+        # than softmax-of-constant uniform weights.
+        any_valid = jnp.any(scores > _NEG * 0.5, axis=-1, keepdims=True)
+        weights = jnp.where(any_valid, weights, 0.0)
+    return jnp.einsum("bhqk,bhkv->bhqv", weights, v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, scale, block_k,
+                  causal, seq_k, tk_offset):
+    """One (batch·head, q-block) program: stream k/v blocks, online softmax."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
+    block_q = q.shape[0]
+    dv = v_ref.shape[-1]
+
+    m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, dv), jnp.float32)
+    q_ids = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0) + tk_offset
+
+    def body(kb, carry):
+        m, l, acc = carry
+        ks = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        vs = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, ks, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [block_q, block_k]
+        mk = mask_ref[0, 0, pl.ds(kb * block_k, block_k)]
+        s = jnp.where(mk[None, :] > 0, s, _NEG)
+        if causal:
+            k_ids = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        # Zero masked entries explicitly: when a row is ENTIRELY masked,
+        # m_new == _NEG and exp(s - m_new) == 1, which would weight masked
+        # keys uniformly. Zeroing keeps l == 0 so the row output is 0 —
+        # the defined semantics for fully-masked rows on both impls.
+        p = jnp.where(s > _NEG * 0.5, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, vs, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    _, l, acc = jax.lax.fori_loop(0, seq_k // block_k, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)  # fully-masked rows → 0
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _pad_to(x: jax.Array, axis: int, multiple: int, value=0.0) -> jax.Array:
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+def _flash_forward(q, k, v, mask, causal, scale, block_q, block_k, interpret):
+    b, h, tq, d = q.shape
+    tk, dv = k.shape[2], v.shape[3]
+    block_q = min(block_q, max(tq, 1))
+    block_k = min(block_k, max(tk, 1))
+
+    if mask is None:
+        mask = jnp.ones((b, tk), jnp.float32)
+    # [b, 1, tk]: a leading singleton keeps the block's trailing two dims
+    # equal to the array dims, satisfying the mosaic tiling constraint.
+    mask = _pad_to(mask.astype(jnp.float32), 1, block_k, 0.0)[:, None, :]
+    qp = _pad_to(q, 2, block_q)
+    kp = _pad_to(k, 2, block_k)
+    vp = _pad_to(v, 2, block_k)
+    tq_p, tk_p = qp.shape[2], kp.shape[2]
+
+    qp = qp.reshape(b * h, tq_p, d)
+    kp = kp.reshape(b * h, tk_p, d)
+    vp = vp.reshape(b * h, tk_p, dv)
+
+    grid = (b * h, tq_p // block_q)
+    kern = functools.partial(
+        _flash_kernel, scale=scale, block_k=block_k, causal=causal,
+        seq_k=tk_p, tk_offset=tk - tq)
+    kwargs = {}
+    if _VMEM is not None:
+        kwargs = dict(memory_space=_VMEM)
+    out = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0), **kwargs),
+            pl.BlockSpec((1, tk_p, d), lambda bh, qi: (bh, 0, 0), **kwargs),
+            pl.BlockSpec((1, tk_p, dv), lambda bh, qi: (bh, 0, 0), **kwargs),
+            pl.BlockSpec((1, 1, tk_p), lambda bh, qi: (bh // h, 0, 0),
+                         **kwargs),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dv), lambda bh, qi: (bh, qi, 0),
+                               **kwargs),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, dv), q.dtype),
+        interpret=interpret,
+    )(qp, kp, vp, mask)
+    return out.reshape(b, h, tq_p, dv)[:, :, :tq, :]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, mask, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, mask, causal, scale, block_q, block_k,
+                          interpret)
+
+
+def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, mask, causal, scale, block_q, block_k,
+                         interpret)
+    return out, (q, k, v, mask)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, mask = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: mha_attention_reference(
+            q_, k_, v_, mask=mask, causal=causal, scale=scale), q, k, v)
+    dq, dk, dv = vjp(g)
+    dmask = None if mask is None else jnp.zeros_like(mask)
+    return dq, dk, dv, dmask
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention over [b, h, t, d] tensors. ``mask`` is a [b, t_k]
+    key-padding mask (1 = keep). Runs the Pallas kernel compiled on TPU and
+    in interpreter mode elsewhere (the CPU test path)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, mask, causal, float(scale), block_q, block_k,
+                  interpret)
+
+
+def mha_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Dispatch through the helper seam: builtin XLA path by default, the
+    Pallas flash kernel when selected (or automatically on TPU for sequences
+    long enough that materialising q·kᵀ matters)."""
+    impl = _IMPL
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        impl = "flash" if (on_tpu and q.shape[2] >= 512) else "xla"
+    if impl == "flash":
+        return flash_attention(q, k, v, mask=mask, causal=causal, scale=scale)
+    return mha_attention_reference(q, k, v, mask=mask, causal=causal,
+                                   scale=scale)

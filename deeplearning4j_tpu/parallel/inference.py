@@ -72,16 +72,22 @@ class ParallelInference:
         return self.output_async(x).result()
 
     def output_async(self, x) -> Future:
-        if self._shutdown:
-            raise RuntimeError("ParallelInference is shut down")
         fut: Future = Future()
-        self._queue.put((np.asarray(x), fut))
+        # The lock orders enqueues against shutdown's sentinel placement: no
+        # request can land behind the sentinels and starve its Future.
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("ParallelInference is shut down")
+            self._queue.put((np.asarray(x), fut))
         return fut
 
     def shutdown(self) -> None:
-        self._shutdown = True
-        for _ in self._threads:
-            self._queue.put(None)
+        with self._lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+            for _ in self._threads:
+                self._queue.put(None)
         for t in self._threads:
             t.join(timeout=5)
 

@@ -1,0 +1,114 @@
+"""Flash-attention helper vs builtin parity — the ValidateCuDNN pattern
+(SURVEY.md §4: helper enabled vs disabled, compare outputs/grads within eps).
+Runs the Pallas kernel in interpreter mode on the CPU test platform."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops import (
+    flash_attention,
+    mha_attention,
+    mha_attention_reference,
+    set_attention_impl,
+)
+
+
+def _rand(key, *shape):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, jnp.float32)
+
+
+@pytest.mark.parametrize("tq,tk", [(64, 64), (96, 128), (40, 72)])
+def test_flash_matches_reference(tq, tk):
+    q = _rand(0, 2, 2, tq, 16)
+    k = _rand(1, 2, 2, tk, 16)
+    v = _rand(2, 2, 2, tk, 16)
+    ref = mha_attention_reference(q, k, v)
+    out = flash_attention(q, k, v, block_q=32, block_k=32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_with_padding_mask():
+    q = _rand(0, 2, 2, 48, 16)
+    k = _rand(1, 2, 2, 48, 16)
+    v = _rand(2, 2, 2, 48, 16)
+    mask = jnp.asarray(np.random.RandomState(0).rand(2, 48) > 0.3,
+                       jnp.float32)
+    ref = mha_attention_reference(q, k, v, mask=mask)
+    out = flash_attention(q, k, v, mask=mask, block_q=32, block_k=32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_causal():
+    q = _rand(0, 1, 2, 64, 16)
+    k = _rand(1, 1, 2, 64, 16)
+    v = _rand(2, 1, 2, 64, 16)
+    ref = mha_attention_reference(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_gradients_match():
+    q = _rand(0, 1, 1, 32, 8)
+    k = _rand(1, 1, 1, 32, 8)
+    v = _rand(2, 1, 1, 32, 8)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, block_q=16, block_k=16) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_attention_reference(q, k, v) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_impl_seam_dispatch():
+    q = _rand(0, 1, 1, 32, 8)
+    try:
+        set_attention_impl("flash")
+        out_flash = mha_attention(q, q, q)
+        set_attention_impl("xla")
+        out_xla = mha_attention(q, q, q)
+    finally:
+        set_attention_impl("auto")
+    np.testing.assert_allclose(np.asarray(out_flash), np.asarray(out_xla),
+                               atol=2e-5)
+    with pytest.raises(ValueError):
+        set_attention_impl("bogus")
+
+
+def test_attention_layer_with_flash_helper():
+    """Layer-level helper-vs-builtin parity (ValidateCuDNN shape)."""
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.nn.layers.base import LayerContext
+
+    layer = SelfAttentionLayer(n_in=16, n_out=16, n_heads=2).with_input(
+        __import__("deeplearning4j_tpu.nn.input_type",
+                   fromlist=["RecurrentType"]).RecurrentType(size=16,
+                                                             timesteps=32))
+    params = layer.init(jax.random.PRNGKey(0), jnp.float32)
+    x = _rand(5, 3, 16, 32)
+    ctx = LayerContext(train=False, rng=None, mask=None)
+    try:
+        set_attention_impl("xla")
+        ref, _ = layer.apply(params, {}, x, ctx)
+        set_attention_impl("flash")
+        out, _ = layer.apply(params, {}, x, ctx)
+    finally:
+        set_attention_impl("auto")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_fully_masked_rows_zero_on_both_impls():
+    q = _rand(0, 1, 1, 16, 8)
+    k = _rand(1, 1, 1, 10, 8)
+    v = _rand(2, 1, 1, 10, 8)
+    mask = jnp.zeros((1, 10), jnp.float32)
+    ref = mha_attention_reference(q, k, v, mask=mask)
+    out = flash_attention(q, k, v, mask=mask, block_q=8, block_k=4)
+    np.testing.assert_allclose(np.asarray(ref), 0.0, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-7)
