@@ -103,50 +103,58 @@ def mha_attention_reference(
 # ---------------------------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, scale, block_k,
-                  causal, seq_k, tk_offset):
-    """One (batch·head, q-block) program: stream k/v blocks, online softmax."""
+def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, m_scr, l_scr,
+                  acc_scr, *, scale, block_q, block_k, causal, tk_offset):
+    """One (batch·head, q-block, k-block) grid step.
+
+    The k dimension is the innermost grid axis; TPU grids execute
+    sequentially, so the VMEM scratch accumulators (running max /
+    denominator / weighted sum) carry across k steps for a fixed q block.
+    Only (block, d) tiles are ever resident in VMEM — Pallas pipelines the
+    HBM→VMEM tile loads — so sequence length is bounded by HBM, not VMEM.
+    """
     qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr, _NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
     q = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
-    block_q = q.shape[0]
-    dv = v_ref.shape[-1]
+    ks = k_ref[0].astype(jnp.float32)  # [block_k, d]
+    vs = v_ref[0].astype(jnp.float32)  # [block_k, dv]
+    s = jax.lax.dot_general(
+        q, ks, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)  # [block_q, block_k]
+    mk = mask_ref[0, 0]  # [block_k]
+    s = jnp.where(mk[None, :] > 0, s, _NEG)
+    if causal:
+        q_ids = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0) + tk_offset
+        k_ids = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_ids >= k_ids, s, _NEG)
 
-    m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, dv), jnp.float32)
-    q_ids = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0) + tk_offset
+    m, l, acc = m_scr[...], l_scr[...], acc_scr[...]
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    # Zero masked entries explicitly: when a row is ENTIRELY masked,
+    # m_new == _NEG and exp(s - m_new) == 1, which would weight masked
+    # keys uniformly. Zeroing keeps l == 0 so the row output is 0 —
+    # the defined semantics for fully-masked rows on both impls.
+    p = jnp.where(s > _NEG * 0.5, p, 0.0)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jax.lax.dot_general(
+        p, vs, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    m_scr[...], l_scr[...], acc_scr[...] = m_new, l_new, acc_new
 
-    def body(kb, carry):
-        m, l, acc = carry
-        ks = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        vs = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, ks, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [block_q, block_k]
-        mk = mask_ref[0, 0, pl.ds(kb * block_k, block_k)]
-        s = jnp.where(mk[None, :] > 0, s, _NEG)
-        if causal:
-            k_ids = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_ids >= k_ids, s, _NEG)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        # Zero masked entries explicitly: when a row is ENTIRELY masked,
-        # m_new == _NEG and exp(s - m_new) == 1, which would weight masked
-        # keys uniformly. Zeroing keeps l == 0 so the row output is 0 —
-        # the defined semantics for fully-masked rows on both impls.
-        p = jnp.where(s > _NEG * 0.5, p, 0.0)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
-            p, vs, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
-
-    _, l, acc = jax.lax.fori_loop(0, seq_k // block_k, body, (m0, l0, acc0))
-    out = acc / jnp.maximum(l, 1e-30)  # fully-masked rows → 0
-    o_ref[0] = out.astype(o_ref.dtype)
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _():
+        out = acc_new / jnp.maximum(l_new, 1e-30)  # fully-masked rows → 0
+        o_ref[0] = out.astype(o_ref.dtype)
 
 
 def _pad_to(x: jax.Array, axis: int, multiple: int, value=0.0) -> jax.Array:
@@ -160,6 +168,9 @@ def _pad_to(x: jax.Array, axis: int, multiple: int, value=0.0) -> jax.Array:
 
 
 def _flash_forward(q, k, v, mask, causal, scale, block_q, block_k, interpret):
+    if _VMEM is None:  # jaxlib without pallas TPU support: same math via XLA
+        return mha_attention_reference(q, k, v, mask=mask, causal=causal,
+                                       scale=scale)
     b, h, tq, d = q.shape
     tk, dv = k.shape[2], v.shape[3]
     block_q = min(block_q, max(tq, 1))
@@ -179,26 +190,33 @@ def _flash_forward(q, k, v, mask, causal, scale, block_q, block_k, interpret):
     kp = kp.reshape(b * h, tk_p, d)
     vp = vp.reshape(b * h, tk_p, dv)
 
-    grid = (b * h, tq_p // block_q)
+    grid = (b * h, tq_p // block_q, tk_p // block_k)
     kern = functools.partial(
-        _flash_kernel, scale=scale, block_k=block_k, causal=causal,
-        seq_k=tk_p, tk_offset=tk - tq)
-    kwargs = {}
-    if _VMEM is not None:
-        kwargs = dict(memory_space=_VMEM)
+        _flash_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        causal=causal, tk_offset=tk - tq)
+    kwargs = dict(memory_space=_VMEM)
+    scratch = [
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, dv), jnp.float32),
+    ]
     out = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0), **kwargs),
-            pl.BlockSpec((1, tk_p, d), lambda bh, qi: (bh, 0, 0), **kwargs),
-            pl.BlockSpec((1, tk_p, dv), lambda bh, qi: (bh, 0, 0), **kwargs),
-            pl.BlockSpec((1, 1, tk_p), lambda bh, qi: (bh // h, 0, 0),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0),
+                         **kwargs),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0),
+                         **kwargs),
+            pl.BlockSpec((1, block_k, dv), lambda bh, qi, ki: (bh, ki, 0),
+                         **kwargs),
+            pl.BlockSpec((1, 1, block_k), lambda bh, qi, ki: (bh // h, 0, ki),
                          **kwargs),
         ],
-        out_specs=pl.BlockSpec((1, block_q, dv), lambda bh, qi: (bh, qi, 0),
-                               **kwargs),
+        out_specs=pl.BlockSpec((1, block_q, dv),
+                               lambda bh, qi, ki: (bh, qi, 0), **kwargs),
         out_shape=jax.ShapeDtypeStruct((b * h, tq_p, dv), q.dtype),
+        scratch_shapes=scratch,
         interpret=interpret,
     )(qp, kp, vp, mask)
     return out.reshape(b, h, tq_p, dv)[:, :, :tq, :]
@@ -265,7 +283,11 @@ def mha_attention(
     impl = _IMPL
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
-        impl = "flash" if (on_tpu and q.shape[2] >= 512) else "xla"
+        # Gate on the larger of tq/tk: the materialised score matrix is
+        # tq×tk, so long keys with few queries (LearnedSelfAttention) also
+        # benefit from k/v streaming.
+        impl = ("flash" if (on_tpu and max(q.shape[2], k.shape[2]) >= 512)
+                else "xla")
     if impl == "flash":
         return flash_attention(q, k, v, mask=mask, causal=causal, scale=scale)
     return mha_attention_reference(q, k, v, mask=mask, causal=causal,

@@ -16,6 +16,9 @@ Reference capabilities covered (SURVEY.md §2.3/§2.4, §3.4/§3.5):
   strategy (N local steps, then mean of params across the data axis).
 * ``ParallelInference`` → :class:`ParallelInference` (dynamic batching over a
   jitted forward).
+* Long-context (absent in the reference, SURVEY.md §5.7) →
+  :func:`ring_attention` / :func:`ulysses_attention` sequence parallelism
+  over a ``seq`` mesh axis (parallel/sequence.py).
 * Aeron/Spark control plane → ``jax.distributed`` (coordination service),
   see :func:`initialize_distributed`.
 """
@@ -27,11 +30,14 @@ from .strategies import (
     SyncAllReduce,
     ThresholdCompressedSync,
 )
+from .sequence import ring_attention, ulysses_attention
 from .trainer import DistributedTrainer
 from .inference import InferenceMode, ParallelInference
 
 __all__ = [
     "DistributedTrainer",
+    "ring_attention",
+    "ulysses_attention",
     "GradientSyncStrategy",
     "InferenceMode",
     "MeshSpec",

@@ -1,0 +1,186 @@
+"""Sequence/context parallelism — ring attention and Ulysses all-to-all.
+
+The reference has NO sequence parallelism (SURVEY.md §2.3: SP/CP absent;
+long sequences were handled only by truncated BPTT). This module is the
+TPU-native long-context story (SURVEY.md §5.7): attention over sequences
+sharded across a ``seq`` mesh axis, delivered as sharding strategies over
+the attention op rather than a separate framework.
+
+* :func:`ring_attention` — each device holds a sequence shard of q/k/v and
+  an online-softmax accumulator; k/v blocks rotate around the ring via
+  ``lax.ppermute`` (XLA maps it onto neighbor ICI links), so every q shard
+  sees every k/v block while per-device memory stays O(t/N). Math is the
+  same blockwise streaming softmax as the Pallas flash kernel
+  (ops/flash_attention.py) — the ring is flash attention with the k/v loop
+  distributed over chips.
+* :func:`ulysses_attention` — all-to-all head↔sequence swap: devices trade
+  their sequence shards for head shards, run full-sequence attention on
+  h/N heads locally (through the attention helper seam, so the Pallas
+  kernel applies), and swap back. Cheaper collectives for moderate t, but
+  requires heads % N == 0.
+
+Both are reverse-differentiable (scan + ppermute/all_to_all have
+transposes), so they drop into the jitted training step.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.7 style
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_NEG = -1e30
+
+
+def _shmap(fn, mesh, in_specs, out_specs):
+    try:
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+    except TypeError:  # older kwarg name
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
+
+
+# ---------------------------------------------------------------------------
+# ring attention
+# ---------------------------------------------------------------------------
+
+
+def _ring_attention_local(q, k, v, mask, *, axis, n, causal, scale):
+    """Per-device body. q/k/v: [b, h, t_local, d]; mask: [b, t_local]."""
+    idx = jax.lax.axis_index(axis)
+    b, h, tq, d = q.shape
+    dv = v.shape[-1]
+    qf = q.astype(jnp.float32) * scale
+    # End-aligned causal offset for tq != tk (global lengths are n× the
+    # local shards), matching mha_attention_reference / the flash kernel.
+    tk_offset = n * (k.shape[2] - tq)
+    q_ids = (idx * tq + tk_offset
+             + jax.lax.broadcasted_iota(jnp.int32, (tq, k.shape[2]), 0))
+    k_iota = jax.lax.broadcasted_iota(jnp.int32, (tq, k.shape[2]), 1)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, i):
+        k_blk, v_blk, m_blk, m, l, acc = carry
+        src = (idx - i) % n  # which device's shard we currently hold
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32))
+        s = jnp.where(m_blk[:, None, None, :] > 0, s, _NEG)
+        if causal:
+            k_ids = src * k.shape[2] + k_iota
+            s = jnp.where((q_ids >= k_ids)[None, None], s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(s > _NEG * 0.5, p, 0.0)  # fully-masked rows stay 0
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bhqk,bhkv->bhqv", p, v_blk.astype(jnp.float32))
+        # rotate k/v/mask to the next device; the last rotation is wasted
+        # but keeps the scan body uniform (XLA overlaps it with the final
+        # accumulation epilogue).
+        k_blk = jax.lax.ppermute(k_blk, axis, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis, perm)
+        m_blk = jax.lax.ppermute(m_blk, axis, perm)
+        return (k_blk, v_blk, m_blk, m_new, l_new, acc_new), None
+
+    m0 = jnp.full((b, h, tq, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((b, h, tq, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, tq, dv), jnp.float32)
+    # checkpoint: recompute the [tq_local × tk_local] score/prob blocks in
+    # the backward instead of storing one per ring step — without it grad
+    # residuals are O(tq·tk/N) per device, defeating the long-context
+    # purpose. The rotating k/v carries still cost one full k/v copy per
+    # device across the scan (same footprint as an all-gather).
+    (_, _, _, _, l, acc), _ = jax.lax.scan(
+        jax.checkpoint(step), (k, v, mask, m0, l0, acc0), jnp.arange(n))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    *,
+    mesh: Mesh,
+    axis: str = "seq",
+) -> jax.Array:
+    """Ring attention over [b, h, t, d] inputs whose time axis is (to be)
+    sharded over ``mesh`` axis ``axis``. ``mask`` is a [b, t] key-padding
+    mask. Sequence length must be divisible by the axis size."""
+    n = mesh.shape[axis]
+    if q.shape[2] % n or k.shape[2] % n:
+        raise ValueError(
+            f"sequence length {q.shape[2]}/{k.shape[2]} not divisible by "
+            f"mesh axis {axis!r} of size {n}")
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if mask is None:
+        mask = jnp.ones((q.shape[0], k.shape[2]), jnp.float32)
+    fn = functools.partial(
+        _ring_attention_local, axis=axis, n=n, causal=causal,
+        scale=float(scale))
+    spec = P(None, None, axis, None)
+    mapped = _shmap(fn, mesh, (spec, spec, spec, P(None, axis)), spec)
+    return mapped(q, k, v, mask.astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Ulysses (all-to-all head/sequence swap)
+# ---------------------------------------------------------------------------
+
+
+def _ulysses_local(q, k, v, mask, *, axis, causal, scale):
+    from ..ops import mha_attention
+
+    # [b, h, t/N, d] → [b, h/N, t, d]: trade sequence shards for head shards
+    q = jax.lax.all_to_all(q, axis, split_axis=1, concat_axis=2, tiled=True)
+    k = jax.lax.all_to_all(k, axis, split_axis=1, concat_axis=2, tiled=True)
+    v = jax.lax.all_to_all(v, axis, split_axis=1, concat_axis=2, tiled=True)
+    full_mask = jax.lax.all_gather(mask, axis, axis=1, tiled=True)  # [b, t]
+    out = mha_attention(q, k, v, mask=full_mask, causal=causal, scale=scale)
+    return jax.lax.all_to_all(out, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    *,
+    mesh: Mesh,
+    axis: str = "seq",
+) -> jax.Array:
+    """Ulysses-style sequence parallelism: all-to-all swaps the sharded
+    axis from sequence to heads so attention itself is local and full-length
+    (and can use the Pallas flash kernel via the helper seam). Requires
+    heads and sequence length divisible by the axis size."""
+    n = mesh.shape[axis]
+    if q.shape[1] % n:
+        raise ValueError(f"{q.shape[1]} heads not divisible by axis size {n}")
+    if q.shape[2] % n or k.shape[2] % n:
+        raise ValueError(
+            f"sequence length not divisible by mesh axis size {n}")
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if mask is None:
+        mask = jnp.ones((q.shape[0], k.shape[2]), jnp.float32)
+    fn = functools.partial(_ulysses_local, axis=axis, causal=causal,
+                           scale=float(scale))
+    spec = P(None, None, axis, None)
+    mapped = _shmap(fn, mesh, (spec, spec, spec, P(None, axis)), spec)
+    return mapped(q, k, v, mask.astype(jnp.float32))

@@ -443,7 +443,12 @@ def _avg_pool2d(x, kernel=(2, 2), strides=(2, 2), padding="VALID", data_format="
 
 @sd_op("multi_head_dot_product_attention")
 def _mhdpa(q, k, v, wq=None, wk=None, wv=None, wo=None, n_heads=1, mask=None, scaled=True):
-    """SameDiff multiHeadDotProductAttention (reference: sd.nn namespace)."""
+    """SameDiff multiHeadDotProductAttention (reference: sd.nn namespace).
+
+    Semantics note: rows whose key mask is entirely zero output 0 (this
+    framework's defined behavior across all attention impls), where the
+    reference's softmax-of-constant would output mean(v). Reachable only
+    for degenerate all-padding batch entries."""
     from ..nn.layers.attention import dot_product_attention, _merge_heads, _split_heads
 
     if wq is not None:

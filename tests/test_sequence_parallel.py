@@ -1,0 +1,117 @@
+"""Sequence-parallel attention (ring + Ulysses) vs single-device reference
+on the 8-virtual-device CPU mesh (SURVEY.md §4 multi-node-without-a-cluster
+test strategy)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops import mha_attention_reference
+from deeplearning4j_tpu.parallel import make_mesh
+from deeplearning4j_tpu.parallel.sequence import (
+    ring_attention,
+    ulysses_attention,
+)
+
+
+@pytest.fixture(scope="module")
+def seq_mesh():
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 devices")
+    return make_mesh(seq=4, devices=jax.devices()[:4])
+
+
+def _rand(key, *shape):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, jnp.float32)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_reference(seq_mesh, causal):
+    q = _rand(0, 2, 4, 32, 8)
+    k = _rand(1, 2, 4, 32, 8)
+    v = _rand(2, 2, 4, 32, 8)
+    ref = mha_attention_reference(q, k, v, causal=causal)
+    out = ring_attention(q, k, v, causal=causal, mesh=seq_mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ring_attention_with_mask(seq_mesh):
+    q = _rand(0, 2, 2, 32, 8)
+    k = _rand(1, 2, 2, 32, 8)
+    v = _rand(2, 2, 2, 32, 8)
+    mask = jnp.asarray(np.random.RandomState(0).rand(2, 32) > 0.3,
+                       jnp.float32)
+    ref = mha_attention_reference(q, k, v, mask=mask)
+    out = ring_attention(q, k, v, mask=mask, mesh=seq_mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ring_attention_grads(seq_mesh):
+    q = _rand(0, 1, 2, 16, 8)
+    k = _rand(1, 1, 2, 16, 8)
+    v = _rand(2, 1, 2, 16, 8)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, causal=True,
+                                      mesh=seq_mesh) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_attention_reference(q, k, v, causal=True) ** 2)
+
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    ge = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, ge):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_reference(seq_mesh, causal):
+    q = _rand(0, 2, 4, 32, 8)  # 4 heads over 4 devices
+    k = _rand(1, 2, 4, 32, 8)
+    v = _rand(2, 2, 4, 32, 8)
+    ref = mha_attention_reference(q, k, v, causal=causal)
+    out = ulysses_attention(q, k, v, causal=causal, mesh=seq_mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ulysses_with_mask(seq_mesh):
+    q = _rand(0, 2, 4, 32, 8)
+    k = _rand(1, 2, 4, 32, 8)
+    v = _rand(2, 2, 4, 32, 8)
+    mask = jnp.asarray(np.random.RandomState(1).rand(2, 32) > 0.3,
+                       jnp.float32)
+    ref = mha_attention_reference(q, k, v, mask=mask)
+    out = ulysses_attention(q, k, v, mask=mask, mesh=seq_mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ring_attention_jits_in_train_step(seq_mesh):
+    """Ring attention inside a jitted loss+grad step (the way a training
+    loop consumes it)."""
+    q = _rand(0, 1, 2, 16, 8)
+
+    @jax.jit
+    def step(q):
+        return jnp.sum(ring_attention(q, q, q, causal=True, mesh=seq_mesh))
+
+    assert np.isfinite(float(step(q)))
+
+
+def test_divisibility_errors(seq_mesh):
+    q = _rand(0, 1, 2, 30, 8)
+    with pytest.raises(ValueError):
+        ring_attention(q, q, q, mesh=seq_mesh)
+    q2 = _rand(0, 1, 3, 32, 8)  # 3 heads not divisible by 4
+    with pytest.raises(ValueError):
+        ulysses_attention(q2, q2, q2, mesh=seq_mesh)
+
+
+def test_ring_attention_causal_cross_length(seq_mesh):
+    """tq != tk causal alignment (end-aligned, matching the reference)."""
+    q = _rand(0, 1, 2, 16, 8)
+    k = _rand(1, 1, 2, 32, 8)
+    v = _rand(2, 1, 2, 32, 8)
+    ref = mha_attention_reference(q, k, v, causal=True)
+    out = ring_attention(q, k, v, causal=True, mesh=seq_mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
