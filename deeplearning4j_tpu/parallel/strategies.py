@@ -71,8 +71,9 @@ class ThresholdCompressedSync(GradientSyncStrategy):
 
     Note: on TPU the "encoded" tensor stays dense inside XLA — the value of
     this strategy is semantic parity (convergence behavior of compressed
-    sharing) and as the seam where a real DCN-path sparse codec
-    (native/threshold_codec.cpp) plugs in for multi-slice meshes.
+    sharing) and as the seam where the real host-side sparse codec
+    (``deeplearning4j_tpu.native.threshold_encode`` over libdl4jtpu,
+    native/dl4jtpu_native.cpp) plugs in for multi-slice DCN transport.
     """
 
     def __init__(

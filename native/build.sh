@@ -1,0 +1,12 @@
+#!/bin/sh
+# Build libdl4jtpu.so. Prefers cmake+ninja; falls back to direct g++.
+set -e
+cd "$(dirname "$0")"
+mkdir -p build
+if command -v cmake >/dev/null 2>&1 && command -v ninja >/dev/null 2>&1; then
+  cmake -S . -B build -G Ninja >/dev/null
+  cmake --build build >/dev/null
+else
+  g++ -O3 -shared -fPIC -std=c++14 -o build/libdl4jtpu.so dl4jtpu_native.cpp
+fi
+echo "built: $(pwd)/build/libdl4jtpu.so"

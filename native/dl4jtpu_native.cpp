@@ -1,0 +1,311 @@
+// libdl4jtpu — native runtime support for the TPU framework.
+//
+// TPU-native equivalent of the reference's host-side native runtime
+// (SURVEY.md §2.1): the pieces that are NOT device compute (XLA owns that)
+// but sit on the host hot path — gradient compression codecs for the
+// DCN-transport experiments (reference: encodeThresholdP1..P3 /
+// encodeBitmap in the C ABI, consumed by gradient sharing), and the data
+// pipeline's parse/decode/resize loops (reference: DataVec's native
+// OpenCV/JavaCPP loaders).
+//
+// Exposed as a plain C ABI consumed via ctypes (deeplearning4j_tpu/native.py),
+// mirroring the reference's NativeOps.h surface-style: flat functions, caller
+// owns all buffers. Build: native/CMakeLists.txt or native/build.sh (g++).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+#include <algorithm>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Threshold encoding (Strom-style, reference: encodeThresholdP1..P3).
+//
+// Sparse codec with error feedback: every |g| > threshold element is encoded
+// as sign(g)*threshold and SUBTRACTED from the gradient buffer in place (the
+// remainder is the residual carried to the next step). Wire format: int32
+// stream, +(<index>+1) for +threshold, -(<index>+1) for -threshold.
+// Returns the number of encoded entries, or -1 if it would exceed max_out
+// (caller falls back to bitmap/dense, like the reference's EncodingHandler).
+// ---------------------------------------------------------------------------
+
+int64_t dl4j_threshold_encode(float* grad, int64_t n, float threshold,
+                              int32_t* out, int64_t max_out) {
+  // Counting pass first: on overflow the gradient must be left untouched
+  // so the caller can re-encode the SAME signal with the bitmap codec.
+  int64_t count = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grad[i];
+    if (g > threshold || g < -threshold) {
+      if (++count > max_out) return -1;
+    }
+  }
+  count = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grad[i];
+    if (g > threshold) {
+      out[count++] = (int32_t)(i + 1);
+      grad[i] = g - threshold;
+    } else if (g < -threshold) {
+      out[count++] = (int32_t)(-(i + 1));
+      grad[i] = g + threshold;
+    }
+  }
+  return count;
+}
+
+// Apply an encoded update: target[i] += sign * threshold per entry.
+void dl4j_threshold_decode(const int32_t* enc, int64_t count, float threshold,
+                           float* target, int64_t n) {
+  for (int64_t i = 0; i < count; ++i) {
+    int32_t e = enc[i];
+    int64_t idx = (e > 0 ? e : -e) - 1;
+    if (idx < 0 || idx >= n) continue;  // corrupt entry: skip, never overrun
+    target[idx] += (e > 0 ? threshold : -threshold);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Bitmap encoding (reference: encodeBitmap) — dense 2-bit codec for when
+// threshold encoding's index stream would be larger than the bitmap.
+// Codes: 00 = 0, 01 = +threshold, 10 = -threshold. 4 values per byte.
+// Same in-place residual semantics as threshold encoding.
+// ---------------------------------------------------------------------------
+
+int64_t dl4j_bitmap_encode(float* grad, int64_t n, float threshold,
+                           uint8_t* bitmap /* ceil(n/4) bytes, zeroed */) {
+  int64_t count = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grad[i];
+    uint8_t code = 0;
+    if (g > threshold) {
+      code = 1;
+      grad[i] = g - threshold;
+      ++count;
+    } else if (g < -threshold) {
+      code = 2;
+      grad[i] = g + threshold;
+      ++count;
+    }
+    bitmap[i >> 2] |= (uint8_t)(code << ((i & 3) * 2));
+  }
+  return count;
+}
+
+void dl4j_bitmap_decode(const uint8_t* bitmap, int64_t n, float threshold,
+                        float* target) {
+  for (int64_t i = 0; i < n; ++i) {
+    uint8_t code = (bitmap[i >> 2] >> ((i & 3) * 2)) & 3;
+    if (code == 1) target[i] += threshold;
+    else if (code == 2) target[i] -= threshold;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// CSV parsing (reference: DataVec CSVRecordReader hot loop).
+// Parses a delimited text buffer into a row-major float32 matrix.
+// First call with out == nullptr to obtain rows/cols; second call fills.
+// Returns 0 on success, negative error codes otherwise.
+//   -1: ragged rows, -2: output too small, -3: parse error (non-numeric).
+// ---------------------------------------------------------------------------
+
+int32_t dl4j_parse_csv_f32(const char* buf, int64_t len, char delim,
+                           int32_t skip_rows, float* out, int64_t out_cap,
+                           int64_t* n_rows, int64_t* n_cols) {
+  int64_t rows = 0, cols = -1, written = 0;
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t row_idx = 0;
+  while (p < end) {
+    const char* line_end = (const char*)memchr(p, '\n', (size_t)(end - p));
+    if (!line_end) line_end = end;
+    const char* le = line_end;
+    if (le > p && le[-1] == '\r') --le;
+    // Whitespace-only lines are not rows and do not count toward
+    // skip_rows (matching the Python fallback's strip-then-skip).
+    bool blank = true;
+    for (const char* q = p; q < le; ++q) {
+      if (*q != ' ' && *q != '\t') { blank = false; break; }
+    }
+    if (!blank) {
+      if (row_idx++ >= skip_rows) {
+        int64_t c = 0;
+        const char* f = p;
+        while (f <= le) {
+          const char* fe = f;
+          while (fe < le && *fe != delim) ++fe;
+          if (out) {
+            char tmp[64];
+            size_t flen = (size_t)(fe - f);
+            if (flen == 0 || flen >= sizeof(tmp)) return -3;
+            memcpy(tmp, f, flen);
+            tmp[flen] = 0;
+            char* conv_end = nullptr;
+            float val = strtof(tmp, &conv_end);
+            while (*conv_end == ' ' || *conv_end == '\t') ++conv_end;
+            if (conv_end != tmp + flen) return -3;  // trailing garbage
+            if (written >= out_cap) return -2;
+            out[written++] = val;
+          }
+          ++c;
+          if (fe >= le) break;
+          f = fe + 1;
+        }
+        if (cols < 0) cols = c;
+        else if (c != cols) return -1;
+        ++rows;
+      }
+    }
+    p = line_end + 1;
+  }
+  *n_rows = rows;
+  *n_cols = cols < 0 ? 0 : cols;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// IDX (MNIST-style ubyte) → float32 with scaling (reference: the
+// MnistDataSetIterator fetch path decompresses IDX and normalizes).
+// Header: magic(4) | dims... (4 bytes each, big-endian). Returns rank, fills
+// shape[8]; data_out (if non-null) receives all elements * scale.
+// ---------------------------------------------------------------------------
+
+int32_t dl4j_parse_idx(const uint8_t* buf, int64_t len, float scale,
+                       float* data_out, int64_t out_cap, int64_t* shape) {
+  if (len < 4) return -1;
+  if (buf[0] != 0 || buf[1] != 0) return -1;
+  uint8_t dtype = buf[2];
+  int32_t rank = buf[3];
+  if (dtype != 0x08 || rank < 1 || rank > 8) return -1;  // ubyte only
+  if (len < 4 + 4 * rank) return -1;
+  int64_t total = 1;
+  for (int32_t d = 0; d < rank; ++d) {
+    const uint8_t* q = buf + 4 + 4 * d;
+    int64_t dim = ((int64_t)q[0] << 24) | ((int64_t)q[1] << 16) |
+                  ((int64_t)q[2] << 8) | (int64_t)q[3];
+    shape[d] = dim;
+    total *= dim;
+  }
+  if (len < 4 + 4 * rank + total) return -1;
+  if (data_out) {
+    if (out_cap < total) return -2;
+    const uint8_t* data = buf + 4 + 4 * rank;
+    for (int64_t i = 0; i < total; ++i) data_out[i] = data[i] * scale;
+  }
+  return rank;
+}
+
+// ---------------------------------------------------------------------------
+// PPM/PGM image decode (reference: NativeImageLoader via OpenCV; without
+// network or OpenCV the local formats are netpbm). P5 = grayscale binary,
+// P6 = RGB binary, maxval <= 255. Output float32 HWC in [0, 1].
+// Returns 0 on success; fills h/w/c. data_out==nullptr → probe only.
+// ---------------------------------------------------------------------------
+
+static const char* skip_ws_comments(const char* p, const char* end) {
+  while (p < end) {
+    if (*p == '#') {
+      while (p < end && *p != '\n') ++p;
+    } else if (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r') {
+      ++p;
+    } else {
+      break;
+    }
+  }
+  return p;
+}
+
+static const char* read_int(const char* p, const char* end, int64_t* out) {
+  p = skip_ws_comments(p, end);
+  int64_t v = 0;
+  bool any = false;
+  while (p < end && *p >= '0' && *p <= '9') {
+    v = v * 10 + (*p - '0');
+    ++p;
+    any = true;
+  }
+  *out = any ? v : -1;
+  return p;
+}
+
+int32_t dl4j_decode_netpbm(const uint8_t* buf, int64_t len, float* data_out,
+                           int64_t out_cap, int64_t* h, int64_t* w,
+                           int64_t* c) {
+  const char* p = (const char*)buf;
+  const char* end = p + len;
+  if (len < 2 || p[0] != 'P') return -1;
+  int channels;
+  if (p[1] == '5') channels = 1;
+  else if (p[1] == '6') channels = 3;
+  else return -1;
+  p += 2;
+  int64_t width, height, maxval;
+  p = read_int(p, end, &width);
+  p = read_int(p, end, &height);
+  p = read_int(p, end, &maxval);
+  if (width <= 0 || height <= 0 || maxval <= 0 || maxval > 255) return -1;
+  if (p < end && (*p == '\n' || *p == ' ' || *p == '\t' || *p == '\r')) ++p;
+  int64_t total = width * height * channels;
+  if ((const char*)end - p < total) return -1;
+  *h = height;
+  *w = width;
+  *c = channels;
+  if (data_out) {
+    if (out_cap < total) return -2;
+    const uint8_t* d = (const uint8_t*)p;
+    float inv = 1.0f / (float)maxval;
+    for (int64_t i = 0; i < total; ++i) data_out[i] = d[i] * inv;
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Bilinear resize, float32 HWC (reference: DataVec ImageTransform resize;
+// half-pixel centers, the common convention).
+// ---------------------------------------------------------------------------
+
+void dl4j_resize_bilinear_f32(const float* src, int64_t sh, int64_t sw,
+                              int64_t ch, float* dst, int64_t dh, int64_t dw) {
+  float scale_y = (float)sh / (float)dh;
+  float scale_x = (float)sw / (float)dw;
+  for (int64_t y = 0; y < dh; ++y) {
+    float sy = ((float)y + 0.5f) * scale_y - 0.5f;
+    int64_t y0 = (int64_t)floorf(sy);
+    float fy = sy - (float)y0;
+    int64_t y1 = y0 + 1;
+    y0 = std::max<int64_t>(0, std::min(sh - 1, y0));
+    y1 = std::max<int64_t>(0, std::min(sh - 1, y1));
+    for (int64_t x = 0; x < dw; ++x) {
+      float sx = ((float)x + 0.5f) * scale_x - 0.5f;
+      int64_t x0 = (int64_t)floorf(sx);
+      float fx = sx - (float)x0;
+      int64_t x1 = x0 + 1;
+      x0 = std::max<int64_t>(0, std::min(sw - 1, x0));
+      x1 = std::max<int64_t>(0, std::min(sw - 1, x1));
+      for (int64_t k = 0; k < ch; ++k) {
+        float v00 = src[(y0 * sw + x0) * ch + k];
+        float v01 = src[(y0 * sw + x1) * ch + k];
+        float v10 = src[(y1 * sw + x0) * ch + k];
+        float v11 = src[(y1 * sw + x1) * ch + k];
+        float top = v00 + (v01 - v00) * fx;
+        float bot = v10 + (v11 - v10) * fx;
+        dst[(y * dw + x) * ch + k] = top + (bot - top) * fy;
+      }
+    }
+  }
+}
+
+// Normalize in place: (x - mean[c]) / std[c], HWC layout.
+void dl4j_normalize_hwc_f32(float* data, int64_t h, int64_t w, int64_t c,
+                            const float* mean, const float* stddev) {
+  int64_t hw = h * w;
+  for (int64_t i = 0; i < hw; ++i)
+    for (int64_t k = 0; k < c; ++k)
+      data[i * c + k] = (data[i * c + k] - mean[k]) / stddev[k];
+}
+
+int32_t dl4j_native_version() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,191 @@
+"""libdl4jtpu native runtime vs pure-NumPy fallback parity.
+
+Mirrors the reference's CPU-vs-GPU kernel cross-checks (SURVEY.md §4): the
+same inputs must produce the same outputs through the C++ path and the
+fallback path. Native build happens on first use (native/build.sh)."""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    if not native.available():
+        subprocess.run(["sh", os.path.join(_REPO, "native", "build.sh")],
+                       check=True, capture_output=True)
+        native._tried = False  # retry load
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    return native
+
+
+def _fallback(fn, *args, **kw):
+    """Run a native.py function with the library disabled."""
+    saved = native._lib
+    native._lib = None
+    tried = native._tried
+    native._tried = True
+    os.environ["DL4J_TPU_DISABLE_NATIVE"] = "1"
+    try:
+        return fn(*args, **kw)
+    finally:
+        del os.environ["DL4J_TPU_DISABLE_NATIVE"]
+        native._lib = saved
+        native._tried = tried
+
+
+def test_threshold_encode_decode_roundtrip(native_lib):
+    rng = np.random.RandomState(0)
+    grad = rng.randn(1000).astype(np.float32) * 0.01
+    grad_native = grad.copy()
+    grad_fb = grad.copy()
+    thr = 0.012
+
+    enc_n = native.threshold_encode(grad_native, thr)
+    enc_f = _fallback(native.threshold_encode, grad_fb, thr)
+    np.testing.assert_array_equal(enc_n, enc_f)
+    np.testing.assert_allclose(grad_native, grad_fb, atol=1e-7)  # residuals
+
+    tgt_n = np.zeros(1000, np.float32)
+    tgt_f = np.zeros(1000, np.float32)
+    native.threshold_decode(enc_n, thr, tgt_n)
+    _fallback(native.threshold_decode, enc_f, thr, tgt_f)
+    np.testing.assert_allclose(tgt_n, tgt_f, atol=1e-7)
+    # encode(x) then decode ≈ clip-to-threshold of original signal
+    mask = np.abs(grad) > thr
+    np.testing.assert_allclose(tgt_n[mask],
+                               np.sign(grad[mask]) * thr, atol=1e-6)
+    assert not np.any(tgt_n[~mask])
+
+
+def test_threshold_encode_overflow_returns_none(native_lib):
+    grad = np.ones(100, np.float32)
+    assert native.threshold_encode(grad.copy(), 0.5, max_elements=10) is None
+    assert _fallback(native.threshold_encode, grad.copy(), 0.5,
+                     max_elements=10) is None
+
+
+def test_bitmap_encode_decode(native_lib):
+    rng = np.random.RandomState(1)
+    grad = rng.randn(257).astype(np.float32)  # odd size exercises padding
+    thr = 0.8
+    gn, gf = grad.copy(), grad.copy()
+    bm_n, cnt_n = native.bitmap_encode(gn, thr)
+    bm_f, cnt_f = _fallback(native.bitmap_encode, gf, thr)
+    assert cnt_n == cnt_f
+    np.testing.assert_array_equal(bm_n, bm_f)
+    np.testing.assert_allclose(gn, gf, atol=1e-7)
+    tgt_n = np.zeros(257, np.float32)
+    tgt_f = np.zeros(257, np.float32)
+    native.bitmap_decode(bm_n, 257, thr, tgt_n)
+    _fallback(native.bitmap_decode, bm_f, 257, thr, tgt_f)
+    np.testing.assert_allclose(tgt_n, tgt_f, atol=1e-7)
+
+
+def test_parse_csv(native_lib):
+    text = b"a,b,c\n1.5,2,3\n4,-5.25,6e2\n"
+    out = native.parse_csv(text, skip_rows=1)
+    expect = np.array([[1.5, 2, 3], [4, -5.25, 600]], np.float32)
+    np.testing.assert_allclose(out, expect)
+    np.testing.assert_allclose(_fallback(native.parse_csv, text,
+                                         skip_rows=1), expect)
+
+
+def test_parse_csv_ragged_raises(native_lib):
+    with pytest.raises(ValueError):
+        native.parse_csv(b"1,2\n3,4,5\n")
+    with pytest.raises(ValueError):
+        _fallback(native.parse_csv, b"1,2\n3,4,5\n")
+
+
+def test_parse_idx(native_lib):
+    # rank-3 IDX: 2 images of 3x2
+    header = bytes([0, 0, 0x08, 3]) + (2).to_bytes(4, "big") \
+        + (3).to_bytes(4, "big") + (2).to_bytes(4, "big")
+    data = bytes(range(12))
+    buf = header + data
+    out = native.parse_idx(buf, scale=1 / 255.0)
+    assert out.shape == (2, 3, 2)
+    np.testing.assert_allclose(out.reshape(-1),
+                               np.arange(12, dtype=np.float32) / 255.0)
+    np.testing.assert_allclose(_fallback(native.parse_idx, buf,
+                                         scale=1 / 255.0), out)
+
+
+def test_decode_netpbm(native_lib):
+    w, h = 4, 3
+    pix = bytes(range(w * h * 3))
+    buf = b"P6\n# comment\n4 3\n255\n" + pix
+    img = native.decode_netpbm(buf)
+    assert img.shape == (3, 4, 3)
+    np.testing.assert_allclose(
+        img.reshape(-1), np.arange(36, dtype=np.float32) / 255.0, atol=1e-7)
+    np.testing.assert_allclose(_fallback(native.decode_netpbm, buf), img)
+    gray = b"P5\n2 2\n255\n" + bytes([0, 128, 255, 64])
+    g = native.decode_netpbm(gray)
+    assert g.shape == (2, 2, 1)
+    np.testing.assert_allclose(_fallback(native.decode_netpbm, gray), g)
+
+
+def test_resize_bilinear(native_lib):
+    rng = np.random.RandomState(2)
+    img = rng.rand(7, 5, 3).astype(np.float32)
+    out_n = native.resize_bilinear(img, 14, 10)
+    out_f = _fallback(native.resize_bilinear, img, 14, 10)
+    assert out_n.shape == (14, 10, 3)
+    np.testing.assert_allclose(out_n, out_f, atol=1e-5)
+    # identity resize is exact
+    np.testing.assert_allclose(native.resize_bilinear(img, 7, 5), img,
+                               atol=1e-6)
+
+
+def test_normalize_hwc(native_lib):
+    rng = np.random.RandomState(3)
+    img = rng.rand(4, 4, 3).astype(np.float32)
+    mean = [0.485, 0.456, 0.406]
+    std = [0.229, 0.224, 0.225]
+    out_n = native.normalize_hwc(img.copy(), mean, std)
+    out_f = _fallback(native.normalize_hwc, img.copy(), mean, std)
+    np.testing.assert_allclose(out_n, out_f, atol=1e-6)
+    np.testing.assert_allclose(out_n, (img - mean) / std, atol=1e-6)
+
+
+def test_version(native_lib):
+    assert native._load().dl4j_native_version() == 1
+
+
+def test_threshold_encode_overflow_leaves_grad_untouched(native_lib):
+    grad = np.ones(100, np.float32)
+    g = grad.copy()
+    assert native.threshold_encode(g, 0.5, max_elements=10) is None
+    np.testing.assert_array_equal(g, grad)  # no partial residual subtraction
+
+
+def test_parse_csv_blank_lines_skipped(native_lib):
+    text = b"1,2\n   \n3,4\n"
+    expect = np.array([[1, 2], [3, 4]], np.float32)
+    np.testing.assert_allclose(native.parse_csv(text), expect)
+    np.testing.assert_allclose(_fallback(native.parse_csv, text), expect)
+
+
+def test_parse_csv_garbage_rejected(native_lib):
+    for bad in (b"1.5abc,2\n3,4\n", b"1,,2\n"):
+        with pytest.raises(ValueError):
+            native.parse_csv(bad)
+        with pytest.raises(ValueError):
+            _fallback(native.parse_csv, bad)
+
+
+def test_netpbm_16bit_rejected_both_paths(native_lib):
+    buf = b"P5\n2 2\n65535\n" + bytes(8)
+    with pytest.raises(ValueError):
+        native.decode_netpbm(buf)
+    with pytest.raises(ValueError):
+        _fallback(native.decode_netpbm, buf)
