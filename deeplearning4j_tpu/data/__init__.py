@@ -1,3 +1,30 @@
 from .dataset import DataSet, MultiDataSet
+from .records import (
+    CollectionRecordReader,
+    CSVRecordReader,
+    CSVSequenceRecordReader,
+    ImageRecordReader,
+    LineRecordReader,
+    RecordReader,
+    RecordReaderDataSetIterator,
+)
+from .transform import (
+    Schema,
+    TransformProcess,
+    TransformProcessRecordReader,
+)
 
-__all__ = ["DataSet", "MultiDataSet"]
+__all__ = [
+    "CollectionRecordReader",
+    "CSVRecordReader",
+    "CSVSequenceRecordReader",
+    "DataSet",
+    "ImageRecordReader",
+    "LineRecordReader",
+    "MultiDataSet",
+    "RecordReader",
+    "RecordReaderDataSetIterator",
+    "Schema",
+    "TransformProcess",
+    "TransformProcessRecordReader",
+]

@@ -1,0 +1,233 @@
+"""Record readers — the DataVec ingestion tier.
+
+Reference: org.datavec.api.records.reader.RecordReader and its zoo
+(CSVRecordReader, LineRecordReader, CSVSequenceRecordReader,
+ImageRecordReader — SURVEY.md §2.2 "DataVec API"/"DataVec image"). A record
+is a list of field values (float or str — the reference's Writable
+hierarchy collapses to plain Python values; NDArrayWritable is an ndarray).
+
+Readers are restartable iterables; ``RecordReaderDataSetIterator`` bridges
+records to the training tier's :class:`~deeplearning4j_tpu.data.dataset.DataSet`
+batches. Hot parse loops (CSV, netpbm decode, resize) go through the native
+library (deeplearning4j_tpu.native / libdl4jtpu) when built.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .. import native
+from .dataset import DataSet
+
+Writable = Union[float, int, str, np.ndarray]
+Record = List[Writable]
+
+
+class RecordReader:
+    """SPI: restartable stream of records."""
+
+    def __iter__(self) -> Iterator[Record]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        """Default: readers here re-create their state in __iter__."""
+
+    def labels(self) -> Optional[List[str]]:
+        """Label vocabulary, for readers that define one (images)."""
+        return None
+
+
+class CollectionRecordReader(RecordReader):
+    """Wraps an in-memory collection of records (reference:
+    CollectionRecordReader)."""
+
+    def __init__(self, records: Sequence[Record]) -> None:
+        self._records = list(records)
+
+    def __iter__(self) -> Iterator[Record]:
+        return iter(self._records)
+
+
+class LineRecordReader(RecordReader):
+    """One record per line: ``[line]`` (reference: LineRecordReader)."""
+
+    def __init__(self, path: str, encoding: str = "utf-8") -> None:
+        self.path = path
+        self.encoding = encoding
+
+    def __iter__(self) -> Iterator[Record]:
+        with open(self.path, "r", encoding=self.encoding) as f:
+            for line in f:
+                yield [line.rstrip("\n").rstrip("\r")]
+
+
+def _convert_field(field: str) -> Writable:
+    try:
+        return float(field)
+    except ValueError:
+        return field
+
+
+class CSVRecordReader(RecordReader):
+    """Delimited text → records (reference: CSVRecordReader).
+
+    With ``numeric=True`` the whole file is parsed by the native fast path
+    into a float32 matrix (raising on non-numeric data); otherwise each
+    field falls back from float to str individually.
+    """
+
+    def __init__(self, path: str, *, delimiter: str = ",",
+                 skip_lines: int = 0, numeric: bool = False) -> None:
+        self.path = path
+        self.delimiter = delimiter
+        self.skip_lines = int(skip_lines)
+        self.numeric = bool(numeric)
+
+    def __iter__(self) -> Iterator[Record]:
+        if self.numeric:
+            with open(self.path, "rb") as f:
+                matrix = native.parse_csv(f.read(), self.delimiter,
+                                          self.skip_lines)
+            for row in matrix:
+                yield [float(v) for v in row]
+            return
+        with open(self.path, "r") as f:
+            skipped = 0
+            for line in f:
+                line = line.rstrip("\n").rstrip("\r")
+                if not line.strip():
+                    continue
+                if skipped < self.skip_lines:
+                    skipped += 1
+                    continue
+                yield [_convert_field(x) for x in line.split(self.delimiter)]
+
+
+class CSVSequenceRecordReader(RecordReader):
+    """Sequence reader: one CSV file per sequence (reference:
+    CSVSequenceRecordReader). Each record is a [timesteps, fields] list of
+    per-step field lists."""
+
+    def __init__(self, paths: Sequence[str], *, delimiter: str = ",",
+                 skip_lines: int = 0) -> None:
+        self.paths = list(paths)
+        self.delimiter = delimiter
+        self.skip_lines = int(skip_lines)
+
+    def __iter__(self) -> Iterator[List[Record]]:
+        for p in self.paths:
+            reader = CSVRecordReader(p, delimiter=self.delimiter,
+                                     skip_lines=self.skip_lines)
+            yield list(reader)
+
+
+class ImageRecordReader(RecordReader):
+    """Image directory reader (reference: ImageRecordReader +
+    NativeImageLoader — SURVEY.md §2.2 'the ImageNet input path').
+
+    Walks ``root`` for netpbm images (P5/P6 — the local no-OpenCV format),
+    decodes + bilinearly resizes to [height, width, channels], and when
+    ``label_from_path`` appends the parent-directory label index. Record:
+    ``[ndarray(h, w, c), label_idx]``.
+    """
+
+    EXTENSIONS = (".ppm", ".pgm", ".pnm")
+
+    def __init__(self, height: int, width: int, channels: int = 3, *,
+                 root: Optional[str] = None,
+                 paths: Optional[Sequence[str]] = None,
+                 label_from_path: bool = True) -> None:
+        if (root is None) == (paths is None):
+            raise ValueError("provide exactly one of root= or paths=")
+        self.height, self.width, self.channels = height, width, channels
+        self.label_from_path = label_from_path
+        if root is not None:
+            found: List[str] = []
+            for dirpath, _dirnames, filenames in sorted(os.walk(root)):
+                for fn in sorted(filenames):
+                    if fn.lower().endswith(self.EXTENSIONS):
+                        found.append(os.path.join(dirpath, fn))
+            self.paths = found
+        else:
+            self.paths = list(paths)  # type: ignore[arg-type]
+        self._labels = sorted({os.path.basename(os.path.dirname(p))
+                               for p in self.paths}) if label_from_path else []
+
+    def labels(self) -> Optional[List[str]]:
+        return self._labels or None
+
+    def _load(self, path: str) -> np.ndarray:
+        with open(path, "rb") as f:
+            img = native.decode_netpbm(f.read())
+        if img.shape[:2] != (self.height, self.width):
+            img = native.resize_bilinear(img, self.height, self.width)
+        if img.shape[2] != self.channels:
+            if self.channels == 3 and img.shape[2] == 1:
+                img = np.repeat(img, 3, axis=2)
+            elif self.channels == 1 and img.shape[2] == 3:
+                img = img.mean(axis=2, keepdims=True)
+            else:
+                raise ValueError(
+                    f"cannot adapt {img.shape[2]} channels to "
+                    f"{self.channels}: {path}")
+        return img
+
+    def __iter__(self) -> Iterator[Record]:
+        for p in self.paths:
+            rec: Record = [self._load(p)]
+            if self.label_from_path:
+                rec.append(self._labels.index(
+                    os.path.basename(os.path.dirname(p))))
+            yield rec
+
+
+class RecordReaderDataSetIterator:
+    """Records → DataSet batches (reference:
+    org.deeplearning4j.datasets.datavec.RecordReaderDataSetIterator).
+
+    ``label_index`` selects the label field (negative indexes allowed);
+    classification one-hots it to ``num_classes``, regression keeps the
+    raw value(s). ndarray features (image readers) are stacked as-is.
+    """
+
+    def __init__(self, reader: RecordReader, batch_size: int, *,
+                 label_index: int = -1, num_classes: Optional[int] = None,
+                 regression: bool = False) -> None:
+        self.reader = reader
+        self.batch_size = int(batch_size)
+        self.label_index = label_index
+        self.num_classes = num_classes
+        self.regression = regression
+        if not regression and num_classes is None:
+            raise ValueError("classification needs num_classes")
+
+    def __iter__(self) -> Iterator[DataSet]:
+        feats: List[np.ndarray] = []
+        labels: List[np.ndarray] = []
+        for rec in self.reader:
+            li = self.label_index if self.label_index >= 0 \
+                else len(rec) + self.label_index
+            label_val = rec[li]
+            fields = [v for i, v in enumerate(rec) if i != li]
+            if len(fields) == 1 and isinstance(fields[0], np.ndarray):
+                feats.append(np.asarray(fields[0], np.float32))
+            else:
+                feats.append(np.asarray([float(v) for v in fields],
+                                        np.float32))
+            if self.regression:
+                labels.append(np.asarray([float(label_val)], np.float32))
+            else:
+                onehot = np.zeros(self.num_classes, np.float32)
+                onehot[int(label_val)] = 1.0
+                labels.append(onehot)
+            if len(feats) == self.batch_size:
+                yield DataSet(np.stack(feats), np.stack(labels))
+                feats, labels = [], []
+        if feats:
+            yield DataSet(np.stack(feats), np.stack(labels))
+
+    def reset(self) -> None:
+        self.reader.reset()

@@ -54,6 +54,10 @@ def _try_build() -> None:
 
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
+    # Lock-free fast path once the load decision is final — codec calls run
+    # per gradient-sync step / per image and must not serialize on a mutex.
+    if _lib is not None or _tried:
+        return _lib
     with _lock:
         if _lib is not None or _tried:
             return _lib
@@ -123,6 +127,18 @@ def _fptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
 
 
+def _flat_f32_view(a: np.ndarray, what: str) -> np.ndarray:
+    """A mutation-safe flat view. reshape(-1) on a non-contiguous array
+    would COPY, making the in-place residual/decode semantics silently
+    no-ops on the caller's array — reject instead."""
+    if a.dtype != np.float32:
+        raise ValueError(f"{what} must be float32, got {a.dtype}")
+    if not a.flags.c_contiguous or not a.flags.writeable:
+        raise ValueError(f"{what} must be a writeable C-contiguous array "
+                         "(in-place semantics)")
+    return a.reshape(-1)  # guaranteed view for contiguous arrays
+
+
 # ---------------------------------------------------------------------------
 # Threshold / bitmap codecs (reference: encodeThresholdP1-P3, encodeBitmap —
 # the gradient-sharing wire format, SURVEY.md §2.4)
@@ -135,8 +151,7 @@ def threshold_encode(grad: np.ndarray, threshold: float,
     """Encode |g|>threshold entries as a sparse int32 stream, subtracting
     the threshold in place (residual / error feedback). Returns None when
     the encoding would exceed ``max_elements`` (fall back to bitmap)."""
-    flat = grad.reshape(-1)
-    assert flat.dtype == np.float32 and flat.flags.c_contiguous
+    flat = _flat_f32_view(grad, "grad")
     cap = int(max_elements) if max_elements is not None else flat.size
     lib = _load()
     if lib is not None:
@@ -157,8 +172,7 @@ def threshold_encode(grad: np.ndarray, threshold: float,
 def threshold_decode(encoded: np.ndarray, threshold: float,
                      target: np.ndarray) -> None:
     """target[|e|-1] += sign(e) * threshold for each encoded entry."""
-    flat = target.reshape(-1)
-    assert flat.dtype == np.float32 and flat.flags.c_contiguous
+    flat = _flat_f32_view(target, "target")
     lib = _load()
     if lib is not None:
         enc = np.ascontiguousarray(encoded, np.int32)
@@ -166,16 +180,17 @@ def threshold_decode(encoded: np.ndarray, threshold: float,
             enc.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), enc.size,
             ctypes.c_float(threshold), _fptr(flat), flat.size)
         return
-    idx = np.abs(encoded) - 1
-    np.add.at(flat, idx, np.sign(encoded).astype(np.float32) * threshold)
+    idx = np.abs(encoded).astype(np.int64) - 1
+    valid = (idx >= 0) & (idx < flat.size)  # skip corrupt entries (as in C)
+    np.add.at(flat, idx[valid],
+              np.sign(encoded[valid]).astype(np.float32) * threshold)
 
 
 def bitmap_encode(grad: np.ndarray, threshold: float
                   ) -> Tuple[np.ndarray, int]:
     """Dense 2-bit codec (00 zero / 01 +thr / 10 -thr), residual in place.
     Returns (bitmap bytes, count of non-zero codes)."""
-    flat = grad.reshape(-1)
-    assert flat.dtype == np.float32 and flat.flags.c_contiguous
+    flat = _flat_f32_view(grad, "grad")
     bitmap = np.zeros((flat.size + 3) // 4, np.uint8)
     lib = _load()
     if lib is not None:
@@ -199,8 +214,7 @@ def bitmap_encode(grad: np.ndarray, threshold: float
 
 def bitmap_decode(bitmap: np.ndarray, n: int, threshold: float,
                   target: np.ndarray) -> None:
-    flat = target.reshape(-1)
-    assert flat.dtype == np.float32 and flat.flags.c_contiguous
+    flat = _flat_f32_view(target, "target")
     lib = _load()
     if lib is not None:
         bm = np.ascontiguousarray(bitmap, np.uint8)
@@ -272,9 +286,14 @@ def parse_idx(buf: bytes, scale: float = 1.0) -> np.ndarray:
     if raw.size < 4 or raw[0] != 0 or raw[1] != 0 or raw[2] != 0x08:
         raise ValueError("bad IDX buffer (code -1)")
     rank = int(raw[3])
+    if rank < 1 or rank > 8 or raw.size < 4 + 4 * rank:
+        raise ValueError("bad IDX buffer (code -1)")
     dims = tuple(int.from_bytes(buf[4 + 4 * d:8 + 4 * d], "big")
                  for d in range(rank))
-    data = raw[4 + 4 * rank:4 + 4 * rank + int(np.prod(dims))]
+    total = int(np.prod(dims))
+    if raw.size < 4 + 4 * rank + total:
+        raise ValueError("bad IDX buffer (code -1)")
+    data = raw[4 + 4 * rank:4 + 4 * rank + total]
     return (data.astype(np.float32) * scale).reshape(dims)
 
 

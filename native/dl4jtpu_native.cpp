@@ -144,6 +144,7 @@ int32_t dl4j_parse_csv_f32(const char* buf, int64_t len, char delim,
             tmp[flen] = 0;
             char* conv_end = nullptr;
             float val = strtof(tmp, &conv_end);
+            if (conv_end == tmp) return -3;  // nothing parsed (e.g. " ")
             while (*conv_end == ' ' || *conv_end == '\t') ++conv_end;
             if (conv_end != tmp + flen) return -3;  // trailing garbage
             if (written >= out_cap) return -2;

@@ -1,0 +1,175 @@
+"""DataVec-equivalent tests: record readers, schema transforms, and the
+record→DataSet bridge feeding a real fit() (SURVEY.md §2.2 DataVec rows)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native
+from deeplearning4j_tpu.data.records import (
+    CollectionRecordReader,
+    CSVRecordReader,
+    CSVSequenceRecordReader,
+    ImageRecordReader,
+    LineRecordReader,
+    RecordReaderDataSetIterator,
+)
+from deeplearning4j_tpu.data.transform import (
+    Schema,
+    TransformProcess,
+    TransformProcessRecordReader,
+)
+
+
+@pytest.fixture
+def csv_file(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("f1,f2,label\n1.0,2.0,0\n3.0,4.0,1\n5.0,6.0,2\n7.0,8.0,0\n")
+    return str(p)
+
+
+def test_csv_record_reader(csv_file):
+    recs = list(CSVRecordReader(csv_file, skip_lines=1))
+    assert recs == [[1.0, 2.0, 0.0], [3.0, 4.0, 1.0], [5.0, 6.0, 2.0],
+                    [7.0, 8.0, 0.0]]
+    # header row read as strings without skip
+    recs0 = list(CSVRecordReader(csv_file))
+    assert recs0[0] == ["f1", "f2", "label"]
+    # numeric fast path (native CSV parser)
+    recs_n = list(CSVRecordReader(csv_file, skip_lines=1, numeric=True))
+    assert recs_n == recs
+
+
+def test_line_and_collection_readers(tmp_path):
+    p = tmp_path / "lines.txt"
+    p.write_text("hello\nworld\n")
+    assert list(LineRecordReader(str(p))) == [["hello"], ["world"]]
+    cr = CollectionRecordReader([[1, 2], [3, 4]])
+    assert list(cr) == [[1, 2], [3, 4]]
+    assert list(cr) == [[1, 2], [3, 4]]  # restartable
+
+
+def test_csv_sequence_reader(tmp_path):
+    for i, content in enumerate(["1,2\n3,4\n", "5,6\n"]):
+        (tmp_path / f"seq{i}.csv").write_text(content)
+    reader = CSVSequenceRecordReader(
+        [str(tmp_path / "seq0.csv"), str(tmp_path / "seq1.csv")])
+    seqs = list(reader)
+    assert seqs == [[[1.0, 2.0], [3.0, 4.0]], [[5.0, 6.0]]]
+
+
+def _write_ppm(path, h, w, value):
+    data = bytes([value]) * (h * w * 3)
+    path.write_bytes(b"P6\n%d %d\n255\n" % (w, h) + data)
+
+
+def test_image_record_reader(tmp_path):
+    for label, value in [("cat", 10), ("dog", 200)]:
+        d = tmp_path / label
+        d.mkdir()
+        for i in range(2):
+            _write_ppm(d / f"{i}.ppm", 6, 8, value)
+    reader = ImageRecordReader(4, 4, 3, root=str(tmp_path))
+    assert reader.labels() == ["cat", "dog"]
+    recs = list(reader)
+    assert len(recs) == 4
+    img, label = recs[0]
+    assert img.shape == (4, 4, 3)
+    np.testing.assert_allclose(img, 10 / 255.0, atol=1e-6)
+    assert label == 0
+    assert recs[-1][1] == 1
+
+
+def test_record_reader_dataset_iterator(csv_file):
+    reader = CSVRecordReader(csv_file, skip_lines=1)
+    it = RecordReaderDataSetIterator(reader, batch_size=3, label_index=-1,
+                                     num_classes=3)
+    batches = list(it)
+    assert [b.num_examples() for b in batches] == [3, 1]
+    np.testing.assert_allclose(batches[0].features,
+                               [[1, 2], [3, 4], [5, 6]])
+    np.testing.assert_allclose(batches[0].labels,
+                               [[1, 0, 0], [0, 1, 0], [0, 0, 1]])
+
+
+def test_regression_iterator(csv_file):
+    reader = CSVRecordReader(csv_file, skip_lines=1)
+    it = RecordReaderDataSetIterator(reader, batch_size=4, label_index=0,
+                                     regression=True)
+    (batch,) = list(it)
+    np.testing.assert_allclose(batch.features, [[2, 0], [4, 1], [6, 2],
+                                                [8, 0]])
+    np.testing.assert_allclose(batch.labels, [[1], [3], [5], [7]])
+
+
+def test_schema_and_transform_process():
+    schema = (Schema.builder()
+              .add_double_column("x")
+              .add_categorical_column("color", ["red", "green"])
+              .add_string_column("junk")
+              .build())
+    tp = (TransformProcess.builder(schema)
+          .remove_columns("junk")
+          .double_math_op("x", "multiply", 2.0)
+          .min_max_normalize("x", 0.0, 10.0)
+          .categorical_to_one_hot("color")
+          .build())
+    final = tp.final_schema()
+    assert final.names() == ["x", "color[red]", "color[green]"]
+    out = tp.execute([[1.0, "red", "a"], [5.0, "green", "b"]])
+    np.testing.assert_allclose(out, [[0.2, 1, 0], [1.0, 0, 1]])
+
+
+def test_transform_process_json_roundtrip():
+    schema = (Schema.builder().add_double_column("x")
+              .add_categorical_column("c", ["a", "b"]).build())
+    tp = (TransformProcess.builder(schema)
+          .double_math_op("x", "add", 1.0)
+          .categorical_to_integer("c")
+          .conditional_filter("x", "gt", 100.0)
+          .build())
+    tp2 = TransformProcess.from_json(tp.to_json())
+    recs = [[1.0, "b"], [200.0, "a"]]
+    assert tp2.execute(recs) == tp.execute(recs) == [[2.0, 1]]
+
+
+def test_filters():
+    schema = Schema.builder().add_double_column("x").build()
+    tp = (TransformProcess.builder(schema).filter_invalid("x").build())
+    assert tp.execute([[1.0], [float("nan")], [2.0]]) == [[1.0], [2.0]]
+
+
+def test_build_validates_schema():
+    schema = Schema.builder().add_double_column("x").build()
+    with pytest.raises(KeyError):
+        TransformProcess.builder(schema).remove_columns("nope").build()
+
+
+def test_transform_reader_feeds_fit(csv_file):
+    """End-to-end DataVec path: CSV → transform → iterator → fit()."""
+    import jax
+
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+
+    schema = (Schema.builder().add_double_column("f1")
+              .add_double_column("f2").add_integer_column("label").build())
+    tp = (TransformProcess.builder(schema)
+          .min_max_normalize("f1", 0.0, 8.0)
+          .min_max_normalize("f2", 0.0, 8.0)
+          .build())
+    reader = TransformProcessRecordReader(
+        CSVRecordReader(csv_file, skip_lines=1), tp)
+    it = RecordReaderDataSetIterator(reader, batch_size=4, num_classes=3)
+
+    conf = (NeuralNetConfiguration.builder().seed(1).list()
+            .layer(DenseLayer(n_in=2, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    model.fit(it, epochs=3)
+    out = model.output(np.array([[0.125, 0.25]], np.float32))
+    assert out.shape == (1, 3)
+    assert np.isfinite(np.asarray(out)).all()

@@ -189,3 +189,39 @@ def test_netpbm_16bit_rejected_both_paths(native_lib):
         native.decode_netpbm(buf)
     with pytest.raises(ValueError):
         _fallback(native.decode_netpbm, buf)
+
+
+def test_noncontiguous_inputs_rejected(native_lib):
+    grad = np.ones((10, 10), np.float32)
+    with pytest.raises(ValueError):
+        native.threshold_encode(grad[:, ::2], 0.5)
+    with pytest.raises(ValueError):
+        native.threshold_decode(np.array([1], np.int32), 0.5, grad.T)
+    with pytest.raises(ValueError):
+        native.bitmap_encode(grad[::2, ::2], 0.5)
+
+
+def test_threshold_decode_skips_corrupt_entries(native_lib):
+    tgt_n = np.zeros(4, np.float32)
+    tgt_f = np.zeros(4, np.float32)
+    enc = np.array([0, 2, 99, -99999], np.int32)  # 0 and out-of-range corrupt
+    native.threshold_decode(enc, 0.5, tgt_n)
+    _fallback(native.threshold_decode, enc, 0.5, tgt_f)
+    np.testing.assert_allclose(tgt_n, [0, 0.5, 0, 0])
+    np.testing.assert_allclose(tgt_f, tgt_n)
+
+
+def test_parse_csv_whitespace_field_rejected(native_lib):
+    with pytest.raises(ValueError):
+        native.parse_csv(b"1, ,3\n")
+    with pytest.raises(ValueError):
+        _fallback(native.parse_csv, b"1, ,3\n")
+
+
+def test_parse_idx_truncated_rejected_both_paths(native_lib):
+    for bad in (bytes([0, 0, 0x08, 3]),
+                bytes([0, 0, 0x08, 1]) + (10).to_bytes(4, "big") + bytes(3)):
+        with pytest.raises(ValueError):
+            native.parse_idx(bad)
+        with pytest.raises(ValueError):
+            _fallback(native.parse_idx, bad)
