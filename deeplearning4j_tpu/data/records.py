@@ -80,11 +80,13 @@ class CSVRecordReader(RecordReader):
     """
 
     def __init__(self, path: str, *, delimiter: str = ",",
-                 skip_lines: int = 0, numeric: bool = False) -> None:
+                 skip_lines: int = 0, numeric: bool = False,
+                 encoding: str = "utf-8") -> None:
         self.path = path
         self.delimiter = delimiter
         self.skip_lines = int(skip_lines)
         self.numeric = bool(numeric)
+        self.encoding = encoding
 
     def __iter__(self) -> Iterator[Record]:
         if self.numeric:
@@ -94,7 +96,7 @@ class CSVRecordReader(RecordReader):
             for row in matrix:
                 yield [float(v) for v in row]
             return
-        with open(self.path, "r") as f:
+        with open(self.path, "r", encoding=self.encoding) as f:
             skipped = 0
             for line in f:
                 line = line.rstrip("\n").rstrip("\r")
@@ -155,6 +157,11 @@ class ImageRecordReader(RecordReader):
             self.paths = list(paths)  # type: ignore[arg-type]
         self._labels = sorted({os.path.basename(os.path.dirname(p))
                                for p in self.paths}) if label_from_path else []
+        label_idx = {n: i for i, n in enumerate(self._labels)}
+        # per-path label resolved once — the iter loop is the ImageNet-scale
+        # hot path, no per-image string scans there
+        self._path_labels = [label_idx[os.path.basename(os.path.dirname(p))]
+                             for p in self.paths] if label_from_path else []
 
     def labels(self) -> Optional[List[str]]:
         return self._labels or None
@@ -176,11 +183,10 @@ class ImageRecordReader(RecordReader):
         return img
 
     def __iter__(self) -> Iterator[Record]:
-        for p in self.paths:
+        for i, p in enumerate(self.paths):
             rec: Record = [self._load(p)]
             if self.label_from_path:
-                rec.append(self._labels.index(
-                    os.path.basename(os.path.dirname(p))))
+                rec.append(self._path_labels[i])
             yield rec
 
 
@@ -220,8 +226,13 @@ class RecordReaderDataSetIterator:
             if self.regression:
                 labels.append(np.asarray([float(label_val)], np.float32))
             else:
+                cls = int(label_val)
+                if not 0 <= cls < self.num_classes:
+                    # explicit: numpy would silently wrap negative labels
+                    raise ValueError(
+                        f"label {cls} outside [0, {self.num_classes})")
                 onehot = np.zeros(self.num_classes, np.float32)
-                onehot[int(label_val)] = 1.0
+                onehot[cls] = 1.0
                 labels.append(onehot)
             if len(feats) == self.batch_size:
                 yield DataSet(np.stack(feats), np.stack(labels))

@@ -304,22 +304,27 @@ class TransformProcess:
                  ops: Sequence[TransformOp]) -> None:
         self.initial_schema = initial_schema
         self.ops = list(ops)
+        # schema before each op, resolved once — execute() (and the
+        # streaming per-record reader) must not rebuild/revalidate the
+        # schema chain per call
+        self._schemas: List[Schema] = []
+        schema = initial_schema
+        for op in self.ops:
+            self._schemas.append(schema)
+            _, schema = op.apply([], schema)
+        self._final = schema
 
     @staticmethod
     def builder(schema: Schema) -> "TransformProcessBuilder":
         return TransformProcessBuilder(schema)
 
     def final_schema(self) -> Schema:
-        schema = self.initial_schema
-        for op in self.ops:
-            _, schema = op.apply([], schema)
-        return schema
+        return self._final
 
     def execute(self, records: Sequence[Record]) -> List[Record]:
         out = [list(r) for r in records]
-        schema = self.initial_schema
-        for op in self.ops:
-            out, schema = op.apply(out, schema)
+        for op, schema in zip(self.ops, self._schemas):
+            out, _ = op.apply(out, schema)
         return out
 
     def to_json(self) -> str:

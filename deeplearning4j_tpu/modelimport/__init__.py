@@ -1,0 +1,3 @@
+from .keras import KerasModelImport
+
+__all__ = ["KerasModelImport"]

@@ -1,0 +1,404 @@
+"""Keras HDF5 model import.
+
+Reference: org.deeplearning4j.nn.modelimport.keras.KerasModelImport /
+KerasModel / ~60 KerasLayer mappers (SURVEY.md §2.2 "Keras import"):
+h5 parsing → config mapping → weight mapping, Sequential →
+MultiLayerNetwork and functional → ComputationGraph.
+
+Conventions handled here (the same dance the reference does):
+* Keras conv weights are HWIO channels-last; ours are OIHW over NCHW
+  activations — kernels transpose at import, and the first Dense after a
+  Flatten gets its rows permuted from NHWC-flatten order to our
+  channels-first flatten order.
+* Keras LSTM gate columns are [i, f, g, o]; ours are [i, f, o, g]
+  (reference LSTMParamInitializer order) — columns reorder at import.
+* BatchNormalization moving stats land in the model's state pytree.
+* Imported CNN models therefore take NCHW input; recurrent models take
+  [batch, features, time] (the reference's conventions throughout).
+
+Supports both Keras 2 ("kernel:0") and Keras 3 ("kernel") weight naming.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..nn.activations import Activation
+from ..nn.conf import NeuralNetConfiguration
+from ..nn.layers import (
+    ActivationLayer,
+    BatchNormalizationLayer,
+    CnnToFeedForwardPreProcessor,
+    ConvolutionLayer,
+    ConvolutionMode,
+    DenseLayer,
+    DropoutLayer,
+    GlobalPoolingLayer,
+    LastTimeStepLayer,
+    LSTMLayer,
+    PoolingType,
+    SubsamplingLayer,
+)
+from ..nn.sequential import MultiLayerNetwork
+
+_ACTIVATIONS = {
+    "linear": Activation.IDENTITY,
+    "relu": Activation.RELU,
+    "relu6": Activation.RELU6,
+    "sigmoid": Activation.SIGMOID,
+    "hard_sigmoid": Activation.HARDSIGMOID,
+    "tanh": Activation.TANH,
+    "softmax": Activation.SOFTMAX,
+    "softplus": Activation.SOFTPLUS,
+    "softsign": Activation.SOFTSIGN,
+    "selu": Activation.SELU,
+    "elu": Activation.ELU,
+    "gelu": Activation.GELU,
+    "swish": Activation.SWISH,
+    "silu": Activation.SWISH,
+    "mish": Activation.MISH,
+    "leaky_relu": Activation.LEAKYRELU,
+}
+
+# keras column order [i, f, g, o] → ours [i, f, o, g]
+_LSTM_GATE_PERM = (0, 1, 3, 2)
+
+
+class KerasImportError(ValueError):
+    pass
+
+
+def _map_activation(name: Optional[str]) -> Activation:
+    if name is None:
+        return Activation.IDENTITY
+    try:
+        return _ACTIVATIONS[name]
+    except KeyError:
+        raise KerasImportError(f"unsupported Keras activation {name!r}") from None
+
+
+def _collect_weights(group) -> Dict[str, np.ndarray]:
+    """Leaf datasets under a layer's weight group, keyed by basename with
+    any Keras-2 ':0' suffix stripped."""
+    import h5py
+
+    out: Dict[str, np.ndarray] = {}
+
+    def walk(g):
+        for k in g:
+            item = g[k]
+            if isinstance(item, h5py.Dataset):
+                out[k.split(":")[0]] = np.asarray(item)
+            else:
+                walk(item)
+
+    walk(group)
+    return out
+
+
+def _lstm_reorder(arr: np.ndarray, units: int) -> np.ndarray:
+    """Reorder fused gate columns keras→ours along the last axis."""
+    parts = np.split(arr, 4, axis=-1)
+    return np.concatenate([parts[p] for p in _LSTM_GATE_PERM], axis=-1)
+
+
+def _pad_mode(padding: str) -> ConvolutionMode:
+    if padding == "same":
+        return ConvolutionMode.SAME
+    if padding in ("valid", None):
+        return ConvolutionMode.TRUNCATE
+    raise KerasImportError(f"unsupported padding {padding!r}")
+
+
+def _conv_out(size: int, k: int, s: int, mode: ConvolutionMode) -> int:
+    if mode is ConvolutionMode.SAME:
+        return -(-size // s)
+    return (size - k) // s + 1
+
+
+class _Shape:
+    """Tracks the activation shape through a sequential stack, in OUR
+    conventions (conv: h/w/c over NCHW; rnn: features/timesteps)."""
+
+    def __init__(self, input_shape: Tuple[Optional[int], ...]) -> None:
+        # keras input_shape excludes batch: (h, w, c) or (t, f) or (n,)
+        if len(input_shape) == 3:
+            self.kind = "conv"
+            self.h, self.w, self.c = input_shape
+        elif len(input_shape) == 2:
+            self.kind = "rnn"
+            self.t, self.f = input_shape
+        elif len(input_shape) == 1:
+            self.kind = "ff"
+            self.n = input_shape[0]
+        else:
+            raise KerasImportError(f"unsupported input rank {input_shape}")
+
+
+class _SequentialImporter:
+    def __init__(self, layer_configs: List[dict], weights_by_layer) -> None:
+        self.configs = layer_configs
+        self.weights_by_layer = weights_by_layer
+        self.layers: List[Any] = []
+        self.params: Dict[str, Dict[str, np.ndarray]] = {}
+        self.state: Dict[str, Dict[str, np.ndarray]] = {}
+        self.shape: Optional[_Shape] = None
+        self.dense_perm: Optional[np.ndarray] = None  # post-Flatten fixup
+
+    def _add(self, layer, params=None, state=None):
+        self.layers.append(layer)
+        name = layer.name or f"layer_{len(self.layers) - 1}"
+        if params:
+            self.params[name] = params
+        if state:
+            self.state[name] = state
+
+    def run(self) -> Tuple[List[Any], dict, dict]:
+        for cfg in self.configs:
+            cls = cfg["class_name"]
+            conf = cfg["config"]
+            handler = getattr(self, f"_import_{cls}", None)
+            if cls == "InputLayer":
+                shape = conf.get("batch_shape") or conf.get(
+                    "batch_input_shape")
+                self.shape = _Shape(tuple(shape[1:]))
+                continue
+            if self.shape is None and "batch_input_shape" in conf:
+                self.shape = _Shape(tuple(conf["batch_input_shape"][1:]))
+            if handler is None:
+                raise KerasImportError(
+                    f"unsupported Keras layer {cls!r} ({conf.get('name')})")
+            if self.shape is None:
+                raise KerasImportError("no input shape before first layer")
+            handler(conf)
+        return self.layers, self.params, self.state
+
+    # --- per-class handlers -------------------------------------------
+
+    def _weights(self, conf) -> Dict[str, np.ndarray]:
+        return self.weights_by_layer.get(conf["name"], {})
+
+    def _import_Dense(self, conf):
+        s = self.shape
+        n_in = s.n if s.kind == "ff" else s.f
+        w = self._weights(conf)
+        kernel = w["kernel"]
+        if self.dense_perm is not None:
+            kernel = kernel[self.dense_perm]
+            self.dense_perm = None
+        params = {"W": kernel}
+        if conf.get("use_bias", True):
+            params["b"] = w["bias"]
+        self._add(DenseLayer(
+            name=conf["name"], n_in=int(n_in), n_out=int(conf["units"]),
+            activation=_map_activation(conf.get("activation")),
+            has_bias=conf.get("use_bias", True)), params)
+        if s.kind == "rnn":
+            s.f = conf["units"]  # TimeDistributed-style dense over features
+        else:
+            s.kind, s.n = "ff", conf["units"]
+
+    def _import_Conv2D(self, conf):
+        s = self.shape
+        if s.kind != "conv":
+            raise KerasImportError("Conv2D on non-convolutional input")
+        if conf.get("data_format") not in (None, "channels_last"):
+            raise KerasImportError("only channels_last Keras models supported")
+        mode = _pad_mode(conf.get("padding", "valid"))
+        kh, kw = conf["kernel_size"]
+        sh, sw = conf.get("strides", (1, 1))
+        w = self._weights(conf)
+        params = {"W": w["kernel"].transpose(3, 2, 0, 1)}  # HWIO → OIHW
+        if conf.get("use_bias", True):
+            params["b"] = w["bias"]
+        self._add(ConvolutionLayer(
+            name=conf["name"], n_in=int(s.c), n_out=int(conf["filters"]),
+            kernel_size=(kh, kw), stride=(sh, sw), convolution_mode=mode,
+            activation=_map_activation(conf.get("activation")),
+            has_bias=conf.get("use_bias", True)), params)
+        s.h = _conv_out(s.h, kh, sh, mode)
+        s.w = _conv_out(s.w, kw, sw, mode)
+        s.c = conf["filters"]
+
+    def _pool(self, conf, ptype):
+        s = self.shape
+        kh, kw = conf.get("pool_size", (2, 2))
+        st = conf.get("strides") or (kh, kw)
+        mode = _pad_mode(conf.get("padding", "valid"))
+        self._add(SubsamplingLayer(
+            name=conf["name"], kernel_size=(kh, kw), stride=tuple(st),
+            pooling_type=ptype, convolution_mode=mode))
+        s.h = _conv_out(s.h, kh, st[0], mode)
+        s.w = _conv_out(s.w, kw, st[1], mode)
+
+    def _import_MaxPooling2D(self, conf):
+        self._pool(conf, PoolingType.MAX)
+
+    def _import_AveragePooling2D(self, conf):
+        self._pool(conf, PoolingType.AVG)
+
+    def _import_GlobalAveragePooling2D(self, conf):
+        s = self.shape
+        self._add(GlobalPoolingLayer(name=conf["name"],
+                                     pooling_type=PoolingType.AVG))
+        s.kind, s.n = "ff", s.c
+
+    def _import_GlobalMaxPooling2D(self, conf):
+        s = self.shape
+        self._add(GlobalPoolingLayer(name=conf["name"],
+                                     pooling_type=PoolingType.MAX))
+        s.kind, s.n = "ff", s.c
+
+    def _import_Flatten(self, conf):
+        s = self.shape
+        if s.kind == "conv":
+            self._add(CnnToFeedForwardPreProcessor(
+                name=conf["name"], height=int(s.h), width=int(s.w),
+                channels=int(s.c)))
+            # keras flattens NHWC (c fastest); ours flattens NCHW (w fastest)
+            n = int(s.h * s.w * s.c)
+            self.dense_perm = (np.arange(n).reshape(s.h, s.w, s.c)
+                               .transpose(2, 0, 1).ravel())
+            s.kind, s.n = "ff", n
+        elif s.kind == "ff":
+            pass  # already flat
+        else:
+            raise KerasImportError("Flatten on recurrent input unsupported")
+
+    def _import_Dropout(self, conf):
+        # keras rate = drop probability; ours = retain probability
+        self._add(DropoutLayer(name=conf["name"],
+                               dropout=1.0 - float(conf["rate"])))
+
+    def _import_Activation(self, conf):
+        self._add(ActivationLayer(
+            name=conf["name"],
+            activation=_map_activation(conf.get("activation"))))
+
+    def _import_ReLU(self, conf):
+        if conf.get("max_value") not in (None, 6.0):
+            raise KerasImportError("ReLU max_value other than None/6 "
+                                   "unsupported")
+        act = Activation.RELU6 if conf.get("max_value") == 6.0 \
+            else Activation.RELU
+        self._add(ActivationLayer(name=conf["name"], activation=act))
+
+    def _import_BatchNormalization(self, conf):
+        s = self.shape
+        axis = conf.get("axis")
+        if isinstance(axis, list):
+            axis = axis[0]
+        rank = 4 if s.kind == "conv" else 2
+        if axis not in (None, -1, rank - 1):
+            raise KerasImportError("only channels-last BatchNormalization "
+                                   "supported")
+        n = s.c if s.kind == "conv" else (s.f if s.kind == "rnn" else s.n)
+        w = self._weights(conf)
+        params = {}
+        if conf.get("scale", True):
+            params["gamma"] = w["gamma"]
+        if conf.get("center", True):
+            params["beta"] = w["beta"]
+        state = {"mean": w["moving_mean"], "var": w["moving_variance"]}
+        self._add(BatchNormalizationLayer(
+            name=conf["name"], n_out=int(n), eps=float(conf.get(
+                "epsilon", 1e-3)), decay=float(conf.get("momentum", 0.99))),
+            params, state)
+
+    def _import_LSTM(self, conf):
+        s = self.shape
+        if s.kind != "rnn":
+            raise KerasImportError("LSTM needs sequence input")
+        if conf.get("activation", "tanh") != "tanh" or conf.get(
+                "recurrent_activation", "sigmoid") != "sigmoid":
+            raise KerasImportError("non-default LSTM activations unsupported")
+        units = int(conf["units"])
+        w = self._weights(conf)
+        params = {
+            "W": _lstm_reorder(w["kernel"], units),
+            "RW": _lstm_reorder(w["recurrent_kernel"], units),
+        }
+        if conf.get("use_bias", True):
+            params["b"] = _lstm_reorder(w["bias"], units)
+        self._add(LSTMLayer(name=conf["name"], n_in=int(s.f), n_out=units),
+                  params)
+        s.f = units
+        if not conf.get("return_sequences", False):
+            self._add(LastTimeStepLayer(name=conf["name"] + "_last"))
+            s.kind, s.n = "ff", units
+
+
+class KerasModelImport:
+    """Reference API: KerasModelImport.importKerasModelAndWeights()."""
+
+    @staticmethod
+    def import_keras_sequential_model_and_weights(
+            path: str) -> MultiLayerNetwork:
+        model = KerasModelImport.import_keras_model_and_weights(path)
+        if not isinstance(model, MultiLayerNetwork):
+            raise KerasImportError("model is not Sequential")
+        return model
+
+    @staticmethod
+    def import_keras_model_and_weights(path: str):
+        import h5py
+
+        with h5py.File(path, "r") as f:
+            if "model_config" not in f.attrs:
+                raise KerasImportError(
+                    "no model_config attribute — not a Keras h5 model file")
+            raw = f.attrs["model_config"]
+            if isinstance(raw, bytes):
+                raw = raw.decode()
+            cfg = json.loads(raw)
+            weights_by_layer: Dict[str, Dict[str, np.ndarray]] = {}
+            wg = f["model_weights"] if "model_weights" in f else f
+            for lname in wg:
+                weights_by_layer[lname] = _collect_weights(wg[lname])
+
+        if cfg["class_name"] != "Sequential":
+            raise KerasImportError(
+                f"unsupported model class {cfg['class_name']!r} (functional "
+                "import: use the TF GraphDef path, samediff/tf_import.py)")
+        layer_cfgs = cfg["config"]["layers"]
+        importer = _SequentialImporter(layer_cfgs, weights_by_layer)
+        layers, params, state = importer.run()
+
+        # As in the reference importer: a trailing Dense becomes an
+        # OutputLayer with a matching loss, so the imported model is
+        # directly trainable (fit/score). Forward behavior is identical.
+        if layers and isinstance(layers[-1], DenseLayer):
+            from ..nn.layers import OutputLayer
+            from ..nn.losses import LossFunction
+
+            last = layers[-1]
+            act = last.activation or Activation.IDENTITY
+            loss = {Activation.SOFTMAX: LossFunction.MCXENT,
+                    Activation.SIGMOID: LossFunction.XENT}.get(
+                        act, LossFunction.MSE)
+            layers[-1] = OutputLayer(
+                name=last.name, n_in=last.n_in, n_out=last.n_out,
+                activation=act, has_bias=last.has_bias, loss=loss)
+
+        lb = NeuralNetConfiguration.builder().list()
+        for layer in layers:
+            lb.layer(layer)
+        model = MultiLayerNetwork(lb.build()).init()
+        dtype = model.dtype
+        for lname, lparams in params.items():
+            if lname not in model.params:
+                raise KerasImportError(f"internal: no params slot {lname}")
+            for pname, arr in lparams.items():
+                have = model.params[lname][pname]
+                if tuple(have.shape) != tuple(arr.shape):
+                    raise KerasImportError(
+                        f"shape mismatch for {lname}/{pname}: "
+                        f"{arr.shape} vs {have.shape}")
+                model.params[lname][pname] = np.asarray(arr, dtype)
+        for lname, lstate in state.items():
+            for sname, arr in lstate.items():
+                model.state[lname][sname] = np.asarray(arr, dtype)
+        return model

@@ -61,22 +61,26 @@ def _load() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _tried:
             return _lib
-        _tried = True
-        if os.environ.get("DL4J_TPU_DISABLE_NATIVE") == "1":
+        try:
+            if os.environ.get("DL4J_TPU_DISABLE_NATIVE") == "1":
+                return None
+            for attempt in range(2):
+                for p in _LIB_PATHS:
+                    if os.path.exists(p):
+                        try:
+                            lib = ctypes.CDLL(p)
+                        except OSError:
+                            continue
+                        _declare(lib)
+                        _lib = lib
+                        return _lib
+                if attempt == 0:
+                    _try_build()
             return None
-        for attempt in range(2):
-            for p in _LIB_PATHS:
-                if os.path.exists(p):
-                    try:
-                        lib = ctypes.CDLL(p)
-                    except OSError:
-                        continue
-                    _declare(lib)
-                    _lib = lib
-                    return _lib
-            if attempt == 0:
-                _try_build()
-        return None
+        finally:
+            # only now is the decision final — setting _tried earlier would
+            # let lock-free readers fall back mid-load/build
+            _tried = True
 
 
 def _declare(lib: ctypes.CDLL) -> None:

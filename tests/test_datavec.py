@@ -173,3 +173,10 @@ def test_transform_reader_feeds_fit(csv_file):
     out = model.output(np.array([[0.125, 0.25]], np.float32))
     assert out.shape == (1, 3)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_negative_label_rejected():
+    reader = CollectionRecordReader([[1.0, -1]])
+    it = RecordReaderDataSetIterator(reader, batch_size=1, num_classes=3)
+    with pytest.raises(ValueError, match="label -1"):
+        list(it)

@@ -1,0 +1,119 @@
+"""Keras h5 import golden tests (SURVEY.md §4 "Keras import": golden
+outputs from Keras for each saved model). Models are built and saved with
+the local TF/Keras, imported, and forward outputs compared on random data."""
+
+import os
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = tf.keras
+
+from deeplearning4j_tpu.modelimport import KerasModelImport  # noqa: E402
+from deeplearning4j_tpu.modelimport.keras import KerasImportError  # noqa: E402
+
+
+def _import_and_compare(tmp_path, kmodel, x_keras, to_ours, atol=1e-4):
+    path = str(tmp_path / "model.h5")
+    kmodel.save(path)
+    expected = np.asarray(kmodel(x_keras))
+    ours = KerasModelImport.import_keras_model_and_weights(path)
+    got = np.asarray(ours.output(to_ours(x_keras)))
+    np.testing.assert_allclose(got, expected, atol=atol, rtol=1e-3)
+    return ours
+
+
+def test_mlp_import(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((12,)),
+        keras.layers.Dense(16, activation="relu"),
+        keras.layers.Dense(8, activation="tanh"),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    x = np.random.RandomState(0).randn(4, 12).astype(np.float32)
+    _import_and_compare(tmp_path, m, x, lambda a: a)
+
+
+def test_cnn_import_with_flatten_permutation(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((10, 8, 3)),
+        keras.layers.Conv2D(6, 3, padding="same", activation="relu"),
+        keras.layers.MaxPooling2D(2),
+        keras.layers.Conv2D(4, 3, padding="valid", strides=2,
+                            activation="linear"),
+        keras.layers.Flatten(),
+        keras.layers.Dense(5, activation="softmax"),
+    ])
+    x = np.random.RandomState(1).rand(2, 10, 8, 3).astype(np.float32)
+    # ours takes NCHW
+    _import_and_compare(tmp_path, m, x, lambda a: a.transpose(0, 3, 1, 2))
+
+
+def test_cnn_batchnorm_dropout_global_pool(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((8, 8, 3)),
+        keras.layers.Conv2D(5, 3, padding="same", use_bias=False),
+        keras.layers.BatchNormalization(),
+        keras.layers.Activation("relu"),
+        keras.layers.Dropout(0.25),
+        keras.layers.GlobalAveragePooling2D(),
+        keras.layers.Dense(4),
+    ])
+    # fit one batch so BN moving stats are non-trivial
+    m.compile(optimizer="sgd", loss="mse")
+    rng = np.random.RandomState(2)
+    m.fit(rng.rand(8, 8, 8, 3).astype(np.float32),
+          rng.rand(8, 4).astype(np.float32), epochs=1, verbose=0)
+    x = rng.rand(3, 8, 8, 3).astype(np.float32)
+    # inference mode: dropout inactive, BN uses moving stats
+    _import_and_compare(tmp_path, m, x, lambda a: a.transpose(0, 3, 1, 2))
+
+
+def test_lstm_import(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((7, 5)),  # [t, f]
+        keras.layers.LSTM(6, return_sequences=False),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    x = np.random.RandomState(3).randn(4, 7, 5).astype(np.float32)
+    # ours takes [b, f, t]
+    _import_and_compare(tmp_path, m, x, lambda a: a.transpose(0, 2, 1))
+
+
+def test_lstm_return_sequences(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((6, 4)),
+        keras.layers.LSTM(5, return_sequences=True),
+    ])
+    x = np.random.RandomState(4).randn(2, 6, 4).astype(np.float32)
+    path = str(tmp_path / "model.h5")
+    m.save(path)
+    expected = np.asarray(m(x))  # [b, t, u]
+    ours = KerasModelImport.import_keras_model_and_weights(path)
+    got = np.asarray(ours.output(x.transpose(0, 2, 1)))  # [b, u, t]
+    np.testing.assert_allclose(got.transpose(0, 2, 1), expected, atol=1e-4,
+                               rtol=1e-3)
+
+
+def test_unsupported_layer_raises(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((4, 4, 1)),
+        keras.layers.SeparableConv2D(2, 3),
+        keras.layers.Flatten(),
+        keras.layers.Dense(2),
+    ])
+    path = str(tmp_path / "model.h5")
+    m.save(path)
+    with pytest.raises(KerasImportError, match="SeparableConv2D"):
+        KerasModelImport.import_keras_model_and_weights(path)
+
+
+def test_not_a_keras_file(tmp_path):
+    import h5py
+
+    path = str(tmp_path / "junk.h5")
+    with h5py.File(path, "w") as f:
+        f.create_dataset("x", data=np.zeros(3))
+    with pytest.raises(KerasImportError, match="model_config"):
+        KerasModelImport.import_keras_model_and_weights(path)
