@@ -114,15 +114,17 @@ class CSVSequenceRecordReader(RecordReader):
     per-step field lists."""
 
     def __init__(self, paths: Sequence[str], *, delimiter: str = ",",
-                 skip_lines: int = 0) -> None:
+                 skip_lines: int = 0, encoding: str = "utf-8") -> None:
         self.paths = list(paths)
         self.delimiter = delimiter
         self.skip_lines = int(skip_lines)
+        self.encoding = encoding
 
     def __iter__(self) -> Iterator[List[Record]]:
         for p in self.paths:
             reader = CSVRecordReader(p, delimiter=self.delimiter,
-                                     skip_lines=self.skip_lines)
+                                     skip_lines=self.skip_lines,
+                                     encoding=self.encoding)
             yield list(reader)
 
 

@@ -113,10 +113,12 @@ def _pad_mode(padding: str) -> ConvolutionMode:
     raise KerasImportError(f"unsupported padding {padding!r}")
 
 
-def _conv_out(size: int, k: int, s: int, mode: ConvolutionMode) -> int:
+def _conv_out(size: int, k: int, s: int, mode: ConvolutionMode,
+              d: int = 1) -> int:
     if mode is ConvolutionMode.SAME:
         return -(-size // s)
-    return (size - k) // s + 1
+    eff_k = (k - 1) * d + 1
+    return (size - eff_k) // s + 1
 
 
 class _Shape:
@@ -183,6 +185,10 @@ class _SequentialImporter:
 
     def _import_Dense(self, conf):
         s = self.shape
+        if s.kind == "conv":
+            raise KerasImportError(
+                f"Dense on 4D conv output ({conf['name']}) — insert a "
+                "Flatten/GlobalPooling in the Keras model first")
         n_in = s.n if s.kind == "ff" else s.f
         w = self._weights(conf)
         kernel = w["kernel"]
@@ -207,20 +213,24 @@ class _SequentialImporter:
             raise KerasImportError("Conv2D on non-convolutional input")
         if conf.get("data_format") not in (None, "channels_last"):
             raise KerasImportError("only channels_last Keras models supported")
+        if conf.get("groups", 1) != 1:
+            raise KerasImportError("grouped Conv2D unsupported")
         mode = _pad_mode(conf.get("padding", "valid"))
         kh, kw = conf["kernel_size"]
         sh, sw = conf.get("strides", (1, 1))
+        dh, dw = conf.get("dilation_rate", (1, 1))
         w = self._weights(conf)
         params = {"W": w["kernel"].transpose(3, 2, 0, 1)}  # HWIO → OIHW
         if conf.get("use_bias", True):
             params["b"] = w["bias"]
         self._add(ConvolutionLayer(
             name=conf["name"], n_in=int(s.c), n_out=int(conf["filters"]),
-            kernel_size=(kh, kw), stride=(sh, sw), convolution_mode=mode,
+            kernel_size=(kh, kw), stride=(sh, sw), dilation=(dh, dw),
+            convolution_mode=mode,
             activation=_map_activation(conf.get("activation")),
             has_bias=conf.get("use_bias", True)), params)
-        s.h = _conv_out(s.h, kh, sh, mode)
-        s.w = _conv_out(s.w, kw, sw, mode)
+        s.h = _conv_out(s.h, kh, sh, mode, dh)
+        s.w = _conv_out(s.w, kw, sw, mode, dw)
         s.c = conf["filters"]
 
     def _pool(self, conf, ptype):
@@ -282,6 +292,9 @@ class _SequentialImporter:
         if conf.get("max_value") not in (None, 6.0):
             raise KerasImportError("ReLU max_value other than None/6 "
                                    "unsupported")
+        if conf.get("negative_slope") or conf.get("threshold"):
+            raise KerasImportError(
+                "ReLU negative_slope/threshold unsupported")
         act = Activation.RELU6 if conf.get("max_value") == 6.0 \
             else Activation.RELU
         self._add(ActivationLayer(name=conf["name"], activation=act))
@@ -297,12 +310,21 @@ class _SequentialImporter:
                                    "supported")
         n = s.c if s.kind == "conv" else (s.f if s.kind == "rnn" else s.n)
         w = self._weights(conf)
+
+        def fix(arr):
+            # per-feature params between Flatten and the next Dense are in
+            # keras NHWC-flatten order; permute to our NCHW-flatten order
+            # (the pending Dense still gets its own row permutation after)
+            return arr[self.dense_perm] if self.dense_perm is not None \
+                else arr
+
         params = {}
         if conf.get("scale", True):
-            params["gamma"] = w["gamma"]
+            params["gamma"] = fix(w["gamma"])
         if conf.get("center", True):
-            params["beta"] = w["beta"]
-        state = {"mean": w["moving_mean"], "var": w["moving_variance"]}
+            params["beta"] = fix(w["beta"])
+        state = {"mean": fix(w["moving_mean"]),
+                 "var": fix(w["moving_variance"])}
         self._add(BatchNormalizationLayer(
             name=conf["name"], n_out=int(n), eps=float(conf.get(
                 "epsilon", 1e-3)), decay=float(conf.get("momentum", 0.99))),
@@ -315,6 +337,8 @@ class _SequentialImporter:
         if conf.get("activation", "tanh") != "tanh" or conf.get(
                 "recurrent_activation", "sigmoid") != "sigmoid":
             raise KerasImportError("non-default LSTM activations unsupported")
+        if conf.get("go_backwards", False):
+            raise KerasImportError("LSTM go_backwards unsupported")
         units = int(conf["units"])
         w = self._weights(conf)
         params = {

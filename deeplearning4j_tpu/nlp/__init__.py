@@ -1,0 +1,20 @@
+"""NLP tier — tokenization, BERT data prep, embedding models.
+
+Reference: deeplearning4j-nlp (SURVEY.md §2.2 "NLP"):
+Word2Vec/GloVe/ParagraphVectors, tokenizer factories, vocab, and
+``BertIterator``/``BertWordPieceTokenizer`` for BERT fine-tune/inference
+data prep.
+"""
+
+from .tokenization import BasicTokenizer, BertWordPieceTokenizer, Vocabulary
+from .bert_iterator import BertIterator, BertTask
+from .word2vec import Word2Vec
+
+__all__ = [
+    "BasicTokenizer",
+    "BertIterator",
+    "BertTask",
+    "BertWordPieceTokenizer",
+    "Vocabulary",
+    "Word2Vec",
+]

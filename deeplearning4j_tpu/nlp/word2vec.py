@@ -1,0 +1,215 @@
+"""Word2Vec — skip-gram with negative sampling, trained on device.
+
+Reference: org.deeplearning4j.models.word2vec.Word2Vec (SURVEY.md §2.2
+"NLP"): vocab build with min_count, frequency subsampling, unigram^0.75
+negative-sampling table, lock-free hogwild trainer threads.
+
+TPU design: hogwild's point was keeping many CPU cores busy with tiny
+rank-1 updates. On TPU the same math batches into MXU-shaped work: each
+jitted step takes [B] center ids, [B] context ids, and [B, K] negative
+ids, computes the sigmoid NS loss, and applies dense adagrad updates via
+segment-sum scatters — thousands of (center, context) pairs per launch
+instead of one per thread. Semantics (objective, sampling, lr decay)
+follow the reference; the execution schedule is synchronous minibatch.
+
+API parity: fit(), get_word_vector(), similarity(), words_nearest().
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class Word2Vec:
+    def __init__(
+        self,
+        *,
+        vector_size: int = 100,
+        window: int = 5,
+        min_count: int = 5,
+        negative: int = 5,
+        subsample: float = 1e-3,
+        learning_rate: float = 2.5,  # per-BATCH rate; pair-level ≈ lr/batch
+        min_learning_rate: float = 1e-4,
+        epochs: int = 1,
+        batch_size: int = 1024,
+        seed: int = 12345,
+    ) -> None:
+        self.vector_size = int(vector_size)
+        self.window = int(window)
+        self.min_count = int(min_count)
+        self.negative = int(negative)
+        self.subsample = float(subsample)
+        self.learning_rate = float(learning_rate)
+        self.min_learning_rate = float(min_learning_rate)
+        self.epochs = int(epochs)
+        self.batch_size = int(batch_size)
+        self.seed = int(seed)
+
+        self.vocab: List[str] = []
+        self.vocab_index: Dict[str, int] = {}
+        self.counts: Optional[np.ndarray] = None
+        self.syn0: Optional[np.ndarray] = None  # input vectors [V, D]
+        self.syn1: Optional[np.ndarray] = None  # output vectors [V, D]
+        self._step = None
+
+    # ----- vocab ------------------------------------------------------
+
+    def _build_vocab(self, sentences: Sequence[Sequence[str]]) -> None:
+        freq: Dict[str, int] = {}
+        for sent in sentences:
+            for w in sent:
+                freq[w] = freq.get(w, 0) + 1
+        items = sorted(((c, w) for w, c in freq.items()
+                        if c >= self.min_count), reverse=True)
+        self.vocab = [w for _, w in items]
+        self.vocab_index = {w: i for i, w in enumerate(self.vocab)}
+        self.counts = np.asarray([c for c, _ in items], np.float64)
+        if not self.vocab:
+            raise ValueError(
+                f"no tokens with count >= min_count ({self.min_count})")
+
+    def _negative_table(self, size: int = 1 << 20) -> np.ndarray:
+        probs = self.counts ** 0.75
+        probs /= probs.sum()
+        return np.random.RandomState(self.seed).choice(
+            len(self.vocab), size=size, p=probs).astype(np.int32)
+
+    # ----- training ---------------------------------------------------
+
+    def _pairs(self, sentences, rng) -> Iterable[Tuple[int, int]]:
+        """Skip-gram (center, context) pairs with frequency subsampling and
+        the reference's random dynamic window shrink."""
+        total = float(self.counts.sum())
+        keep_prob = None
+        if self.subsample > 0:
+            ratio = self.counts / (self.subsample * total)
+            keep_prob = (np.sqrt(ratio) + 1) / ratio
+        for sent in sentences:
+            ids = [self.vocab_index[w] for w in sent if w in self.vocab_index]
+            if keep_prob is not None:
+                ids = [i for i in ids if rng.rand() < keep_prob[i]]
+            for pos, center in enumerate(ids):
+                b = rng.randint(1, self.window + 1)
+                for off in range(-b, b + 1):
+                    ctx = pos + off
+                    if off != 0 and 0 <= ctx < len(ids):
+                        yield center, ids[ctx]
+
+    def _make_step(self):
+        neg = self.negative
+
+        @jax.jit
+        def step(syn0, syn1, centers, contexts, negatives, lr):
+            c_vec = syn0[centers]            # [B, D]
+            targets = jnp.concatenate(
+                [contexts[:, None], negatives], axis=1)  # [B, 1+K]
+            t_vec = syn1[targets]            # [B, 1+K, D]
+            logits = jnp.einsum("bd,bkd->bk", c_vec, t_vec)
+            labels = jnp.zeros_like(logits).at[:, 0].set(1.0)
+            # drop negatives that collided with the positive context (the
+            # reference resamples; masking is the branch-free equivalent)
+            valid = jnp.concatenate(
+                [jnp.ones_like(contexts[:, None], jnp.float32),
+                 (negatives != contexts[:, None]).astype(jnp.float32)],
+                axis=1)
+            sig = jax.nn.sigmoid(logits)
+            # dL/dlogits for sigmoid NS loss. Normalized by batch size: the
+            # reference applies each pair's update sequentially (hogwild);
+            # summing B unnormalized updates into the same rows would scale
+            # the effective step by each word's in-batch frequency and
+            # diverge on small vocabularies.
+            g = (sig - labels) * valid * (lr / logits.shape[0])  # [B, 1+K]
+            grad_c = jnp.einsum("bk,bkd->bd", g, t_vec)
+            grad_t = g[..., None] * c_vec[:, None, :]   # [B, 1+K, D]
+            syn0 = syn0.at[centers].add(-grad_c)
+            syn1 = syn1.at[targets.reshape(-1)].add(
+                -grad_t.reshape(-1, grad_t.shape[-1]))
+            loss = -jnp.sum(
+                valid * (labels * jnp.log(sig + 1e-10)
+                         + (1 - labels) * jnp.log(1 - sig + 1e-10))
+            ) / jnp.sum(valid)
+            return syn0, syn1, loss
+
+        return step
+
+    def fit(self, sentences: Sequence[Sequence[str]],
+            verbose: bool = False) -> "Word2Vec":
+        """``sentences`` is an iterable of token lists (use a tokenizer from
+        nlp.tokenization upstream)."""
+        sentences = list(sentences)
+        self._build_vocab(sentences)
+        rng = np.random.RandomState(self.seed)
+        v, d = len(self.vocab), self.vector_size
+        self.syn0 = ((rng.rand(v, d) - 0.5) / d).astype(np.float32)
+        self.syn1 = np.zeros((v, d), np.float32)
+        table = self._negative_table()
+        step = self._make_step()
+
+        syn0 = jnp.asarray(self.syn0)
+        syn1 = jnp.asarray(self.syn1)
+        # pair count estimate for the linear lr decay
+        est_pairs = max(1, sum(len(s) for s in sentences) * self.window)
+        total_batches = max(1, self.epochs * est_pairs // self.batch_size)
+        batch_i = 0
+        for _epoch in range(self.epochs):
+            buf_c: List[int] = []
+            buf_x: List[int] = []
+
+            def flush(syn0, syn1, batch_i):
+                n = len(buf_c)
+                if n == 0:
+                    return syn0, syn1, batch_i, 0.0
+                total = -(-n // self.batch_size) * self.batch_size
+                # cyclic pad to a full batch: one static shape → one compile
+                centers = np.resize(np.asarray(buf_c, np.int32), total)
+                contexts = np.resize(np.asarray(buf_x, np.int32), total)
+                negs = table[rng.randint(0, table.size,
+                                         (centers.size, self.negative))]
+                frac = min(1.0, batch_i / total_batches)
+                lr = max(self.min_learning_rate,
+                         self.learning_rate * (1 - frac))
+                syn0, syn1, loss = step(syn0, syn1, centers, contexts,
+                                        jnp.asarray(negs),
+                                        jnp.float32(lr))
+                return syn0, syn1, batch_i + 1, float(loss)
+
+            for center, ctx in self._pairs(sentences, rng):
+                buf_c.append(center)
+                buf_x.append(ctx)
+                if len(buf_c) >= self.batch_size:
+                    syn0, syn1, batch_i, loss = flush(syn0, syn1, batch_i)
+                    if verbose and batch_i % 50 == 0:
+                        print(f"w2v batch {batch_i}: loss {loss:.4f}")
+                    buf_c, buf_x = [], []
+            syn0, syn1, batch_i, _ = flush(syn0, syn1, batch_i)
+        self.syn0 = np.asarray(syn0)
+        self.syn1 = np.asarray(syn1)
+        return self
+
+    # ----- query API (reference method names) -------------------------
+
+    def has_word(self, word: str) -> bool:
+        return word in self.vocab_index
+
+    def get_word_vector(self, word: str) -> np.ndarray:
+        return self.syn0[self.vocab_index[word]]
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        denom = (np.linalg.norm(va) * np.linalg.norm(vb)) or 1e-10
+        return float(va @ vb / denom)
+
+    def words_nearest(self, word: str, n: int = 10) -> List[str]:
+        v = self.get_word_vector(word)
+        norms = np.linalg.norm(self.syn0, axis=1) * (np.linalg.norm(v) + 1e-10)
+        sims = self.syn0 @ v / np.maximum(norms, 1e-10)
+        order = np.argsort(-sims)
+        return [self.vocab[i] for i in order
+                if self.vocab[i] != word][:n]

@@ -117,3 +117,41 @@ def test_not_a_keras_file(tmp_path):
         f.create_dataset("x", data=np.zeros(3))
     with pytest.raises(KerasImportError, match="model_config"):
         KerasModelImport.import_keras_model_and_weights(path)
+
+
+def test_dilated_conv_import(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((12, 12, 2)),
+        keras.layers.Conv2D(3, 3, dilation_rate=2, activation="relu"),
+        keras.layers.Flatten(),
+        keras.layers.Dense(4),
+    ])
+    x = np.random.RandomState(5).rand(2, 12, 12, 2).astype(np.float32)
+    _import_and_compare(tmp_path, m, x, lambda a: a.transpose(0, 3, 1, 2))
+
+
+def test_batchnorm_after_flatten_permuted(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((6, 6, 3)),
+        keras.layers.Conv2D(4, 3, padding="same"),
+        keras.layers.Flatten(),
+        keras.layers.BatchNormalization(),
+        keras.layers.Dense(5),
+    ])
+    m.compile(optimizer="sgd", loss="mse")
+    rng = np.random.RandomState(6)
+    m.fit(rng.rand(16, 6, 6, 3).astype(np.float32),
+          rng.rand(16, 5).astype(np.float32), epochs=1, verbose=0)
+    x = rng.rand(3, 6, 6, 3).astype(np.float32)
+    _import_and_compare(tmp_path, m, x, lambda a: a.transpose(0, 3, 1, 2))
+
+
+def test_go_backwards_lstm_rejected(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((5, 3)),
+        keras.layers.LSTM(4, go_backwards=True),
+    ])
+    path = str(tmp_path / "model.h5")
+    m.save(path)
+    with pytest.raises(KerasImportError, match="go_backwards"):
+        KerasModelImport.import_keras_model_and_weights(path)

@@ -1,0 +1,139 @@
+"""NLP tier tests: wordpiece tokenization, BertIterator data prep, and
+Word2Vec learning co-occurrence structure (SURVEY.md §2.2 NLP row)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BasicTokenizer,
+    BertIterator,
+    BertTask,
+    BertWordPieceTokenizer,
+    Vocabulary,
+    Word2Vec,
+)
+
+_VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+          "the", "quick", "brown", "fox", "jump", "##ed", "##s",
+          "over", "lazy", "dog", "un", "##want"]
+
+
+@pytest.fixture
+def tokenizer():
+    return BertWordPieceTokenizer(Vocabulary(_VOCAB))
+
+
+def test_basic_tokenizer():
+    t = BasicTokenizer()
+    assert t.tokenize("Hello, World!") == ["hello", ",", "world", "!"]
+    assert t.tokenize("  a\tb\nc ") == ["a", "b", "c"]
+    # accents stripped under lowercasing
+    assert t.tokenize("Café") == ["cafe"]
+
+
+def test_wordpiece_greedy_longest_match(tokenizer):
+    assert tokenizer.tokenize("jumped") == ["jump", "##ed"]
+    assert tokenizer.tokenize("unwanted") == ["un", "##want", "##ed"]
+    assert tokenizer.tokenize("The quick fox") == ["the", "quick", "fox"]
+    # unknown word → [UNK]
+    assert tokenizer.tokenize("zebra") == ["[UNK]"]
+
+
+def test_encode(tokenizer):
+    ids = tokenizer.encode("jumped")
+    assert ids == [_VOCAB.index("jump"), _VOCAB.index("##ed")]
+
+
+def test_vocab_from_file(tmp_path):
+    p = tmp_path / "vocab.txt"
+    p.write_text("\n".join(_VOCAB) + "\n")
+    v = Vocabulary.from_file(str(p))
+    assert len(v) == len(_VOCAB)
+    assert v.id_of("fox") == _VOCAB.index("fox")
+
+
+def test_bert_iterator_classification(tokenizer):
+    sents = ["the quick brown fox", "the lazy dog", "jumped over"]
+    it = BertIterator(tokenizer, task=BertTask.SEQ_CLASSIFICATION,
+                      sentences=sents, labels=[0, 1, 0], num_classes=2,
+                      max_length=8, batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2 and len(it) == 2
+    ids, mask = batches[0].features
+    assert ids.shape == (2, 8) and mask.shape == (2, 8)
+    assert ids[0, 0] == tokenizer.vocab.id_of("[CLS]")
+    # [SEP] closes each sequence at the last unmasked position
+    last = int(mask[0].sum()) - 1
+    assert ids[0, last] == tokenizer.vocab.id_of("[SEP]")
+    assert ids[0, last + 1] == tokenizer.vocab.id_of("[PAD]")
+    np.testing.assert_allclose(batches[0].labels[0][0], [1, 0])
+
+
+def test_bert_iterator_mlm(tokenizer):
+    sents = ["the quick brown fox jumped over the lazy dog"] * 20
+    it = BertIterator(tokenizer, task=BertTask.UNSUPERVISED,
+                      sentences=sents, max_length=16, batch_size=10,
+                      mask_prob=0.3, seed=7)
+    (b1, b2) = list(it)
+    ids, mask = b1.features
+    labels = b1.labels[0]
+    lmask = b1.labels_masks[0]
+    assert ids.shape == labels.shape == lmask.shape == (10, 16)
+    # masked positions: corrupted ids differ from labels at ~80% of picks
+    picked = lmask > 0
+    assert picked.any()
+    # labels hold the ORIGINAL ids everywhere
+    orig, _ = it._encode(sents[0])
+    np.testing.assert_array_equal(labels[0], orig)
+    # CLS/SEP are never masked
+    cls_id = tokenizer.vocab.id_of("[CLS]")
+    sep_id = tokenizer.vocab.id_of("[SEP]")
+    assert not ((labels == cls_id) & picked).any()
+    assert not ((labels == sep_id) & picked).any()
+    # most masked positions become [MASK]
+    mask_id = tokenizer.vocab.id_of("[MASK]")
+    frac_masked = ((ids == mask_id) & picked).sum() / picked.sum()
+    assert 0.5 < frac_masked <= 1.0
+
+
+def test_bert_iterator_validation(tokenizer):
+    with pytest.raises(ValueError):
+        BertIterator(tokenizer, task=BertTask.SEQ_CLASSIFICATION,
+                     sentences=["a"], num_classes=2)  # no labels
+    with pytest.raises(ValueError):
+        BertIterator(tokenizer, task=BertTask.SEQ_CLASSIFICATION,
+                     sentences=["a", "b"], labels=[0], num_classes=2)
+
+
+def test_word2vec_learns_cooccurrence():
+    # two disjoint topic clusters; words within a cluster co-occur
+    rng = np.random.RandomState(0)
+    animals = ["cat", "dog", "fox", "wolf"]
+    tools = ["hammer", "wrench", "drill", "saw"]
+    sentences = []
+    for _ in range(400):
+        group = animals if rng.rand() < 0.5 else tools
+        sentences.append([group[rng.randint(4)] for _ in range(8)])
+    w2v = Word2Vec(vector_size=16, window=3, min_count=1, negative=4,
+                   epochs=5, batch_size=256, seed=3,
+                   learning_rate=5.0, subsample=0)
+    w2v.fit(sentences)
+    assert w2v.has_word("cat") and not w2v.has_word("zebra")
+    assert w2v.get_word_vector("cat").shape == (16,)
+    # within-cluster similarity should beat cross-cluster
+    within = w2v.similarity("cat", "dog")
+    across = w2v.similarity("cat", "hammer")
+    assert within > across, (within, across)
+    nearest = w2v.words_nearest("cat", 3)
+    assert set(nearest) <= set(animals) - {"cat"} | set(), nearest
+
+
+def test_word2vec_min_count():
+    sents = [["a", "b"], ["a", "c"], ["a", "b"]]
+    w2v = Word2Vec(vector_size=4, min_count=2, window=2, epochs=1,
+                   batch_size=8, subsample=0)
+    w2v.fit(sents)
+    assert w2v.has_word("a") and w2v.has_word("b")
+    assert not w2v.has_word("c")  # below min_count
+    with pytest.raises(ValueError):
+        Word2Vec(min_count=10).fit([["x", "y"]])
