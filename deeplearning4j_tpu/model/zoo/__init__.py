@@ -1,5 +1,30 @@
+"""Model zoo (reference: org.deeplearning4j.zoo.model.* — SURVEY.md §2.2).
+
+No pretrained-weight downloads (zero-egress environment); architectures are
+construction-parity with the reference and train from scratch.
+"""
+
+from .darknet import Darknet19, TinyYOLO
+from .inception_resnet import InceptionResNetV1
 from .lenet import LeNet
 from .resnet50 import ResNet50
-from .vgg16 import AlexNet, VGG16
+from .squeezenet import SqueezeNet
+from .textgen_lstm import TextGenerationLSTM
+from .unet import UNet
+from .vgg16 import AlexNet, VGG16, VGG19
+from .xception import Xception
 
-__all__ = ["AlexNet", "LeNet", "ResNet50", "VGG16"]
+__all__ = [
+    "AlexNet",
+    "Darknet19",
+    "InceptionResNetV1",
+    "LeNet",
+    "ResNet50",
+    "SqueezeNet",
+    "TextGenerationLSTM",
+    "TinyYOLO",
+    "UNet",
+    "VGG16",
+    "VGG19",
+    "Xception",
+]

@@ -1,0 +1,47 @@
+"""TextGenerationLSTM — the GravesLSTM char-RNN benchmark model.
+
+Reference: org.deeplearning4j.zoo.model.TextGenerationLSTM
+(BASELINE.json:9, "GravesLSTM char-RNN"): stacked GravesLSTM (peephole)
+layers over one-hot character input with an RnnOutputLayer, trained via
+truncated BPTT.
+"""
+
+from __future__ import annotations
+
+from ...nn import Activation, InputType, LossFunction, NeuralNetConfiguration, WeightInit
+from ...nn.conf import BackpropType
+from ...nn.sequential import MultiLayerNetwork
+from ...nn.layers import GravesLSTMLayer, RnnOutputLayer
+from ...train.updaters import RmsProp
+
+
+class TextGenerationLSTM:
+    def __init__(self, vocab_size: int = 77, hidden: int = 200,
+                 layers: int = 2, tbptt_length: int = 50, seed: int = 123,
+                 updater=None, dtype: str = "float32") -> None:
+        self.vocab_size = vocab_size
+        self.hidden = hidden
+        self.layers = layers
+        self.tbptt_length = tbptt_length
+        self.seed = seed
+        self.updater = updater or RmsProp(1e-3)
+        self.dtype = dtype
+
+    def conf(self):
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed).data_type(self.dtype).updater(self.updater)
+             .weight_init(WeightInit.XAVIER).list())
+        for _ in range(self.layers):
+            b.layer(GravesLSTMLayer(n_out=self.hidden,
+                                    activation=Activation.TANH))
+        b.layer(RnnOutputLayer(n_out=self.vocab_size,
+                               loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+        return (b.set_input_type(InputType.recurrent(self.vocab_size))
+                .backprop_type(BackpropType.TRUNCATED_BPTT)
+                .tbptt_fwd_length(self.tbptt_length)
+                .tbptt_back_length(self.tbptt_length)
+                .build())
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()

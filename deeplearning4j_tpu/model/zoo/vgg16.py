@@ -27,6 +27,9 @@ class VGG16:
         self.updater = updater or Nesterovs(1e-2, 0.9)
         self.dtype = dtype
 
+    #: (filters, conv repetitions) per stage — VGG19 overrides this
+    _PLAN = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+
     def conf(self):
         b = (
             NeuralNetConfiguration.builder()
@@ -37,8 +40,7 @@ class VGG16:
             .activation(Activation.RELU)
             .list()
         )
-        plan = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
-        for n_out, reps in plan:
+        for n_out, reps in self._PLAN:
             for _ in range(reps):
                 b = b.layer(ConvolutionLayer(
                     n_out=n_out, kernel_size=(3, 3), stride=(1, 1),
@@ -102,3 +104,10 @@ class AlexNet:
 
     def init(self) -> MultiLayerNetwork:
         return MultiLayerNetwork(self.conf()).init()
+
+
+class VGG19(VGG16):
+    """Reference: org.deeplearning4j.zoo.model.VGG19 — VGG16 with a fourth
+    conv in the last three stages."""
+
+    _PLAN = [(64, 2), (128, 2), (256, 4), (512, 4), (512, 4)]

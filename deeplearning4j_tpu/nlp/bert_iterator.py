@@ -93,8 +93,16 @@ class BertIterator:
         # remaining 10%: keep original token
         return out, labels, pick.astype(np.float32)
 
+    def reset_rng(self) -> None:
+        """Re-seed the masking RNG (for exact reproducibility runs)."""
+        self._rng = np.random.RandomState(self.seed)
+
     def __iter__(self) -> Iterator[MultiDataSet]:
-        rng = np.random.RandomState(self.seed)
+        # persistent RNG: masked-LM corruption must resample every epoch
+        # (dynamic masking), not replay the same positions
+        if not hasattr(self, "_rng"):
+            self.reset_rng()
+        rng = self._rng
         n = len(self.sentences)
         for start in range(0, n, self.batch_size):
             idx = range(start, min(start + self.batch_size, n))

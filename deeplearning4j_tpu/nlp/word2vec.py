@@ -106,7 +106,7 @@ class Word2Vec:
         neg = self.negative
 
         @jax.jit
-        def step(syn0, syn1, centers, contexts, negatives, lr):
+        def step(syn0, syn1, centers, contexts, negatives, row_valid, lr):
             c_vec = syn0[centers]            # [B, D]
             targets = jnp.concatenate(
                 [contexts[:, None], negatives], axis=1)  # [B, 1+K]
@@ -119,6 +119,9 @@ class Word2Vec:
                 [jnp.ones_like(contexts[:, None], jnp.float32),
                  (negatives != contexts[:, None]).astype(jnp.float32)],
                 axis=1)
+            # zero padded rows too (cyclic batch fill) — otherwise tail
+            # pairs train batch_size/n times per flush
+            valid = valid * row_valid[:, None]
             sig = jax.nn.sigmoid(logits)
             # dL/dlogits for sigmoid NS loss. Normalized by batch size: the
             # reference applies each pair's update sequentially (hogwild);
@@ -170,6 +173,8 @@ class Word2Vec:
                 # cyclic pad to a full batch: one static shape → one compile
                 centers = np.resize(np.asarray(buf_c, np.int32), total)
                 contexts = np.resize(np.asarray(buf_x, np.int32), total)
+                row_valid = np.zeros(total, np.float32)
+                row_valid[:n] = 1.0
                 negs = table[rng.randint(0, table.size,
                                          (centers.size, self.negative))]
                 frac = min(1.0, batch_i / total_batches)
@@ -177,6 +182,7 @@ class Word2Vec:
                          self.learning_rate * (1 - frac))
                 syn0, syn1, loss = step(syn0, syn1, centers, contexts,
                                         jnp.asarray(negs),
+                                        jnp.asarray(row_valid),
                                         jnp.float32(lr))
                 return syn0, syn1, batch_i + 1, float(loss)
 

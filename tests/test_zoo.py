@@ -1,0 +1,107 @@
+"""Model zoo smoke tests: every architecture builds, runs a tiny forward
+with the expected output shape, and (for the flagship families) takes a
+training step (SURVEY.md §2.2 "Model zoo")."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.model.zoo import (
+    AlexNet,
+    Darknet19,
+    InceptionResNetV1,
+    LeNet,
+    SqueezeNet,
+    TextGenerationLSTM,
+    TinyYOLO,
+    UNet,
+    VGG16,
+    VGG19,
+    Xception,
+)
+
+
+def _x(b, c, h, w, seed=0):
+    return np.random.RandomState(seed).rand(b, c, h, w).astype(np.float32)
+
+
+def test_alexnet_small_forward():
+    m = AlexNet(num_classes=5, height=96, width=96).init()
+    out = m.output(_x(2, 3, 96, 96))
+    assert out.shape == (2, 5)
+    assert np.allclose(np.asarray(out).sum(1), 1, atol=1e-4)
+
+
+def test_vgg19_builds():
+    m = VGG19(num_classes=4, height=64, width=64).init()
+    out = m.output(_x(1, 3, 64, 64))
+    assert out.shape == (1, 4)
+    # VGG19 has 3 more convs than VGG16
+    n16 = sum(1 for l in VGG16(num_classes=4, height=64, width=64)
+              .conf().layers if type(l).__name__ == "ConvolutionLayer")
+    n19 = sum(1 for l in VGG19(num_classes=4, height=64, width=64)
+              .conf().layers if type(l).__name__ == "ConvolutionLayer")
+    assert (n16, n19) == (13, 16)
+
+
+def test_squeezenet_forward_and_fit():
+    m = SqueezeNet(num_classes=6, height=64, width=64).init()
+    out = m.output(_x(2, 3, 64, 64))
+    assert out.shape == (2, 6)
+    assert np.allclose(np.asarray(out).sum(1), 1, atol=1e-4)
+    y = np.eye(6, dtype=np.float32)[[0, 3]]
+    s0 = m.score([_x(2, 3, 64, 64)], [y])
+    m.fit([_x(2, 3, 64, 64)], [y], epochs=3)
+    assert m.score([_x(2, 3, 64, 64)], [y]) < s0
+
+
+def test_darknet19_forward():
+    m = Darknet19(num_classes=7, height=64, width=64).init()
+    out = m.output(_x(1, 3, 64, 64))
+    assert out.shape == (1, 7)
+
+
+def test_tiny_yolo_grid_shape():
+    m = TinyYOLO(num_classes=3, num_boxes=5, height=128, width=128).init()
+    out = m.output(_x(1, 3, 128, 128))
+    # 128 / 2^5 = 4 grid, depth = 5 * (5 + 3)
+    assert out.shape == (1, 5 * 8, 4, 4)
+
+
+def test_unet_shapes_match_input():
+    m = UNet(num_classes=2, height=32, width=32, base_filters=8,
+             depth=2).init()
+    out = m.output(_x(1, 3, 32, 32))
+    assert out.shape == (1, 2, 32, 32)
+    vals = np.asarray(out)
+    assert ((vals >= 0) & (vals <= 1)).all()  # sigmoid head
+
+
+def test_xception_forward():
+    m = Xception(num_classes=4, height=64, width=64, middle_blocks=1).init()
+    out = m.output(_x(1, 3, 64, 64))
+    assert out.shape == (1, 4)
+    assert np.allclose(np.asarray(out).sum(1), 1, atol=1e-4)
+
+
+def test_inception_resnet_v1_forward():
+    m = InceptionResNetV1(num_classes=4, height=96, width=96, blocks_a=1,
+                          blocks_b=1, blocks_c=1).init()
+    out = m.output(_x(1, 3, 96, 96))
+    assert out.shape == (1, 4)
+    assert np.allclose(np.asarray(out).sum(1), 1, atol=1e-4)
+
+
+def test_textgen_lstm_trains():
+    vocab = 10
+    m = TextGenerationLSTM(vocab_size=vocab, hidden=16, layers=2,
+                           tbptt_length=8).init()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (4, 20))
+    x = np.eye(vocab, dtype=np.float32)[ids].transpose(0, 2, 1)  # [b,v,t]
+    # next-char labels: shift by one
+    y = np.eye(vocab, dtype=np.float32)[np.roll(ids, -1, 1)].transpose(0, 2, 1)
+    out = m.output(x)
+    assert out.shape == (4, vocab, 20)
+    s0 = m.score(x, y)
+    m.fit(x, y, epochs=5)
+    assert m.score(x, y) < s0
