@@ -117,3 +117,63 @@ class CollectScoresListener(TrainingListener):
     def iteration_done(self, model: Any, iteration: int, epoch: int, score: float) -> None:
         self.iterations.append(iteration)
         self.scores.append(float(score))
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodic evaluation during training (reference: EvaluativeListener):
+    every N iterations (or each epoch end) runs the given evaluation over an
+    iterator and logs/stores the result."""
+
+    def __init__(self, iterator, frequency: int = 0, *,
+                 evaluation_factory=None, log_fn=print) -> None:
+        """frequency > 0: every N iterations; 0: each epoch end."""
+        self.iterator = iterator
+        self.frequency = int(frequency)
+        self.evaluation_factory = evaluation_factory
+        self.log_fn = log_fn
+        self.history: List[Any] = []
+
+    def _evaluate(self, model) -> None:
+        import numpy as np
+
+        if self.evaluation_factory is None:
+            from ..train.evaluation import Evaluation
+
+            ev = Evaluation()  # self-sizes on first eval() call
+        else:
+            ev = self.evaluation_factory()
+        saw_data = False
+        for batch in self.iterator:
+            feats = batch.features
+            fmask = getattr(batch, "features_mask", None)
+            lmask = getattr(batch, "labels_mask", None)
+            if isinstance(feats, (list, tuple)):  # graph model, MultiDataSet
+                out = model.output(*feats, masks=fmask)
+                if isinstance(out, tuple):
+                    out = out[0]
+                labels = batch.labels[0]
+                if lmask is not None:
+                    lmask = lmask[0]
+            else:
+                out = model.output(feats, mask=fmask)
+                labels = batch.labels
+            ev.eval(labels, np.asarray(out), mask=lmask)
+            saw_data = True
+        if not saw_data:
+            # exhausted one-shot iterable (plain generator): warn, don't
+            # record a vacuous evaluation
+            self.log_fn("EvaluativeListener: iterator yielded no batches — "
+                        "pass a restartable iterator for repeated eval")
+            return
+        self.history.append(ev)
+        acc = getattr(ev, "accuracy", None)
+        if callable(acc):
+            self.log_fn(f"EvaluativeListener: accuracy={ev.accuracy():.4f}")
+
+    def iteration_done(self, model: Any, iteration: int, epoch: int, score: float) -> None:
+        if self.frequency > 0 and iteration % self.frequency == 0:
+            self._evaluate(model)
+
+    def on_epoch_end(self, model: Any) -> None:
+        if self.frequency <= 0:
+            self._evaluate(model)

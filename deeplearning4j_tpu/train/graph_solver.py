@@ -25,8 +25,8 @@ class GraphSolver:
         self.opt_state = self.optim.init(model.params)
         self._step_cache: Dict[Any, Any] = {}
 
-    def _step_fn(self, n_in: int, n_out: int):
-        key = ("step", n_in, n_out)
+    def _step_fn(self, n_in: int, n_out: int, return_grads: bool = False):
+        key = ("step", n_in, n_out, return_grads)
         if key not in self._step_cache:
             model = self.model
             conf = model.conf
@@ -40,6 +40,8 @@ class GraphSolver:
                     grads, conf.gradient_normalization, conf.gradient_normalization_threshold
                 )
                 new_params, new_opt = self.optim.update(grads, opt_state, params)
+                if return_grads:  # array-hungry listeners (StatsListener)
+                    return new_params, new_opt, new_state, score, grads
                 return new_params, new_opt, new_state, score
 
             self._step_cache[key] = jax.jit(step, donate_argnums=(0, 1, 2))
@@ -79,11 +81,17 @@ class GraphSolver:
         model = self.model
         xs = tuple(jnp.asarray(x, model.dtype) for x in xs)
         ys = tuple(jnp.asarray(y) for y in ys)
-        fn = self._step_fn(len(xs), len(ys))
+        want_grads = model.listeners.requires_arrays
+        fn = self._step_fn(len(xs), len(ys), want_grads)
         rng = model._rng.next_key()
-        params, opt_state, state, score = fn(
+        out = fn(
             model.params, self.opt_state, model.state, xs, ys, rng
         )
+        if want_grads:
+            params, opt_state, state, score, grads = out
+            model.listeners.gradient_calculation(model, grads)
+        else:
+            params, opt_state, state, score = out
         model.params = params
         model.state = state
         self.opt_state = opt_state

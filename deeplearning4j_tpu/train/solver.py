@@ -116,7 +116,8 @@ class Solver:
         self.opt_state = self.optim.init(model.params)
         self._step_cache: Dict[Any, Any] = {}
 
-    def _make_step(self, has_mask: bool, has_label_mask: bool, stateful: bool):
+    def _make_step(self, has_mask: bool, has_label_mask: bool, stateful: bool,
+                   return_grads: bool = False):
         model = self.model
         conf = model.conf
 
@@ -132,12 +133,14 @@ class Solver:
                 grads, conf.gradient_normalization, conf.gradient_normalization_threshold
             )
             new_params, new_opt = self.optim.update(grads, opt_state, params)
+            if return_grads:  # array-hungry listeners (StatsListener)
+                return new_params, new_opt, new_state, new_rnn, score, grads
             return new_params, new_opt, new_state, new_rnn, score
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
-    def _step_fn(self, has_mask, has_label_mask, stateful):
-        key = (has_mask, has_label_mask, stateful)
+    def _step_fn(self, has_mask, has_label_mask, stateful, return_grads=False):
+        key = (has_mask, has_label_mask, stateful, return_grads)
         if key not in self._step_cache:
             self._step_cache[key] = self._make_step(*key)
         return self._step_cache[key]
@@ -149,12 +152,19 @@ class Solver:
         mask_a = None if mask is None else jnp.asarray(mask, model.dtype)
         lmask_a = None if label_mask is None else jnp.asarray(label_mask, model.dtype)
         stateful = rnn_state is not None
-        fn = self._step_fn(mask_a is not None, lmask_a is not None, stateful)
+        want_grads = model.listeners.requires_arrays
+        fn = self._step_fn(mask_a is not None, lmask_a is not None, stateful,
+                           want_grads)
         rng = model._rng.next_key()
-        params, opt_state, state, new_rnn, score = fn(
+        out = fn(
             model.params, self.opt_state, model.state,
             rnn_state if stateful else {}, x, y, rng, mask_a, lmask_a,
         )
+        if want_grads:
+            params, opt_state, state, new_rnn, score, grads = out
+            model.listeners.gradient_calculation(model, grads)
+        else:
+            params, opt_state, state, new_rnn, score = out
         model.params = params
         model.state = state
         self.opt_state = opt_state

@@ -1,0 +1,101 @@
+"""Profiling + numerics tripwires.
+
+Reference (SURVEY.md §5.1): SameDiff ProfilingListener writes Chrome
+trace-event JSON; OpProfiler/PerformanceTracker time per-op work;
+ProfilerConfig checkForNAN/INF ("NaN panic") throws on the first bad
+value. TPU equivalents: iteration-phase trace events (host view),
+``jax.profiler`` traces (device view, perfetto), ``jax_debug_nans``
+plus a listener-level score tripwire.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import Any, List, Optional
+
+import jax
+
+from ..core.listeners import TrainingListener
+
+
+class ProfilingListener(TrainingListener):
+    """Emits Chrome trace-event JSON (load in chrome://tracing or
+    ui.perfetto.dev). Each iteration is a complete event on the training
+    track; epochs are nested spans."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._events: List[dict] = []
+        self._iter_start: Optional[float] = None
+        self._epoch_start: Optional[float] = None
+        self._epoch = 0
+        self._t0 = time.perf_counter()
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def on_epoch_start(self, model: Any) -> None:
+        self._epoch_start = self._now_us()
+
+    def on_epoch_end(self, model: Any) -> None:
+        if self._epoch_start is not None:
+            self._events.append({
+                "name": f"epoch {self._epoch}", "ph": "X", "pid": 0,
+                "tid": 0, "ts": self._epoch_start,
+                "dur": self._now_us() - self._epoch_start,
+                "cat": "epoch",
+            })
+        self._epoch += 1
+        self.flush()
+
+    def iteration_done(self, model: Any, iteration: int, epoch: int,
+                       score: float) -> None:
+        now = self._now_us()
+        start = self._iter_start if self._iter_start is not None else now
+        self._events.append({
+            "name": "iteration", "ph": "X", "pid": 0, "tid": 1,
+            "ts": start, "dur": max(now - start, 1.0), "cat": "train",
+            "args": {"iteration": iteration, "epoch": epoch,
+                     "score": float(score)},
+        })
+        self._iter_start = now
+
+    def flush(self) -> None:
+        with open(self.path, "w") as f:
+            json.dump({"traceEvents": self._events,
+                       "displayTimeUnit": "ms"}, f)
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """XLA/device-level profiling via jax.profiler (perfetto/tensorboard
+    readable) — the deep view the host-side listener can't see."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def enable_debug_nans(enable: bool = True) -> None:
+    """Global NaN panic (reference: ProfilerConfig.checkForNAN): XLA raises
+    at the op that produced the first NaN. Costly — debugging only."""
+    jax.config.update("jax_debug_nans", enable)
+
+
+class NanPanicListener(TrainingListener):
+    """Listener-level tripwire: raises the moment the training score goes
+    non-finite, with context (reference: the executioner's checkForNAN at
+    the op level; this is the cheap always-on variant)."""
+
+    def iteration_done(self, model: Any, iteration: int, epoch: int,
+                       score: float) -> None:
+        import math
+
+        if not math.isfinite(score):
+            raise FloatingPointError(
+                f"NaN panic: non-finite score {score} at iteration "
+                f"{iteration} (epoch {epoch}). Enable "
+                f"ui.enable_debug_nans() to locate the producing op.")

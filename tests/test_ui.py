@@ -1,0 +1,129 @@
+"""Stats storage / profiling / NaN panic tests (SURVEY.md §5.1, §5.5)."""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.core.listeners import EvaluativeListener
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.train.updaters import Adam
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    NanPanicListener,
+    ProfilingListener,
+    StatsListener,
+)
+
+
+def _model(seed=3):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(learning_rate=1e-2)).list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=2))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=32, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+    return x, y
+
+
+def test_stats_listener_collects_params_grads_updates():
+    model = _model()
+    storage = InMemoryStatsStorage()
+    model.add_listeners(StatsListener(storage, session_id="s1",
+                                      update_frequency=1))
+    x, y = _data()
+    model.fit(x, y, epochs=5)
+    recs = storage.records("s1")
+    assert len(recs) == 5
+    full = [r for r in recs if "params" in r]
+    assert full, "no full stat records collected"
+    r = full[-1]
+    assert "layer_0/W" in r["params"]
+    stats = r["params"]["layer_0/W"]
+    assert {"mean", "std", "norm", "histogram"} <= set(stats)
+    assert sum(stats["histogram"]["counts"]) == 4 * 8
+    assert "gradients" in r and "layer_0/W" in r["gradients"]
+    # update:param ratios appear from the second full record on
+    ratios = storage.update_ratios("layer_0/W", "s1")
+    assert ratios and all(r > 0 for r in ratios)
+    assert storage.scores("s1") == [rec["score"] for rec in recs]
+
+
+def test_file_stats_storage_roundtrip(tmp_path):
+    path = str(tmp_path / "stats.jsonl")
+    storage = FileStatsStorage(path)
+    storage.put({"session": "a", "iteration": 0, "score": 1.0})
+    storage.put({"session": "b", "iteration": 0, "score": 2.0})
+    assert [r["score"] for r in storage.records("a")] == [1.0]
+    assert storage.session_ids() == ["a", "b"]
+    # appended lines are valid JSONL
+    with open(path) as f:
+        assert len([json.loads(l) for l in f]) == 2
+
+
+def test_profiling_listener_chrome_trace(tmp_path):
+    path = str(tmp_path / "trace.json")
+    model = _model()
+    model.add_listeners(ProfilingListener(path))
+    x, y = _data()
+    model.fit(x, y, epochs=3)
+    trace = json.load(open(path))
+    events = trace["traceEvents"]
+    iters = [e for e in events if e["cat"] == "train"]
+    epochs = [e for e in events if e["cat"] == "epoch"]
+    assert len(iters) == 3 and len(epochs) == 3
+    assert all(e["ph"] == "X" and e["dur"] > 0 for e in events)
+    assert iters[0]["args"]["score"] > 0
+
+
+def test_nan_panic_listener():
+    model = _model()
+    model.add_listeners(NanPanicListener())
+    x, y = _data()
+    # poison the params so the first score is NaN
+    model.params["layer_0"]["W"] = np.full((4, 8), np.nan, np.float32)
+    with pytest.raises(FloatingPointError, match="NaN panic"):
+        model.fit(x, y, epochs=1)
+
+
+def test_evaluative_listener_epoch_end():
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+
+    model = _model()
+    x, y = _data(64)
+    it = ListDataSetIterator(DataSet(x, y), 32)
+    lst = EvaluativeListener(it, frequency=0, log_fn=lambda *_: None)
+    model.add_listeners(lst)
+    model.fit(x, y, epochs=3)
+    assert len(lst.history) == 3
+    assert 0.0 <= lst.history[-1].accuracy() <= 1.0
+
+
+def test_stats_listener_on_computation_graph():
+    """Gradient stats flow on the graph solver too (review regression)."""
+    from deeplearning4j_tpu.nn import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.input_type import InputType
+
+    g = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+         .graph_builder().add_inputs("in")
+         .set_input_types(InputType.feed_forward(4)))
+    g.add_layer("d", DenseLayer(n_out=8), "in")
+    g.add_layer("out", OutputLayer(n_out=2), "d")
+    model = ComputationGraph(g.set_outputs("out").build()).init()
+    storage = InMemoryStatsStorage()
+    model.add_listeners(StatsListener(storage, update_frequency=1))
+    x, y = _data()
+    model.fit([x], [y], epochs=3)
+    full = [r for r in storage.records() if "gradients" in r]
+    assert full and "d/W" in full[-1]["gradients"]
