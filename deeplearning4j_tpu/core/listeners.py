@@ -136,6 +136,15 @@ class EvaluativeListener(TrainingListener):
     def _evaluate(self, model) -> None:
         import numpy as np
 
+        if self.evaluation_factory is None and hasattr(model, "evaluate"):
+            # single eval path: the model's own evaluate() loop (no second
+            # implementation to drift from)
+            ev = model.evaluate(self.iterator)
+            self.history.append(ev)
+            acc = getattr(ev, "accuracy", None)
+            if callable(acc):
+                self.log_fn(f"EvaluativeListener: accuracy={ev.accuracy():.4f}")
+            return
         if self.evaluation_factory is None:
             from ..train.evaluation import Evaluation
 

@@ -233,6 +233,12 @@ class DistributedTrainer:
                 model.iteration_count += 1
                 if sync:
                     model.score_value = float(last)
+                    if model.listeners.requires_arrays:
+                        # array-hungry listeners (StatsListener) must see the
+                        # LIVE params, not the stale pre-fit model copy
+                        # (gradients stay inside the SPMD step; records omit
+                        # the gradients section on this path)
+                        self.sync_to_model()
                     model.listeners.iteration_done(
                         model, model.iteration_count, model.epoch_count, model.score_value
                     )

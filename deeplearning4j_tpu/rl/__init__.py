@@ -1,0 +1,24 @@
+"""Reinforcement learning tier.
+
+Reference: rl4j (SURVEY.md §2.2 "RL4J"): MDP environment interface,
+experience replay, DQN/double-DQN with target network, epsilon-greedy
+policies. The jitted Q-update batches TD targets onto the device; the
+environment loop stays host-side (tiny, sequential by nature).
+"""
+
+from .mdp import MDP, CartPole, StepReply
+from .replay import ExpReplay, Transition
+from .policy import EpsGreedyPolicy, GreedyPolicy
+from .dqn import QLearningConfiguration, QLearningDiscreteDense
+
+__all__ = [
+    "CartPole",
+    "EpsGreedyPolicy",
+    "ExpReplay",
+    "GreedyPolicy",
+    "MDP",
+    "QLearningConfiguration",
+    "QLearningDiscreteDense",
+    "StepReply",
+    "Transition",
+]

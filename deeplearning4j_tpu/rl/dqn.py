@@ -1,0 +1,179 @@
+"""DQN / double-DQN over dense observations.
+
+Reference: org.deeplearning4j.rl4j.learning.sync.qlearning.discrete.
+QLearningDiscreteDense + QLearningConfiguration: epsilon-greedy rollout,
+experience replay, TD targets from a periodically-synced target network,
+double-DQN action selection.
+
+The Q-network is a MultiLayerNetwork (config DSL); the TD update is one
+jitted step (network forward x2 + masked MSE on the taken actions),
+mirroring how the reference drives a DL4J model from its learning loop.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Callable, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import NeuralNetConfiguration
+from ..nn.layers import DenseLayer, OutputLayer
+from ..nn.losses import LossFunction
+from ..nn.sequential import MultiLayerNetwork
+from ..train.updaters import Adam
+from .mdp import MDP
+from .policy import EpsGreedyPolicy
+from .replay import ExpReplay, Transition
+
+
+@dataclasses.dataclass
+class QLearningConfiguration:
+    """Reference: QLearningConfiguration (builder fields kept)."""
+
+    seed: int = 123
+    max_step: int = 10000
+    max_epoch_step: int = 500
+    exp_replay_size: int = 10000
+    batch_size: int = 32
+    target_dqn_update_freq: int = 100
+    update_start: int = 100
+    gamma: float = 0.99
+    eps_start: float = 1.0
+    min_epsilon: float = 0.05
+    epsilon_nb_step: int = 3000
+    double_dqn: bool = True
+    learning_rate: float = 1e-3
+    hidden: tuple = (64, 64)
+
+
+class QLearningDiscreteDense:
+    def __init__(self, mdp: MDP, conf: Optional[QLearningConfiguration] = None,
+                 network: Optional[MultiLayerNetwork] = None) -> None:
+        self.mdp = mdp
+        self.conf = conf or QLearningConfiguration()
+        c = self.conf
+        self.network = network or self._default_network()
+        self.target_params = copy.deepcopy(self.network.params)
+        self.replay = ExpReplay(c.exp_replay_size, c.batch_size, seed=c.seed)
+        self.policy = EpsGreedyPolicy(
+            self._q_values, mdp.action_size, eps_start=c.eps_start,
+            eps_min=c.min_epsilon, decay_steps=c.epsilon_nb_step, seed=c.seed)
+        self.episode_rewards: List[float] = []
+        self._steps = 0
+        self._q_jit = None
+        self._td_jit = None
+
+    def _default_network(self) -> MultiLayerNetwork:
+        c = self.conf
+        b = (NeuralNetConfiguration.builder().seed(c.seed)
+             .updater(Adam(learning_rate=c.learning_rate)).list())
+        from ..nn.activations import Activation
+
+        n_in = self.mdp.observation_size
+        for h in c.hidden:
+            b.layer(DenseLayer(n_in=n_in, n_out=h,
+                               activation=Activation.RELU))
+            n_in = h
+        # IDENTITY head: Q-values are unbounded regression targets (the
+        # OutputLayer default is the classifier's SOFTMAX)
+        b.layer(OutputLayer(n_in=n_in, n_out=self.mdp.action_size,
+                            loss=LossFunction.MSE,
+                            activation=Activation.IDENTITY))
+        return MultiLayerNetwork(b.build()).init()
+
+    # --- device-side pieces -------------------------------------------
+
+    def _q_values(self, obs: np.ndarray) -> np.ndarray:
+        if self._q_jit is None:
+            model = self.network
+
+            def q(params, state, x):
+                out, _, _ = model.forward_pure(params, state, x, train=False,
+                                               rng=None)
+                return out
+
+            self._q_jit = jax.jit(q)
+        return np.asarray(self._q_jit(self.network.params,
+                                      self.network.state,
+                                      jnp.asarray(obs, jnp.float32)))
+
+    def _td_targets(self, obs, actions, rewards, next_obs, dones
+                    ) -> np.ndarray:
+        """Q-matrix with the taken actions' entries replaced by TD targets —
+        feeding the standard fit(x, y) MSE step (the reference does the
+        same through its DQN output layer)."""
+        c = self.conf
+        if self._td_jit is None:
+            model = self.network
+
+            def td(params, target_params, state, obs, actions, rewards,
+                   next_obs, dones):
+                q_now, _, _ = model.forward_pure(params, state, obs,
+                                                 train=False, rng=None)
+                q_next_t, _, _ = model.forward_pure(target_params, state,
+                                                    next_obs, train=False,
+                                                    rng=None)
+                if c.double_dqn:
+                    q_next_live, _, _ = model.forward_pure(
+                        params, state, next_obs, train=False, rng=None)
+                    next_a = jnp.argmax(q_next_live, axis=1)
+                else:
+                    next_a = jnp.argmax(q_next_t, axis=1)
+                next_q = jnp.take_along_axis(
+                    q_next_t, next_a[:, None], axis=1)[:, 0]
+                targets = rewards + c.gamma * next_q * (1.0 - dones)
+                return q_now.at[jnp.arange(obs.shape[0]), actions].set(
+                    targets)
+
+            self._td_jit = jax.jit(td)
+        return np.asarray(self._td_jit(
+            self.network.params, self.target_params, self.network.state,
+            jnp.asarray(obs), jnp.asarray(actions), jnp.asarray(rewards),
+            jnp.asarray(next_obs), jnp.asarray(dones)))
+
+    # --- learning loop ------------------------------------------------
+
+    def train_step(self) -> None:
+        obs, actions, rewards, next_obs, dones = self.replay.sample()
+        y = self._td_targets(obs, actions, rewards, next_obs, dones)
+        self.network.fit(obs, y)
+
+    def train(self, on_episode_end: Optional[Callable[[int, float], None]]
+              = None) -> List[float]:
+        """Run the full learning loop (reference: QLearning.train())."""
+        c = self.conf
+        while self._steps < c.max_step:
+            obs = self.mdp.reset()
+            ep_reward = 0.0
+            for _ in range(c.max_epoch_step):
+                action = self.policy.next_action(obs)
+                reply = self.mdp.step(action)
+                self.replay.store(Transition(
+                    obs, action, reply.reward, reply.observation,
+                    reply.done))
+                obs = reply.observation
+                ep_reward += reply.reward
+                self._steps += 1
+                if self._steps >= c.update_start and len(self.replay) >= \
+                        self.replay.batch_size:
+                    self.train_step()
+                if self._steps % c.target_dqn_update_freq == 0:
+                    self.target_params = copy.deepcopy(self.network.params)
+                if reply.done or self._steps >= c.max_step:
+                    break
+            self.episode_rewards.append(ep_reward)
+            if on_episode_end:
+                on_episode_end(len(self.episode_rewards), ep_reward)
+        return self.episode_rewards
+
+    def get_policy(self):
+        """Greedy policy over the trained network (reference:
+        getPolicy())."""
+        from .policy import GreedyPolicy
+
+        return GreedyPolicy(self._q_values)

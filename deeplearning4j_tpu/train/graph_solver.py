@@ -87,15 +87,18 @@ class GraphSolver:
         out = fn(
             model.params, self.opt_state, model.state, xs, ys, rng
         )
+        grads = None
         if want_grads:
             params, opt_state, state, score, grads = out
-            model.listeners.gradient_calculation(model, grads)
         else:
             params, opt_state, state, score = out
         model.params = params
         model.state = state
         self.opt_state = opt_state
         model.last_batch_size = int(xs[0].shape[0])
+        if grads is not None:
+            # after reassignment: pre-step buffers were donated to the step
+            model.listeners.gradient_calculation(model, grads)
         return score
 
     def fit(self, data, labels=None, *, epochs: int = 1) -> None:

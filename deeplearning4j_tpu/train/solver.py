@@ -160,15 +160,19 @@ class Solver:
             model.params, self.opt_state, model.state,
             rnn_state if stateful else {}, x, y, rng, mask_a, lmask_a,
         )
+        grads = None
         if want_grads:
             params, opt_state, state, new_rnn, score, grads = out
-            model.listeners.gradient_calculation(model, grads)
         else:
             params, opt_state, state, new_rnn, score = out
         model.params = params
         model.state = state
         self.opt_state = opt_state
         model.last_batch_size = int(x.shape[0])
+        if grads is not None:
+            # after reassignment: the pre-step buffers were donated to the
+            # jitted step, so listeners must see the NEW params
+            model.listeners.gradient_calculation(model, grads)
         return score, new_rnn
 
     def fit_scan(self, features, labels, *, steps_per_call: Optional[int] = None) -> float:

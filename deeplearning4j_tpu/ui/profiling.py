@@ -25,8 +25,9 @@ class ProfilingListener(TrainingListener):
     ui.perfetto.dev). Each iteration is a complete event on the training
     track; epochs are nested spans."""
 
-    def __init__(self, path: str) -> None:
+    def __init__(self, path: str, flush_every: int = 50) -> None:
         self.path = path
+        self.flush_every = max(1, flush_every)
         self._events: List[dict] = []
         self._iter_start: Optional[float] = None
         self._epoch_start: Optional[float] = None
@@ -37,7 +38,12 @@ class ProfilingListener(TrainingListener):
         return (time.perf_counter() - self._t0) * 1e6
 
     def on_epoch_start(self, model: Any) -> None:
-        self._epoch_start = self._now_us()
+        now = self._now_us()
+        self._epoch_start = now
+        # iteration 1's span starts here (it includes jit compile — usually
+        # the dominant cost; a fabricated 1us duration would hide it) and
+        # inter-epoch time is not charged to the next iteration
+        self._iter_start = now
 
     def on_epoch_end(self, model: Any) -> None:
         if self._epoch_start is not None:
@@ -61,6 +67,9 @@ class ProfilingListener(TrainingListener):
                      "score": float(score)},
         })
         self._iter_start = now
+        # periodic flush: a run that dies mid-epoch still leaves a trace
+        if len(self._events) % self.flush_every == 0:
+            self.flush()
 
     def flush(self) -> None:
         with open(self.path, "w") as f:

@@ -81,10 +81,21 @@ class FileStatsStorage(StatsStorage):
         self.path = path
         directory = os.path.dirname(os.path.abspath(path))
         os.makedirs(directory, exist_ok=True)
+        self._fh = None
 
     def put(self, record: Dict[str, Any]) -> None:
-        with open(self.path, "a") as f:
-            f.write(json.dumps(record) + "\n")
+        # persistent handle + per-line flush: records flow every iteration;
+        # an open/close syscall pair per step would stall the dispatch
+        # pipeline the listeners docstring warns about
+        if self._fh is None:
+            self._fh = open(self.path, "a")
+        self._fh.write(json.dumps(record) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
 
     def records(self, session_id=None):
         if not os.path.exists(self.path):

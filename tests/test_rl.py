@@ -1,0 +1,73 @@
+"""RL tier tests: CartPole dynamics, replay, policies, and DQN learning
+(SURVEY.md §2.2 "RL4J")."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.rl import (
+    CartPole,
+    EpsGreedyPolicy,
+    ExpReplay,
+    QLearningConfiguration,
+    QLearningDiscreteDense,
+    Transition,
+)
+
+
+def test_cartpole_contract():
+    env = CartPole(max_steps=50, seed=1)
+    obs = env.reset()
+    assert obs.shape == (4,) and not env.is_done()
+    steps = 0
+    while not env.is_done():
+        reply = env.step(steps % 2)
+        steps += 1
+        assert reply.reward == 1.0
+    assert 1 <= steps <= 50
+    # reset restarts
+    env.reset()
+    assert not env.is_done()
+
+
+def test_exp_replay_ring():
+    rep = ExpReplay(max_size=5, batch_size=3, seed=0)
+    for i in range(8):
+        rep.store(Transition(np.full(2, i, np.float32), i % 2, float(i),
+                             np.zeros(2, np.float32), False))
+    assert len(rep) == 5
+    obs, actions, rewards, next_obs, dones = rep.sample()
+    assert obs.shape == (3, 2) and rewards.min() >= 3  # 0..2 overwritten
+
+
+def test_eps_greedy_anneals():
+    calls = []
+    pol = EpsGreedyPolicy(lambda x: np.array([[0.0, 1.0]]), 2,
+                          eps_start=1.0, eps_min=0.1, decay_steps=10, seed=0)
+    assert pol.epsilon == 1.0
+    for _ in range(10):
+        calls.append(pol.next_action(np.zeros(4, np.float32)))
+    assert abs(pol.epsilon - 0.1) < 1e-9
+    # greedy action is 1 once epsilon decayed
+    assert pol.next_action(np.zeros(4, np.float32)) in (0, 1)
+
+
+def test_dqn_learns_cartpole():
+    conf = QLearningConfiguration(
+        seed=7, max_step=3000, max_epoch_step=200, exp_replay_size=5000,
+        batch_size=64, target_dqn_update_freq=200, update_start=200,
+        epsilon_nb_step=1500, hidden=(32, 32), learning_rate=2e-3)
+    dqn = QLearningDiscreteDense(CartPole(max_steps=200, seed=3), conf)
+    rewards = dqn.train()
+    assert len(rewards) >= 5
+    first = np.mean(rewards[:5])
+    last = np.mean(rewards[-5:])
+    assert last > first * 1.5, (first, last)
+    # trained greedy policy holds the pole notably longer than random
+    policy = dqn.get_policy()
+    env = CartPole(max_steps=200, seed=11)
+    obs = env.reset()
+    steps = 0
+    while not env.is_done():
+        obs = env.step(policy.next_action(obs)).observation
+        steps += 1
+    assert steps > 50, steps
