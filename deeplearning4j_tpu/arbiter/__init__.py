@@ -1,0 +1,34 @@
+"""Arbiter — hyperparameter search.
+
+Reference: arbiter (SURVEY.md §2.2): parameter spaces over configs,
+random/grid candidate generation, local execution scoring candidates by
+training + evaluating, result tracking.
+"""
+
+from .spaces import (
+    ContinuousParameterSpace,
+    DiscreteParameterSpace,
+    FixedValue,
+    IntegerParameterSpace,
+    ParameterSpace,
+)
+from .search import (
+    CandidateResult,
+    GridSearchGenerator,
+    LocalOptimizationRunner,
+    OptimizationConfiguration,
+    RandomSearchGenerator,
+)
+
+__all__ = [
+    "CandidateResult",
+    "ContinuousParameterSpace",
+    "DiscreteParameterSpace",
+    "FixedValue",
+    "GridSearchGenerator",
+    "IntegerParameterSpace",
+    "LocalOptimizationRunner",
+    "OptimizationConfiguration",
+    "ParameterSpace",
+    "RandomSearchGenerator",
+]

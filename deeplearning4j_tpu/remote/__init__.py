@@ -1,0 +1,3 @@
+from .server import JsonModelServer, JsonRemoteInference
+
+__all__ = ["JsonModelServer", "JsonRemoteInference"]

@@ -1,0 +1,109 @@
+"""JSON-over-HTTP model serving.
+
+Reference: deeplearning4j-remote ``JsonModelServer`` (SURVEY.md §2.2
+"Remote inference"): HTTP endpoint wrapping a model, JSON in/out, with a
+matching ``JsonRemoteInference`` client. Serving goes through
+:class:`~deeplearning4j_tpu.parallel.inference.ParallelInference` so
+concurrent requests dynamically batch into one jitted forward (the
+reference's worker-pool + BatchedInferenceObservable collapses to that).
+
+Endpoints:
+  POST <path>   {"data": [[...]]}  → {"output": [[...]]}
+  GET  /health  → {"status": "ok"}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib import request as urllib_request
+
+import numpy as np
+
+from ..parallel.inference import InferenceMode, ParallelInference
+
+
+class JsonModelServer:
+    def __init__(self, model, *, port: int = 0, path: str = "/v1/serving",
+                 batch_limit: int = 32, workers: int = 2) -> None:
+        self.model = model
+        self.path = path
+        self._pi = ParallelInference(
+            model, inference_mode=InferenceMode.BATCHED,
+            batch_limit=batch_limit, workers=workers)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # silent by default
+                pass
+
+            def _send(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._send(200, {"status": "ok"})
+                else:
+                    self._send(404, {"error": f"unknown path {self.path}"})
+
+            def do_POST(self):
+                if self.path != outer.path:
+                    self._send(404, {"error": f"unknown path {self.path}"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length))
+                    data = np.asarray(payload["data"], np.float32)
+                    out = outer._pi.output(data)
+                    self._send(200, {"output": np.asarray(out).tolist()})
+                except Exception as e:
+                    self._send(400, {"error": str(e)})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "JsonModelServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, name="json-model-server",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._pi.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class JsonRemoteInference:
+    """Client helper (reference: JsonRemoteInference)."""
+
+    def __init__(self, endpoint: str, timeout: float = 30.0) -> None:
+        self.endpoint = endpoint
+        self.timeout = timeout
+
+    def predict(self, data) -> np.ndarray:
+        body = json.dumps({"data": np.asarray(data).tolist()}).encode()
+        req = urllib_request.Request(
+            self.endpoint, data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib_request.urlopen(req, timeout=self.timeout) as resp:
+            payload = json.loads(resp.read())
+        if "error" in payload:
+            raise RuntimeError(payload["error"])
+        return np.asarray(payload["output"], np.float32)

@@ -1,0 +1,112 @@
+"""Arbiter tests: spaces, generators, and a real search over a tiny net
+(SURVEY.md §2.2 "Arbiter")."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.arbiter import (
+    ContinuousParameterSpace,
+    DiscreteParameterSpace,
+    FixedValue,
+    GridSearchGenerator,
+    IntegerParameterSpace,
+    LocalOptimizationRunner,
+    OptimizationConfiguration,
+    RandomSearchGenerator,
+)
+
+
+def test_spaces_sample_and_grid():
+    rng = np.random.RandomState(0)
+    c = ContinuousParameterSpace(0.1, 1.0)
+    assert all(0.1 <= c.sample(rng) <= 1.0 for _ in range(20))
+    assert len(c.grid(5)) == 5
+    logc = ContinuousParameterSpace(1e-4, 1e-1, log_scale=True)
+    vals = [logc.sample(rng) for _ in range(50)]
+    assert min(vals) < 1e-3 and max(vals) > 1e-2  # spans decades
+    i = IntegerParameterSpace(2, 5)
+    assert set(i.grid(10)) == {2, 3, 4, 5}
+    d = DiscreteParameterSpace(["a", "b"])
+    assert d.grid(99) == ["a", "b"]
+    assert FixedValue(7).sample(rng) == 7
+    with pytest.raises(ValueError):
+        ContinuousParameterSpace(1.0, 0.1)
+    with pytest.raises(ValueError):
+        ContinuousParameterSpace(-1.0, 1.0, log_scale=True)
+
+
+def test_grid_generator_cartesian():
+    gen = GridSearchGenerator({
+        "a": DiscreteParameterSpace([1, 2]),
+        "b": DiscreteParameterSpace(["x", "y", "z"]),
+    })
+    combos = list(gen)
+    assert len(combos) == 6
+    assert {"a": 1, "b": "z"} in combos
+
+
+def test_random_generator_deterministic():
+    spaces = {"lr": ContinuousParameterSpace(1e-4, 1e-1, log_scale=True)}
+    a = list(RandomSearchGenerator(spaces, 5, seed=1))
+    b = list(RandomSearchGenerator(spaces, 5, seed=1))
+    assert a == b and len(a) == 5
+
+
+def test_search_finds_better_hyperparameters():
+    """Search lr × hidden for a tiny classifier; best beats worst clearly."""
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(128, 6).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] * x[:, 1] > 0).astype(int)]
+
+    def factory(hp):
+        conf = (NeuralNetConfiguration.builder().seed(11)
+                .updater(Adam(learning_rate=hp["lr"])).list()
+                .layer(DenseLayer(n_in=6, n_out=hp["hidden"]))
+                .layer(OutputLayer(n_in=hp["hidden"], n_out=2))
+                .build())
+        m = MultiLayerNetwork(conf).init()
+        m.fit(x, y, epochs=60)
+        return m
+
+    def score(model, hp):
+        return model.score(x, y)  # training loss (minimize)
+
+    runner = LocalOptimizationRunner(OptimizationConfiguration(
+        candidate_generator=RandomSearchGenerator({
+            "lr": ContinuousParameterSpace(1e-5, 1e-1, log_scale=True),
+            "hidden": IntegerParameterSpace(4, 32),
+        }, num_candidates=6, seed=4),
+        model_factory=factory,
+        score_function=score,
+        minimize=True,
+    ))
+    best = runner.execute()
+    scores = [r.score for r in runner.results]
+    assert runner.num_candidates_completed() == 6
+    assert best.score == min(scores)
+    assert best.score < max(scores) * 0.8  # search actually discriminates
+    assert best.error is None
+
+
+def test_failed_candidate_does_not_stop_search():
+    def factory(hp):
+        if hp["x"] == 2:
+            raise RuntimeError("boom")
+        return hp["x"]
+
+    runner = LocalOptimizationRunner(OptimizationConfiguration(
+        candidate_generator=GridSearchGenerator(
+            {"x": DiscreteParameterSpace([1, 2, 3])}),
+        model_factory=factory,
+        score_function=lambda m, hp: float(m),
+        minimize=True,
+    ))
+    best = runner.execute()
+    assert runner.num_candidates_completed() == 3
+    assert best.score == 1.0
+    failed = [r for r in runner.results if r.error]
+    assert len(failed) == 1 and "boom" in failed[0].error

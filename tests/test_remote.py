@@ -1,0 +1,83 @@
+"""JsonModelServer round-trip tests (SURVEY.md §2.2 "Remote inference")."""
+
+import json
+import threading
+from urllib import request as urllib_request
+from urllib.error import HTTPError
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.remote import JsonModelServer, JsonRemoteInference
+
+
+@pytest.fixture(scope="module")
+def server():
+    conf = (NeuralNetConfiguration.builder().seed(5).list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    srv = JsonModelServer(model, port=0, workers=2).start()
+    yield srv, model
+    srv.stop()
+
+
+def test_health(server):
+    srv, _ = server
+    with urllib_request.urlopen(
+            f"http://127.0.0.1:{srv.port}/health") as r:
+        assert json.loads(r.read())["status"] == "ok"
+
+
+def test_predict_matches_local(server):
+    srv, model = server
+    client = JsonRemoteInference(
+        f"http://127.0.0.1:{srv.port}/v1/serving")
+    x = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+    remote = client.predict(x)
+    local = np.asarray(model.output(x))
+    np.testing.assert_allclose(remote, local, atol=1e-5)
+
+
+def test_concurrent_requests_batched(server):
+    srv, model = server
+    client = JsonRemoteInference(
+        f"http://127.0.0.1:{srv.port}/v1/serving")
+    rng = np.random.RandomState(1)
+    inputs = [rng.randn(2, 4).astype(np.float32) for _ in range(8)]
+    results = [None] * 8
+
+    def call(i):
+        results[i] = client.predict(inputs[i])
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for i in range(8):
+        np.testing.assert_allclose(results[i],
+                                   np.asarray(model.output(inputs[i])),
+                                   atol=1e-5)
+
+
+def test_bad_request(server):
+    srv, _ = server
+    req = urllib_request.Request(
+        f"http://127.0.0.1:{srv.port}/v1/serving",
+        data=b'{"wrong": 1}',
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(HTTPError) as ei:
+        urllib_request.urlopen(req, timeout=10)
+    assert ei.value.code == 400
+
+
+def test_unknown_path(server):
+    srv, _ = server
+    with pytest.raises(HTTPError) as ei:
+        urllib_request.urlopen(
+            f"http://127.0.0.1:{srv.port}/nope", timeout=10)
+    assert ei.value.code == 404
