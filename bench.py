@@ -1,16 +1,25 @@
 """Benchmark driver artifact.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
-Current headline: LeNet-MNIST training samples/sec on the attached TPU via
-MultiLayerNetwork.fit() — the reference's designated first baseline config
-(BASELINE.json:7 "LeNet MNIST via MultiLayerNetwork (nd4j-native CPU
-baseline)"). ``vs_baseline`` is TPU samples/sec divided by the same model's
-host-CPU-jax samples/sec measured in this run (the reference baseline config
-is CPU; no published numbers exist — BASELINE.md).
+Resilience contract (VERDICT.md round 1, "Next round" item 1b): the attached
+axon TPU plugin can hang during PJRT client init (observed: >120 s block
+inside ``make_c_api_client``), and this environment's sitecustomize forces
+``jax_platforms="axon,cpu"`` at interpreter start, so naive in-process
+benching can produce NO output at all. This driver therefore:
 
-Dataset: procedural MNIST-shaped data (no network; provenance recorded in
-deeplearning4j_tpu/data/mnist.py).
+  1. probes TPU availability in a bounded-time subprocess (retry once);
+  2. runs every measurement in its own subprocess with a hard timeout, so a
+     mid-bench hang costs one metric, not the artifact;
+  3. ALWAYS prints a final parsed JSON line — on a dead chip it re-runs the
+     measurements on host CPU and reports ``platform: "cpu-fallback"`` plus a
+     ``diagnostics`` field.
+
+Headline metric: ResNet-50 synthetic-ImageNet train samples/sec/chip
+(ComputationGraph path — BASELINE.md row 1). Extra rows: BERT-style encoder
+tokens/sec, LeNet-MNIST smoke. ``vs_baseline`` divides device throughput by
+the same config's host-CPU throughput measured in this run (the reference's
+designated baseline config is CPU; no published numbers exist — BASELINE.md).
 """
 
 import json
@@ -19,75 +28,261 @@ import subprocess
 import sys
 import time
 
+PROBE_TIMEOUT_S = 180
+MEASURE_TIMEOUT_S = 1500
 
-def measure_lenet(batch: int = 256, warmup_iters: int = 12, bench_iters: int = 60) -> float:
-    import numpy as np
 
+# --------------------------------------------------------------------------
+# measurements (run inside child processes)
+# --------------------------------------------------------------------------
+
+def _force_cpu_inprocess() -> None:
+    """Win over the sitecustomize's jax_platforms='axon,cpu' — effective
+    because no backend has initialized yet in a fresh child."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def measure_lenet(batch: int = 256, warmup_iters: int = 12, bench_iters: int = 60) -> dict:
+    """LeNet-MNIST MultiLayerNetwork.fit() smoke row (BASELINE.json:7)."""
+    import jax
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
     from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
     from deeplearning4j_tpu.model.zoo import LeNet
-
-    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
-    from deeplearning4j_tpu.data.dataset import DataSet
 
     model = LeNet(seed=42).init()
     base = MnistDataSetIterator(batch, train=True, num_examples=batch * 8)
     data = DataSet.merge(list(base))
 
     def run(n_iters: int) -> float:
-        import jax
-
-        from deeplearning4j_tpu.data.iterators import (
-            AsyncDataSetIterator,
-            device_put_dataset,
-        )
-
         epochs = max(1, n_iters // 8)
         it = ListDataSetIterator(data, batch)
         start = time.perf_counter()
-        model.fit(it, epochs=epochs)  # one fit call; sync only at the end
+        model.fit(it, epochs=epochs)
         jax.block_until_ready(model.params)
-        elapsed = time.perf_counter() - start
-        return elapsed / (epochs * 8)  # seconds per iteration
+        return (time.perf_counter() - start) / (epochs * 8)
 
-    run(warmup_iters)  # compile + cache warm
+    run(warmup_iters)
     per_iter = run(bench_iters)
-    return batch / per_iter
+    return {"samples_per_sec": batch / per_iter, "batch": batch}
 
 
-def main() -> None:
-    mode = sys.argv[1] if len(sys.argv) > 1 else "main"
-    if mode == "cpu-baseline":
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
+def measure_resnet50(batch: int = 64, warmup_iters: int = 3, bench_iters: int = 20,
+                     compute_dtype: str = "bfloat16") -> dict:
+    """ResNet-50 synthetic-ImageNet train samples/sec/chip + MFU
+    (BASELINE.md row 1; the reference's ComputationGraph.fit path)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
 
-        jax.config.update("jax_platforms", "cpu")
-        print(json.dumps({"cpu_samples_per_sec": measure_lenet(bench_iters=20)}))
-        return
+    from deeplearning4j_tpu.bench.flops import resnet50_train_flops_per_example
+    from deeplearning4j_tpu.bench.peak import chip_peak_flops
+    from deeplearning4j_tpu.model.zoo import ResNet50
+    from deeplearning4j_tpu.train.graph_solver import GraphSolver
 
-    tpu_sps = measure_lenet()
+    cd = None if compute_dtype in (None, "float32") else compute_dtype
+    model = ResNet50(seed=42, num_classes=1000, compute_dtype=cd).init()
+    solver = GraphSolver(model)
+    rng = np.random.RandomState(0)
+    # synthetic ImageNet at shape, NCHW (the framework's CNN convention)
+    x = jnp.asarray(rng.rand(batch, 3, 224, 224), model.dtype)
+    y_np = np.zeros((batch, 1000), np.float32)
+    y_np[np.arange(batch), rng.randint(0, 1000, batch)] = 1.0
+    y = jnp.asarray(y_np)
 
-    # reference-spirit baseline: same config on host CPU, separate process so
-    # the platform choice is clean
-    cpu_sps = None
+    for _ in range(warmup_iters):
+        solver.fit_batch((x,), (y,))
+    jax.block_until_ready(model.params)
+    start = time.perf_counter()
+    for _ in range(bench_iters):
+        solver.fit_batch((x,), (y,))
+    jax.block_until_ready(model.params)
+    sec_per_step = (time.perf_counter() - start) / bench_iters
+
+    sps = batch / sec_per_step
+    flops_per_ex = resnet50_train_flops_per_example()
+    achieved = sps * flops_per_ex
+    peak = chip_peak_flops(jax.devices()[0], compute_dtype)
+    return {
+        "samples_per_sec": sps,
+        "batch": batch,
+        "compute_dtype": compute_dtype,
+        "step_ms": sec_per_step * 1e3,
+        "model_tflops_per_sec": achieved / 1e12,
+        "mfu": (achieved / peak) if peak else None,
+    }
+
+
+def measure_bert(batch: int = 16, seq: int = 128, warmup_iters: int = 3,
+                 bench_iters: int = 20, compute_dtype: str = "bfloat16") -> dict:
+    """BERT-base-shaped encoder train tokens/sec + MFU (BASELINE.md row 2)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.bench.flops import bert_train_flops_per_token
+    from deeplearning4j_tpu.bench.peak import chip_peak_flops
+    from deeplearning4j_tpu.model.zoo import BertEncoder
+    from deeplearning4j_tpu.train.graph_solver import GraphSolver
+
+    cd = None if compute_dtype in (None, "float32") else compute_dtype
+    bert = BertEncoder(seed=42, compute_dtype=cd)
+    model = bert.init()
+    solver = GraphSolver(model)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, bert.vocab_size, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, bert.vocab_size, (batch, seq)), jnp.int32)
+
+    for _ in range(warmup_iters):
+        solver.fit_batch((ids,), (labels,))
+    jax.block_until_ready(model.params)
+    start = time.perf_counter()
+    for _ in range(bench_iters):
+        solver.fit_batch((ids,), (labels,))
+    jax.block_until_ready(model.params)
+    sec_per_step = (time.perf_counter() - start) / bench_iters
+
+    tokens_per_sec = batch * seq / sec_per_step
+    flops_per_tok = bert_train_flops_per_token(bert, seq)
+    achieved = tokens_per_sec * flops_per_tok
+    peak = chip_peak_flops(jax.devices()[0], compute_dtype)
+    return {
+        "tokens_per_sec": tokens_per_sec,
+        "batch": batch,
+        "seq": seq,
+        "compute_dtype": compute_dtype,
+        "step_ms": sec_per_step * 1e3,
+        "model_tflops_per_sec": achieved / 1e12,
+        "mfu": (achieved / peak) if peak else None,
+    }
+
+
+_MEASUREMENTS = {
+    "lenet": measure_lenet,
+    "resnet50": measure_resnet50,
+    "bert": measure_bert,
+}
+
+
+# --------------------------------------------------------------------------
+# orchestration (parent process)
+# --------------------------------------------------------------------------
+
+def _probe_tpu() -> dict:
+    """Bounded-time check that the axon TPU backend can initialize and run
+    one op. Retries once (the plugin is experimental and flaky)."""
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "d = jax.devices()[0];"
+        "x = jnp.ones((8, 8)) @ jnp.ones((8, 8));"
+        "x.block_until_ready();"
+        "print('PLATFORM:' + d.platform)"
+    )
+    last_err = ""
+    for attempt in range(2):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True, text=True,
+                timeout=PROBE_TIMEOUT_S,
+            )
+            for line in out.stdout.splitlines():
+                if line.startswith("PLATFORM:"):
+                    plat = line.split(":", 1)[1]
+                    if plat not in ("cpu",):
+                        return {"ok": True, "platform": plat, "attempts": attempt + 1}
+                    last_err = f"probe resolved to {plat}, not a TPU"
+            if not last_err:
+                last_err = (out.stderr or "no PLATFORM line").strip()[-500:]
+        except subprocess.TimeoutExpired:
+            last_err = f"probe timed out after {PROBE_TIMEOUT_S}s (PJRT init hang)"
+    return {"ok": False, "error": last_err}
+
+
+def _run_measurement(name: str, platform: str) -> dict:
+    """Run one measurement in a child process; returns its JSON or an error."""
+    argv = [sys.executable, os.path.abspath(__file__), "measure", name, platform]
     try:
         out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "cpu-baseline"],
-            capture_output=True, text=True, timeout=600,
+            argv, capture_output=True, text=True, timeout=MEASURE_TIMEOUT_S,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
         for line in out.stdout.splitlines():
             line = line.strip()
             if line.startswith("{"):
-                cpu_sps = json.loads(line)["cpu_samples_per_sec"]
-    except Exception:
-        pass
+                return json.loads(line)
+        return {"error": (out.stderr or f"rc={out.returncode}, no JSON").strip()[-500:]}
+    except subprocess.TimeoutExpired:
+        return {"error": f"measurement timed out after {MEASURE_TIMEOUT_S}s"}
 
-    result = {
-        "metric": "LeNet-MNIST train samples/sec (MultiLayerNetwork.fit, batch=256)",
-        "value": round(tpu_sps, 1),
-        "unit": "samples/sec",
-        "vs_baseline": round(tpu_sps / cpu_sps, 2) if cpu_sps else 1.0,
+
+def _child_measure(name: str, platform: str) -> None:
+    if platform == "cpu":
+        _force_cpu_inprocess()
+    kwargs = {}
+    if platform == "cpu":
+        # Host CPU baseline (this box: ONE core, ~50 GFLOP/s): shrink batch +
+        # iters so the denominator finishes inside the timeout, and use f32
+        # (CPUs emulate bf16 — it would understate the baseline). Throughput
+        # is normalized per sample/token, so the ratio stays comparable.
+        kwargs = {
+            "resnet50": {"batch": 8, "warmup_iters": 1, "bench_iters": 2,
+                         "compute_dtype": "float32"},
+            "bert": {"batch": 2, "warmup_iters": 1, "bench_iters": 2,
+                     "compute_dtype": "float32"},
+            "lenet": {"warmup_iters": 8, "bench_iters": 8},
+        }[name]
+    result = _MEASUREMENTS[name](**kwargs)
+    print(json.dumps(result))
+
+
+def main() -> None:
+    if len(sys.argv) > 2 and sys.argv[1] == "measure":
+        _child_measure(sys.argv[2], sys.argv[3] if len(sys.argv) > 3 else "tpu")
+        return
+
+    probe = _probe_tpu()
+    fallback = not probe["ok"]
+    platform = probe.get("platform", "cpu") if probe["ok"] else "cpu"
+    diagnostics = {} if probe["ok"] else {"tpu_probe_error": probe["error"]}
+
+    device = _run_measurement("resnet50", platform)
+    if "error" in device and not fallback:
+        # chip passed the probe but died mid-bench: fall back BEFORE the
+        # extras so a dead chip doesn't cost extra child timeouts, and the
+        # artifact still parses
+        diagnostics["tpu_bench_error"] = device["error"]
+        fallback = True
+        platform = "cpu"
+        device = _run_measurement("resnet50", "cpu")
+
+    # extras run on the platform that actually worked
+    extras = {
+        "bert": _run_measurement("bert", platform),
+        "lenet_smoke": _run_measurement("lenet", platform),
     }
+    cpu_base = device if platform == "cpu" else _run_measurement("resnet50", "cpu")
+
+    value = device.get("samples_per_sec")
+    base = cpu_base.get("samples_per_sec")
+    result = {
+        "metric": "ResNet-50 synthetic-ImageNet train samples/sec/chip "
+                  f"(ComputationGraph.fit, batch={device.get('batch')}, "
+                  f"{device.get('compute_dtype', 'f32')})",
+        "value": round(value, 2) if value else None,
+        "unit": "samples/sec",
+        "vs_baseline": round(value / base, 2) if value and base else 1.0,
+        "platform": "cpu-fallback" if fallback else platform,
+        "mfu": round(device["mfu"], 4) if device.get("mfu") else None,
+        "extras": extras,
+    }
+    if diagnostics:
+        result["diagnostics"] = diagnostics
+    if value is None and "error" in device:
+        result["diagnostics"] = {**diagnostics, "bench_error": device["error"]}
     print(json.dumps(result))
 
 

@@ -1,0 +1,15 @@
+"""Benchmark support: model-FLOPs accounting and chip peak rates for MFU.
+
+MFU (model FLOPs utilization) = achieved model FLOPs/sec divided by the
+chip's peak FLOPs/sec — the headline efficiency metric for the TPU build
+(VERDICT.md round 1, "Next round" item 2).
+"""
+
+from .flops import bert_train_flops_per_token, resnet50_train_flops_per_example
+from .peak import chip_peak_flops
+
+__all__ = [
+    "bert_train_flops_per_token",
+    "chip_peak_flops",
+    "resnet50_train_flops_per_example",
+]

@@ -1,0 +1,48 @@
+"""Chip peak FLOPs/sec lookup for MFU denominators.
+
+Public per-generation bf16 peak matmul rates (dense, per chip). The axon
+PJRT plugin reports generic device kinds, so the generation can also come
+from the ``PALLAS_AXON_TPU_GEN`` env var this environment sets.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# bf16 dense peak FLOPs/sec per chip (public spec sheets)
+_PEAKS_BF16 = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def _generation(device) -> Optional[str]:
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    plat = (getattr(device, "platform", "") or "").lower()
+    if plat == "cpu":
+        return None
+    for gen in ("v6e", "v5p", "v5e", "v4"):
+        if gen in kind.replace(" ", ""):
+            return gen
+    if "v5 lite" in kind or "v5lite" in kind:
+        return "v5e"
+    env = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    if env in _PEAKS_BF16:
+        return env
+    return None
+
+
+def chip_peak_flops(device, compute_dtype: str = "bfloat16") -> Optional[float]:
+    """Peak FLOPs/sec for ``device``, or None when unknown (CPU — MFU is
+    then reported as null rather than against a made-up denominator).
+    f32 runs at half the bf16 MXU rate on these generations."""
+    gen = _generation(device)
+    if gen is None:
+        return None
+    peak = _PEAKS_BF16[gen]
+    if str(compute_dtype) in ("float32", "f32"):
+        peak = peak / 2.0
+    return peak

@@ -72,3 +72,28 @@ def default_float_dtype():
     from .env import get_environment
 
     return jnp.dtype(get_environment().default_dtype)
+
+
+def cast_floats(tree, dtype):
+    """Cast every floating-point array leaf of a pytree to ``dtype``,
+    leaving integer/bool leaves and ``None`` untouched.
+
+    This is the mixed-precision boundary cast: the model keeps float32
+    master params (reference analog: the cuDNN-era pseudo-half mode where
+    FP32 master weights back FP16 math), and the forward/backward runs in
+    ``compute_dtype`` (bf16 on the TPU MXU). TPU bf16 needs no loss
+    scaling — its exponent range matches f32.
+    """
+    import jax
+
+    want = jnp.dtype(dtype)
+
+    def cast(leaf):
+        if leaf is None:
+            return None
+        arr = jnp.asarray(leaf)
+        if jnp.issubdtype(arr.dtype, jnp.floating) and arr.dtype != want:
+            return arr.astype(want)
+        return leaf
+
+    return jax.tree_util.tree_map(cast, tree)

@@ -4,6 +4,7 @@ No pretrained-weight downloads (zero-egress environment); architectures are
 construction-parity with the reference and train from scratch.
 """
 
+from .bert import BertEncoder
 from .darknet import Darknet19, TinyYOLO
 from .inception_resnet import InceptionResNetV1
 from .lenet import LeNet
@@ -16,6 +17,7 @@ from .xception import Xception
 
 __all__ = [
     "AlexNet",
+    "BertEncoder",
     "Darknet19",
     "InceptionResNetV1",
     "LeNet",

@@ -1,0 +1,108 @@
+"""BERT-style transformer encoder as a native ComputationGraph.
+
+Reference: the reference reaches BERT through SameDiff TF import
+(SURVEY.md §2.2 "TF import" — the BASELINE.json:10 tokens/sec path); it has
+no native-layer BERT. This zoo model is the TPU-native equivalent used for
+the headline BERT throughput benchmark: pre-LN transformer blocks built from
+the framework's own layers (SelfAttentionLayer, time-distributed DenseLayer
+FFN, LayerNorm, ElementWiseVertex residuals), MLM-style sparse softmax loss
+over the vocab. bert-base defaults (L=12, H=768, A=12, FFN=3072,
+vocab=30522).
+
+Sequence format is the framework's recurrent convention [batch, features,
+time]; token ids enter as [batch, time] int32.
+"""
+
+from __future__ import annotations
+
+from ...nn import Activation, LossFunction, NeuralNetConfiguration, WeightInit
+from ...nn.graph import ComputationGraph
+from ...nn.layers import (
+    DenseLayer,
+    EmbeddingSequenceLayer,
+    LayerNormLayer,
+    PositionalEmbeddingLayer,
+    RnnOutputLayer,
+)
+from ...nn.vertices import ElementWiseOp, ElementWiseVertex
+from ...train.updaters import Adam
+
+
+class BertEncoder:
+    def __init__(
+        self,
+        vocab_size: int = 30522,
+        hidden: int = 768,
+        n_layers: int = 12,
+        n_heads: int = 12,
+        ffn_size: int = 3072,
+        max_len: int = 512,
+        seed: int = 123,
+        updater=None,
+        dtype: str = "float32",
+        compute_dtype: str = None,
+    ) -> None:
+        self.vocab_size = vocab_size
+        self.hidden = hidden
+        self.n_layers = n_layers
+        self.n_heads = n_heads
+        self.ffn_size = ffn_size
+        self.max_len = max_len
+        self.seed = seed
+        self.updater = updater or Adam(1e-4)
+        self.dtype = dtype
+        self.compute_dtype = compute_dtype
+
+    def _block(self, g, name: str, inp: str) -> str:
+        """Pre-LN transformer block: x + Attn(LN(x)), then x + FFN(LN(x))."""
+        from ...nn.layers import SelfAttentionLayer
+
+        h = self.hidden
+        g.add_layer(f"{name}_ln1", LayerNormLayer(n_out=h), inp)
+        g.add_layer(f"{name}_attn", SelfAttentionLayer(
+            n_in=h, n_out=h, n_heads=self.n_heads,
+            activation=Activation.IDENTITY,
+        ), f"{name}_ln1")
+        g.add_vertex(f"{name}_res1", ElementWiseVertex(op=ElementWiseOp.ADD),
+                     inp, f"{name}_attn")
+        g.add_layer(f"{name}_ln2", LayerNormLayer(n_out=h), f"{name}_res1")
+        g.add_layer(f"{name}_ffn1", DenseLayer(
+            n_in=h, n_out=self.ffn_size, activation=Activation.GELU,
+        ), f"{name}_ln2")
+        g.add_layer(f"{name}_ffn2", DenseLayer(
+            n_in=self.ffn_size, n_out=h, activation=Activation.IDENTITY,
+        ), f"{name}_ffn1")
+        g.add_vertex(f"{name}_res2", ElementWiseVertex(op=ElementWiseOp.ADD),
+                     f"{name}_res1", f"{name}_ffn2")
+        return f"{name}_res2"
+
+    def conf(self):
+        g = (
+            NeuralNetConfiguration.builder()
+            .seed(self.seed)
+            .data_type(self.dtype)
+            .compute_dtype(self.compute_dtype)
+            .updater(self.updater)
+            .weight_init(WeightInit.XAVIER)
+            .graph_builder()
+            .add_inputs("ids")
+        )
+        g.add_layer("tok_emb", EmbeddingSequenceLayer(
+            n_in=self.vocab_size, n_out=self.hidden,
+        ), "ids")
+        g.add_layer("pos_emb", PositionalEmbeddingLayer(
+            n_out=self.hidden, max_len=self.max_len,
+        ), "tok_emb")
+        x = "pos_emb"
+        for i in range(self.n_layers):
+            x = self._block(g, f"blk{i}", x)
+        g.add_layer("final_ln", LayerNormLayer(n_out=self.hidden), x)
+        g.add_layer("mlm", RnnOutputLayer(
+            n_in=self.hidden, n_out=self.vocab_size,
+            loss=LossFunction.SPARSE_MCXENT, activation=Activation.SOFTMAX,
+        ), "final_ln")
+        g.set_outputs("mlm")
+        return g.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()

@@ -36,6 +36,7 @@ class ResNet50:
         channels: int = 3,
         updater=None,
         dtype: str = "float32",
+        compute_dtype: str = None,
     ) -> None:
         self.num_classes = num_classes
         self.seed = seed
@@ -44,6 +45,7 @@ class ResNet50:
         self.channels = channels
         self.updater = updater or Adam(1e-3)
         self.dtype = dtype
+        self.compute_dtype = compute_dtype
 
     # ---- block builders ---------------------------------------------------
     def _conv_bn(self, g, name, n_out, kernel, stride, inp, activation=True, mode=ConvolutionMode.SAME):
@@ -77,6 +79,7 @@ class ResNet50:
             NeuralNetConfiguration.builder()
             .seed(self.seed)
             .data_type(self.dtype)
+            .compute_dtype(self.compute_dtype)
             .updater(self.updater)
             .weight_init(WeightInit.RELU)
             .graph_builder()

@@ -40,7 +40,14 @@ def _try_build() -> None:
     script = os.path.join(_REPO_ROOT, "native", "build.sh")
     if not os.path.exists(script):
         return
-    _log.info("libdl4jtpu not found; building via %s", script)
+    if os.environ.get("DL4J_TPU_AUTOBUILD", "1") == "0":
+        _log.info("libdl4jtpu not built and DL4J_TPU_AUTOBUILD=0; "
+                  "using NumPy fallbacks")
+        return
+    # warning level so the first-use stall (up to ~2 min of cmake/g++) is
+    # attributable in serving/test logs; disable via DL4J_TPU_AUTOBUILD=0
+    _log.warning("libdl4jtpu not found; building via %s (may take up to "
+                 "120s; set DL4J_TPU_AUTOBUILD=0 to skip)", script)
     try:
         proc = subprocess.run(["sh", script], capture_output=True,
                               timeout=120, check=False, text=True)
@@ -154,8 +161,12 @@ def threshold_encode(grad: np.ndarray, threshold: float,
                      ) -> Optional[np.ndarray]:
     """Encode |g|>threshold entries as a sparse int32 stream, subtracting
     the threshold in place (residual / error feedback). Returns None when
-    the encoding would exceed ``max_elements`` (fall back to bitmap)."""
+    the encoding would exceed ``max_elements`` (fall back to bitmap) or when
+    the buffer is too large for the int32 +/-(index+1) wire format
+    (>= 2^31-1 elements; the gradient is left untouched either way)."""
     flat = _flat_f32_view(grad, "grad")
+    if flat.size >= 2**31 - 1:
+        return None  # mirrors the C guard (returns -2)
     cap = int(max_elements) if max_elements is not None else flat.size
     lib = _load()
     if lib is not None:
@@ -193,7 +204,9 @@ def threshold_decode(encoded: np.ndarray, threshold: float,
 def bitmap_encode(grad: np.ndarray, threshold: float
                   ) -> Tuple[np.ndarray, int]:
     """Dense 2-bit codec (00 zero / 01 +thr / 10 -thr), residual in place.
-    Returns (bitmap bytes, count of non-zero codes)."""
+    Returns (bitmap bytes, count of non-zero codes). The count is
+    informational (compression-ratio accounting) — bitmap_decode takes the
+    TOTAL element count of the tensor, not this value."""
     flat = _flat_f32_view(grad, "grad")
     bitmap = np.zeros((flat.size + 3) // 4, np.uint8)
     lib = _load()
@@ -218,6 +231,10 @@ def bitmap_encode(grad: np.ndarray, threshold: float
 
 def bitmap_decode(bitmap: np.ndarray, n: int, threshold: float,
                   target: np.ndarray) -> None:
+    """Apply a bitmap-encoded update to ``target``. ``n`` is the TOTAL
+    element count of the encoded tensor (4 codes per bitmap byte, the last
+    byte may be padding) — NOT the non-zero count bitmap_encode returns;
+    passing that would silently decode only a prefix."""
     flat = _flat_f32_view(target, "target")
     lib = _load()
     if lib is not None:

@@ -169,6 +169,10 @@ class MultiLayerConfiguration:
     input_type: Optional[InputType] = None
     seed: int = 0
     dtype: str = "float32"
+    # Mixed precision: params/updater state stay in ``dtype`` (f32 master
+    # weights); forward/backward math runs in ``compute_dtype`` (bf16 on the
+    # TPU MXU). None = compute in ``dtype`` (no mixed precision).
+    compute_dtype: Optional[str] = None
     updater: Optional[Any] = None
     backprop_type: BackpropType = BackpropType.STANDARD
     tbptt_fwd_length: int = 20
@@ -253,6 +257,7 @@ class ListBuilder:
             input_type=self._input_type,
             seed=p._seed,
             dtype=p._dtype,
+            compute_dtype=p._compute_dtype,
             updater=p._updater,
             backprop_type=self._backprop_type,
             tbptt_fwd_length=self._tbptt_fwd,
@@ -271,6 +276,7 @@ class NeuralNetConfigurationBuilder:
     def __init__(self) -> None:
         self._seed = 0
         self._dtype = "float32"
+        self._compute_dtype: Optional[str] = None
         self._activation: Optional[Activation] = None
         self._weight_init: Optional[WeightInit] = None
         self._dist: Optional[Distribution] = None
@@ -294,6 +300,12 @@ class NeuralNetConfigurationBuilder:
 
     def data_type(self, dtype: str) -> "NeuralNetConfigurationBuilder":
         self._dtype = dtype
+        return self
+
+    def compute_dtype(self, dtype: Optional[str]) -> "NeuralNetConfigurationBuilder":
+        """Mixed-precision compute dtype (e.g. "bfloat16"); params stay in
+        ``data_type``. See MultiLayerConfiguration.compute_dtype."""
+        self._compute_dtype = dtype
         return self
 
     def activation(self, a) -> "NeuralNetConfigurationBuilder":

@@ -49,6 +49,15 @@ class ComputationGraph:
     def dtype(self):
         return jnp.dtype(self.conf.dtype)
 
+    def _to_compute(self, params, inputs):
+        """Mixed-precision boundary (see MultiLayerNetwork._to_compute)."""
+        cd = getattr(self.conf, "compute_dtype", None)
+        if not cd or jnp.dtype(cd) == self.dtype:
+            return params, inputs
+        from ..core.dtypes import cast_floats
+
+        return cast_floats(params, cd), [cast_floats(x, cd) for x in inputs]
+
     # Solver compatibility surface ------------------------------------------
     def named_param_layers(self) -> List[Tuple[str, Layer]]:
         return [
@@ -93,6 +102,7 @@ class ComputationGraph:
         stop_at_outputs: bool = True,
     ):
         """Topo-order forward. Returns ({vertex: activation}, new_state)."""
+        params, inputs = self._to_compute(params, inputs)
         acts: Dict[str, jax.Array] = dict(zip(self.conf.network_inputs, inputs))
         vmasks: Dict[str, Optional[jax.Array]] = {}
         if masks is not None:
@@ -131,6 +141,10 @@ class ComputationGraph:
         train: bool = True,
     ):
         """Weighted sum of output-layer losses + regularization."""
+        # regularization runs on master (uncast) params; forward math in
+        # compute_dtype
+        master_params = params
+        params, inputs = self._to_compute(params, inputs)
         acts_needed: Dict[str, jax.Array] = {}
         # run the full graph once; output layers need their INPUT activations,
         # so run forward but for output layer vertices compute loss instead.
@@ -178,8 +192,8 @@ class ComputationGraph:
         for name, l in losses.items():
             total = total + self.output_weights.get(name, 1.0) * l.astype(score_dtype)
         for name, layer in self.named_param_layers():
-            if params.get(name):
-                total = total + _layer_reg_score(layer, params[name], score_dtype)
+            if master_params.get(name):
+                total = total + _layer_reg_score(layer, master_params[name], score_dtype)
         return total, new_state
 
     # -------------------------------------------------------------- user API
@@ -197,7 +211,9 @@ class ComputationGraph:
         if key not in self._output_fn_cache:
             def fn(params, state, xs, masks):
                 acts, _ = self.forward_pure(params, state, xs, train=False, rng=None, masks=masks)
-                return tuple(acts[n] for n in self.conf.network_outputs)
+                # user-facing outputs in the model dtype even under a bf16
+                # compute_dtype (mixed precision is an internal property)
+                return tuple(acts[n].astype(self.dtype) for n in self.conf.network_outputs)
 
             self._output_fn_cache[key] = jax.jit(fn)
         outs = self._output_fn_cache[key](self.params, self.state, xs, masks)

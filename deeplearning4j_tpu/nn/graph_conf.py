@@ -50,6 +50,9 @@ class ComputationGraphConfiguration:
     input_types: Tuple[InputType, ...] = ()
     seed: int = 0
     dtype: str = "float32"
+    # Mixed precision (see MultiLayerConfiguration.compute_dtype): f32 master
+    # params, forward/backward in compute_dtype (bf16 on the TPU MXU).
+    compute_dtype: Optional[str] = None
     updater: Optional[object] = None
     backprop_type: BackpropType = BackpropType.STANDARD
     tbptt_fwd_length: int = 20
@@ -194,6 +197,7 @@ class GraphBuilder:
             input_types=tuple(self._input_types),
             seed=p._seed,
             dtype=p._dtype,
+            compute_dtype=p._compute_dtype,
             updater=p._updater,
             backprop_type=self._backprop_type,
             tbptt_fwd_length=self._tbptt_fwd,

@@ -20,6 +20,7 @@ from .feedforward import (
     DropoutLayer,
     EmbeddingLayer,
     EmbeddingSequenceLayer,
+    PositionalEmbeddingLayer,
     PReLULayer,
 )
 from .norm import (

@@ -30,11 +30,18 @@ class DenseLayer(Layer):
     has_bias: bool = True
 
     def output_type(self, input_type: InputType) -> InputType:
+        if isinstance(input_type, RecurrentType):
+            # time-distributed path (3D apply branch): sequence in, sequence
+            # out. In the builder pipeline dense normally sees FF input (a
+            # RnnToFeedForward preprocessor is auto-inserted first).
+            return RecurrentType(size=self.n_out, timesteps=input_type.timesteps)
         return FeedForwardType(size=self.n_out)
 
     def with_input(self, input_type: InputType) -> "DenseLayer":
         if self.n_in:
             return self
+        if isinstance(input_type, RecurrentType):
+            return dataclasses.replace(self, n_in=input_type.size)
         return dataclasses.replace(self, n_in=input_type.flat_size())
 
     def has_params(self) -> bool:
@@ -56,9 +63,16 @@ class DenseLayer(Layer):
 
     def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
         x = apply_input_dropout(self, x, ctx)
-        y = x @ params["W"]
-        if self.has_bias:
-            y = y + params["b"]
+        if x.ndim == 3:
+            # time-distributed dense over recurrent [b, f, t] input (the
+            # transformer FFN): one einsum the MXU tiles over batch*time
+            y = jnp.einsum("bft,fg->bgt", x, params["W"])
+            if self.has_bias:
+                y = y + params["b"][None, :, None]
+        else:
+            y = x @ params["W"]
+            if self.has_bias:
+                y = y + params["b"]
         act = self.activation or Activation.SIGMOID  # reference default
         return act(y), state
 
@@ -175,6 +189,42 @@ class EmbeddingSequenceLayer(Layer):
             emb = emb + params["b"]
         act = self.activation or Activation.IDENTITY
         return act(emb).transpose(0, 2, 1), state  # -> [batch, n_out, time]
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class PositionalEmbeddingLayer(Layer):
+    """Learned absolute position embeddings added to a recurrent-format
+    sequence: x[b, f, t] + P[:t].T. Transformer building block (the reference
+    reaches BERT via SameDiff TF import — SURVEY.md §2.2 "TF import"; this is
+    the native-layer equivalent used by the zoo BertEncoder)."""
+
+    n_out: int = 0
+    max_len: int = 512
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def with_input(self, input_type: InputType) -> "PositionalEmbeddingLayer":
+        if self.n_out or not isinstance(input_type, RecurrentType):
+            return self
+        return dataclasses.replace(self, n_out=input_type.size)
+
+    def has_params(self) -> bool:
+        return True
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return ("P",)
+
+    def weight_param_names(self) -> Tuple[str, ...]:
+        return ()
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        return {"P": 0.02 * jax.random.normal(key, (self.max_len, self.n_out), dtype)}
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        t = x.shape[-1]
+        return x + params["P"][:t].T[None], state
 
 
 @register_config

@@ -73,21 +73,27 @@ class BatchNormalizationLayer(Layer):
         # reduce over all axes except the feature axis (1)
         axes = (0,) + tuple(range(2, x.ndim))
         bshape = (1, self.n_out) + (1,) * (x.ndim - 2)
+        # statistics always in >= f32: under bf16 mixed precision the batch
+        # moments and running stats would otherwise lose too many mantissa
+        # bits (running state arrives in the master dtype and stays there)
+        stat_dtype = jnp.promote_types(x.dtype, jnp.float32)
+        x32 = x.astype(stat_dtype)
         if ctx.train:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            mean = jnp.mean(x32, axis=axes)
+            var = jnp.var(x32, axis=axes)
             new_state = {
-                "mean": self.decay * state["mean"] + (1.0 - self.decay) * mean,
-                "var": self.decay * state["var"] + (1.0 - self.decay) * var,
+                "mean": self.decay * state["mean"] + (1.0 - self.decay) * mean.astype(state["mean"].dtype),
+                "var": self.decay * state["var"] + (1.0 - self.decay) * var.astype(state["var"].dtype),
             }
         else:
-            mean, var = state["mean"], state["var"]
+            mean, var = state["mean"].astype(stat_dtype), state["var"].astype(stat_dtype)
             new_state = state
-        xhat = (x - mean.reshape(bshape)) * jax.lax.rsqrt(var.reshape(bshape) + self.eps)
+        xhat = (x32 - mean.reshape(bshape)) * jax.lax.rsqrt(var.reshape(bshape) + self.eps)
         if not self.lock_gamma_beta:
-            xhat = xhat * params["gamma"].reshape(bshape) + params["beta"].reshape(bshape)
+            xhat = (xhat * params["gamma"].astype(stat_dtype).reshape(bshape)
+                    + params["beta"].astype(stat_dtype).reshape(bshape))
         act = self.activation or Activation.IDENTITY
-        return act(xhat), new_state
+        return act(xhat).astype(x.dtype), new_state
 
 
 @register_config
@@ -147,10 +153,15 @@ class LayerNormLayer(Layer):
 
     def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
         feat_axis = 1 if x.ndim == 3 else -1  # recurrent [b,f,t] vs ff [b,f]
-        mean = jnp.mean(x, axis=feat_axis, keepdims=True)
-        var = jnp.var(x, axis=feat_axis, keepdims=True)
-        xhat = (x - mean) * jax.lax.rsqrt(var + self.eps)
+        # statistics in >= f32 under bf16 mixed precision (same rationale as
+        # BatchNormalizationLayer; LN runs 2/block on the transformer path)
+        stat_dtype = jnp.promote_types(x.dtype, jnp.float32)
+        x32 = x.astype(stat_dtype)
+        mean = jnp.mean(x32, axis=feat_axis, keepdims=True)
+        var = jnp.var(x32, axis=feat_axis, keepdims=True)
+        xhat = (x32 - mean) * jax.lax.rsqrt(var + self.eps)
         bshape = (1, self.n_out, 1) if x.ndim == 3 else (1, self.n_out)
-        y = xhat * params["gamma"].reshape(bshape) + params["beta"].reshape(bshape)
+        y = (xhat * params["gamma"].astype(stat_dtype).reshape(bshape)
+             + params["beta"].astype(stat_dtype).reshape(bshape))
         act = self.activation or Activation.IDENTITY
-        return act(y), state
+        return act(y).astype(x.dtype), state

@@ -166,7 +166,9 @@ class RnnOutputLayer(BaseOutputLayer):
 
     def compute_loss(self, params, x, labels, ctx, label_mask=None):
         pre = self.preoutput(params, x, ctx)  # [b*t, nOut]
-        lab = _rnn_to_ff(labels)
+        # sparse integer labels [b, t] (SPARSE_MCXENT) flatten in the same
+        # (batch, time) order as _rnn_to_ff; dense labels are [b, nOut, t]
+        lab = _rnn_to_ff(labels) if labels.ndim == 3 else labels.reshape(-1)
         act = self.activation or Activation.SOFTMAX
         mask = None
         if label_mask is not None:

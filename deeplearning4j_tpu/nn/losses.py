@@ -99,6 +99,14 @@ def _score_array(
     weights: Optional[jax.Array],
 ) -> jax.Array:
     act = Activation.from_any(activation)
+    # loss math in >= f32 regardless of compute dtype: log/exp/div on bf16
+    # logits is where mixed precision loses accuracy for no speed win (the
+    # FLOPs live in the matmuls, not here)
+    if jnp.issubdtype(pre.dtype, jnp.floating):
+        f32 = jnp.promote_types(pre.dtype, jnp.float32)
+        pre = pre.astype(f32)
+        if jnp.issubdtype(jnp.asarray(labels).dtype, jnp.floating):
+            labels = jnp.asarray(labels).astype(f32)
     sum_last = lambda a: jnp.sum(a, axis=tuple(range(1, a.ndim)))
 
     if kind in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD):

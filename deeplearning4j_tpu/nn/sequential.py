@@ -77,6 +77,17 @@ class MultiLayerNetwork:
     def dtype(self):
         return jnp.dtype(self.conf.dtype)
 
+    def _to_compute(self, params, x):
+        """Mixed-precision boundary: cast params + input to compute_dtype
+        (bf16 on the TPU MXU) while master params stay in ``dtype``.
+        No-op when compute_dtype is unset or equals dtype. Idempotent."""
+        cd = getattr(self.conf, "compute_dtype", None)
+        if not cd or jnp.dtype(cd) == self.dtype:
+            return params, x
+        from ..core.dtypes import cast_floats
+
+        return cast_floats(params, cd), cast_floats(x, cd)
+
     def layer_names(self) -> List[str]:
         return [self.conf.layer_name(i) for i in range(len(self.layers))]
 
@@ -125,6 +136,7 @@ class MultiLayerNetwork:
     ):
         """Pure forward through layers [0, upto). Returns
         (out, new_state, new_rnn_state, activations?)."""
+        params, x = self._to_compute(params, x)
         new_state: Dict[str, Dict[str, jax.Array]] = {}
         new_rnn: Dict[str, Dict[str, jax.Array]] = {}
         acts: List[jax.Array] = []
@@ -174,6 +186,10 @@ class MultiLayerNetwork:
         out_layer = self.layers[-1]
         if not isinstance(out_layer, BaseOutputLayer):
             raise ValueError("Last layer must be an output/loss layer to compute a score")
+        # regularization is computed on the master (uncast) params below;
+        # the compute-dtype cast applies to forward math only
+        master_params = params
+        params, x = self._to_compute(params, x)
         feat, new_state, new_rnn = self.forward_pure(
             params, state, x, train=train, rng=rng, mask=mask,
             rnn_state=rnn_state, upto=len(self.layers) - 1,
@@ -196,8 +212,8 @@ class MultiLayerNetwork:
         reg = jnp.asarray(0.0, score_dtype)
         for i, layer in enumerate(self.layers):
             lname = self.conf.layer_name(i)
-            if params.get(lname):
-                reg = reg + _layer_reg_score(layer, params[lname], score_dtype)
+            if master_params.get(lname):
+                reg = reg + _layer_reg_score(layer, master_params[lname], score_dtype)
         return loss.astype(score_dtype) + reg, (new_state, new_rnn)
 
     # -------------------------------------------------------------- user API
@@ -209,7 +225,9 @@ class MultiLayerNetwork:
         if key not in self._output_fn_cache:
             def fn(params, state, xx, mk):
                 out, _, _ = self.forward_pure(params, state, xx, train=False, rng=None, mask=mk)
-                return out
+                # user-facing outputs in the model dtype even under a bf16
+                # compute_dtype (mixed precision is an internal property)
+                return out.astype(self.dtype)
 
             self._output_fn_cache[key] = jax.jit(fn)
         return self._output_fn_cache[key](self.params, self.state, x,

@@ -21,15 +21,25 @@ from ..core.listeners import TrainingListener
 
 def _tensor_stats(arr: np.ndarray, bins: int) -> Dict[str, Any]:
     flat = np.asarray(arr, np.float32).reshape(-1)
-    counts, edges = np.histogram(flat, bins=bins)
-    return {
-        "mean": float(flat.mean()),
-        "std": float(flat.std()),
-        "norm": float(np.linalg.norm(flat)),
-        "mean_magnitude": float(np.abs(flat).mean()),
+    # Divergence (NaN/Inf params or grads) is exactly what this dashboard
+    # exists to diagnose — record it instead of letting np.histogram raise
+    # from inside the listener and kill the run.
+    finite = flat[np.isfinite(flat)]
+    nonfinite = int(flat.size - finite.size)
+    if finite.size == 0:
+        finite = np.zeros(1, np.float32)
+    counts, edges = np.histogram(finite, bins=bins)
+    out = {
+        "mean": float(finite.mean()),
+        "std": float(finite.std()),
+        "norm": float(np.linalg.norm(finite)),
+        "mean_magnitude": float(np.abs(finite).mean()),
         "histogram": {"min": float(edges[0]), "max": float(edges[-1]),
                       "counts": counts.tolist()},
     }
+    if nonfinite:
+        out["nonfinite_count"] = nonfinite
+    return out
 
 
 class StatsStorage:

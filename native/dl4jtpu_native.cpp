@@ -33,6 +33,11 @@ extern "C" {
 
 int64_t dl4j_threshold_encode(float* grad, int64_t n, float threshold,
                               int32_t* out, int64_t max_out) {
+  // The int32 wire format encodes +/-(index+1): indices beyond INT32_MAX-1
+  // would overflow into corrupt/negative entries AFTER the residual was
+  // already subtracted, silently dropping gradient signal. Refuse up front
+  // (the caller falls back to the dense path, gradient untouched).
+  if (n >= INT32_MAX - 1) return -2;
   // Counting pass first: on overflow the gradient must be left untouched
   // so the caller can re-encode the SAME signal with the bitmap codec.
   int64_t count = 0;
