@@ -74,6 +74,36 @@ def default_float_dtype():
     return jnp.dtype(get_environment().default_dtype)
 
 
+def as_input(x, dtype, keep_int: bool = False):
+    """Convert a model input to a device array at the model's float dtype.
+
+    With ``keep_int`` (the input feeds an index-consuming layer — see
+    ``Layer.consumes_indices``), integer inputs KEEP their dtype: token ids
+    must never pass through a float dtype, because a later ``cast_floats``
+    compute-dtype boundary (bf16) represents integers exactly only up to
+    256, so a float-cast id above that lands on the wrong embedding row.
+    Otherwise every input — including uint8 image bytes — is promoted to
+    ``dtype``, matching the reference's convertDataType ingestion.
+    """
+    arr = jnp.asarray(x)
+    if arr.dtype == jnp.dtype(dtype):
+        return arr
+    if keep_int and not jnp.issubdtype(arr.dtype, jnp.floating):
+        return arr
+    return arr.astype(dtype)
+
+
+def as_input_np(x, dtype, keep_int: bool = False):
+    """Host-side twin of :func:`as_input` for code that must keep the batch
+    on host until an explicit ``device_put`` (sharded training)."""
+    arr = np.asarray(x)
+    if arr.dtype == np.dtype(dtype):
+        return arr
+    if keep_int and not np.issubdtype(arr.dtype, np.floating):
+        return arr
+    return arr.astype(dtype)
+
+
 def cast_floats(tree, dtype):
     """Cast every floating-point array leaf of a pytree to ``dtype``,
     leaving integer/bool leaves and ``None`` untouched.

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.dtypes import as_input
 from ..core.listeners import ListenerBus, TrainingListener
 from ..core.rng import RngState
 from .graph_conf import ComputationGraphConfiguration, VertexSpec
@@ -48,6 +49,24 @@ class ComputationGraph:
     @property
     def dtype(self):
         return jnp.dtype(self.conf.dtype)
+
+    def keeps_int_input(self, input_name: str) -> bool:
+        """True when ``input_name`` feeds an index-consuming layer
+        (embedding lookup) — its integer dtype is then preserved through
+        every cast boundary (see core.dtypes.as_input)."""
+        for spec in self.conf.vertices:
+            if input_name in spec.inputs and spec.layer is not None \
+                    and getattr(spec.layer, "consumes_indices", False):
+                return True
+        return False
+
+    def _as_inputs(self, xs) -> tuple:
+        names = self.conf.network_inputs
+        return tuple(
+            as_input(x, self.dtype,
+                     self.keeps_int_input(names[i]) if i < len(names) else False)
+            for i, x in enumerate(xs)
+        )
 
     def _to_compute(self, params, inputs):
         """Mixed-precision boundary (see MultiLayerNetwork._to_compute)."""
@@ -206,7 +225,7 @@ class ComputationGraph:
     def output(self, *inputs, masks=None):
         """Inference; returns one array or a tuple matching network_outputs."""
         self._check_init()
-        xs = tuple(jnp.asarray(x, self.dtype) for x in inputs)
+        xs = self._as_inputs(inputs)
         key = ("output", masks is not None)
         if key not in self._output_fn_cache:
             def fn(params, state, xs, masks):
@@ -221,7 +240,7 @@ class ComputationGraph:
 
     def score(self, features, labels, masks=None, label_masks=None) -> float:
         self._check_init()
-        xs = tuple(jnp.asarray(x, self.dtype) for x in self._as_tuple(features))
+        xs = self._as_inputs(self._as_tuple(features))
         ys = tuple(jnp.asarray(y) for y in self._as_tuple(labels))
         s, _ = self.loss_pure(self.params, self.state, xs, ys, rng=None,
                               masks=masks, label_masks=label_masks, train=False)
@@ -229,7 +248,7 @@ class ComputationGraph:
 
     def calculate_gradients(self, features, labels, mask=None, label_mask=None):
         self._check_init()
-        xs = tuple(jnp.asarray(x, self.dtype) for x in self._as_tuple(features))
+        xs = self._as_inputs(self._as_tuple(features))
         ys = tuple(jnp.asarray(y) for y in self._as_tuple(labels))
         masks = None if mask is None else self._as_tuple(mask)
         lmasks = None if label_mask is None else self._as_tuple(label_mask)

@@ -25,7 +25,7 @@ codepath (and its class of fwd/bwd mismatch bugs).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, ClassVar, Dict, Optional, Tuple
 
 import jax
 
@@ -66,6 +66,13 @@ class Layer:
     weight_decay: Optional[float] = None
     updater: Optional[Any] = None  # per-layer updater config override
     frozen: bool = False  # transfer-learning freeze (reference: FrozenLayer)
+
+    # True on layers whose input is integer INDICES (embedding lookups).
+    # Inputs feeding such layers keep their integer dtype end-to-end: a
+    # float cast — especially the bf16 compute cast — corrupts ids > 256
+    # (bf16 has 8 mantissa bits). All other inputs are promoted to the
+    # model float dtype as the reference does.
+    consumes_indices: ClassVar[bool] = False
 
     # NOTE on dropout: the reference's layer-level ``dropOut(p)`` keeps each
     # input unit with probability p and scales by 1/p (inverted dropout with

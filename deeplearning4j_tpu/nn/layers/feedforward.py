@@ -112,6 +112,7 @@ class EmbeddingLayer(Layer):
     n_in: int = 0  # vocab size
     n_out: int = 0
     has_bias: bool = False
+    consumes_indices = True
 
     def output_type(self, input_type: InputType) -> InputType:
         return FeedForwardType(size=self.n_out)
@@ -158,6 +159,7 @@ class EmbeddingSequenceLayer(Layer):
     n_out: int = 0
     has_bias: bool = False
     inference_mode: bool = False
+    consumes_indices = True
 
     def output_type(self, input_type: InputType) -> InputType:
         ts = input_type.timesteps if isinstance(input_type, RecurrentType) else None

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.dtypes import as_input
 from ..core.listeners import ListenerBus, TrainingListener
 from ..core.rng import RngState
 from .conf import BackpropType, MultiLayerConfiguration
@@ -76,6 +77,12 @@ class MultiLayerNetwork:
     @property
     def dtype(self):
         return jnp.dtype(self.conf.dtype)
+
+    def keeps_int_input(self) -> bool:
+        """True when the first layer consumes integer indices (embedding):
+        inputs then keep their integer dtype through every cast boundary
+        (see core.dtypes.as_input)."""
+        return bool(self.layers) and getattr(self.layers[0], "consumes_indices", False)
 
     def _to_compute(self, params, x):
         """Mixed-precision boundary: cast params + input to compute_dtype
@@ -220,7 +227,7 @@ class MultiLayerNetwork:
     def output(self, x, mask=None):
         """Inference forward (reference: MultiLayerNetwork.output)."""
         self._check_init()
-        x = jnp.asarray(x, self.dtype)
+        x = as_input(x, self.dtype, self.keeps_int_input())
         key = ("output", mask is not None)
         if key not in self._output_fn_cache:
             def fn(params, state, xx, mk):
@@ -236,7 +243,7 @@ class MultiLayerNetwork:
     def feed_forward(self, x, train: bool = False, mask=None):
         """All layer activations (reference: feedForward). Host-side list."""
         self._check_init()
-        x = jnp.asarray(x, self.dtype)
+        x = as_input(x, self.dtype, self.keeps_int_input())
         rng = self._rng.next_key() if train else None
         _, _, _, acts = self.forward_pure(
             self.params, self.state, x, train=train, rng=rng, mask=mask, collect=True
@@ -247,7 +254,7 @@ class MultiLayerNetwork:
         self._check_init()
         s, _ = self.loss_pure(
             self.params, self.state,
-            jnp.asarray(features, self.dtype), jnp.asarray(labels),
+            as_input(features, self.dtype, self.keeps_int_input()), jnp.asarray(labels),
             rng=None, mask=mask, label_mask=label_mask, train=False,
         )
         return float(s)
@@ -256,7 +263,7 @@ class MultiLayerNetwork:
         """Full gradient pytree for the given batch — the grad-check entry
         point (reference: computeGradientAndScore + Gradient object)."""
         self._check_init()
-        x = jnp.asarray(features, self.dtype)
+        x = as_input(features, self.dtype, self.keeps_int_input())
         y = jnp.asarray(labels)
 
         def loss_of(p):
@@ -293,7 +300,7 @@ class MultiLayerNetwork:
         """Stateful streaming inference (reference: rnnTimeStep): state (h/c)
         carries across calls."""
         self._check_init()
-        x = jnp.asarray(x, self.dtype)
+        x = as_input(x, self.dtype, self.keeps_int_input())
         single_step = False
         if x.ndim == 2 and self._expects_sequence_input():
             x = x[:, :, None]

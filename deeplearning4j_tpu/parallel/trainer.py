@@ -32,6 +32,7 @@ try:  # jax >= 0.6 public API
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore
 
+from ..core.dtypes import as_input, as_input_np
 from ..train.solver import LayerOptimizers, _normalize_gradients
 from .mesh import make_mesh
 from .strategies import GradientSyncStrategy, SyncAllReduce
@@ -193,6 +194,10 @@ class DistributedTrainer:
     def n_data_shards(self) -> int:
         return self.mesh.shape[self.data_axis]
 
+    def _keeps_int_input(self) -> bool:
+        fn = getattr(self.model, "keeps_int_input", None)
+        return bool(fn()) if callable(fn) else False
+
     def fit_batch(self, x, y) -> float:
         if self._step is None:
             self._step = self._build_step()
@@ -200,7 +205,7 @@ class DistributedTrainer:
         # keep host arrays host-side until device_put so each row goes
         # host->owning-shard once (jnp.asarray first would commit to the
         # default device and pay a second device->device scatter)
-        x = np.asarray(x, model.dtype)
+        x = as_input_np(x, model.dtype, self._keeps_int_input())
         y = np.asarray(y)
         n = self.n_data_shards
         if x.shape[0] % n:
@@ -304,7 +309,8 @@ class DistributedTrainer:
                 out_shardings=self._data_sharding,
             )
         self._reconcile_params()
-        return self._fwd(self.params, self.state, jnp.asarray(x, model.dtype))
+        return self._fwd(self.params, self.state,
+                         as_input(x, model.dtype, self._keeps_int_input()))
 
     def _reconcile_params(self) -> None:
         """For strategies whose replicas drift between sync points

@@ -79,7 +79,7 @@ class GraphSolver:
 
     def fit_batch(self, xs: Tuple, ys: Tuple):
         model = self.model
-        xs = tuple(jnp.asarray(x, model.dtype) for x in xs)
+        xs = model._as_inputs(xs)
         ys = tuple(jnp.asarray(y) for y in ys)
         want_grads = model.listeners.requires_arrays
         fn = self._step_fn(len(xs), len(ys), want_grads)
@@ -126,7 +126,7 @@ class GraphSolver:
                 rng = model._rng.next_key()
                 params, opt_state, state, score = fn(
                     model.params, self.opt_state, model.state,
-                    tuple(jnp.asarray(x, model.dtype) for x in xs_stack),
+                    model._as_inputs(xs_stack),
                     tuple(jnp.asarray(y) for y in ys_stack), rng,
                 )
                 model.params = params

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ..core.dtypes import as_input
 from ..nn.conf import BackpropType, GradientNormalization
 from ..nn.layers.base import Layer
 from .updaters import IUpdater, NoOp, Sgd, updater_from_any
@@ -147,7 +148,7 @@ class Solver:
 
     def fit_batch(self, x, y, mask=None, label_mask=None, rnn_state=None) -> Tuple[float, Optional[dict]]:
         model = self.model
-        x = jnp.asarray(x, model.dtype)
+        x = as_input(x, model.dtype, model.keeps_int_input())
         y = jnp.asarray(y)
         mask_a = None if mask is None else jnp.asarray(mask, model.dtype)
         lmask_a = None if label_mask is None else jnp.asarray(label_mask, model.dtype)
@@ -187,7 +188,7 @@ class Solver:
         final score.
         """
         model = self.model
-        x = jnp.asarray(features, model.dtype)
+        x = as_input(features, model.dtype, model.keeps_int_input())
         y = jnp.asarray(labels)
         key = ("scan",)
         if key not in self._step_cache:
