@@ -45,6 +45,26 @@ def _force_cpu_inprocess() -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+def _host_fence(tree) -> float:
+    """End a timed region by materializing ON HOST a scalar that
+    data-depends on ``tree``.
+
+    ``jax.block_until_ready`` returns without waiting under the axon PJRT
+    plugin (VERDICT.md round 3, verified live: a matmul chain "achieved"
+    1669 TFLOP/s block-timed vs ~34-38 TFLOP/s with a forced device->host
+    fetch), so a D2H copy of a result-dependent scalar is the only
+    trustworthy fence. Each training step is one jitted program whose
+    outputs all complete together, and step N's params depend on step
+    N-1's, so summing one leaf of the final params transitively fences the
+    whole timed chain.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    leaf = jax.tree_util.tree_leaves(tree)[0]
+    return float(jnp.sum(jnp.asarray(leaf, jnp.float32)))
+
+
 def measure_lenet(batch: int = 256, warmup_iters: int = 12, bench_iters: int = 60) -> dict:
     """LeNet-MNIST MultiLayerNetwork.fit() smoke row (BASELINE.json:7)."""
     import jax
@@ -61,9 +81,10 @@ def measure_lenet(batch: int = 256, warmup_iters: int = 12, bench_iters: int = 6
     def run(n_iters: int) -> float:
         epochs = max(1, n_iters // 8)
         it = ListDataSetIterator(data, batch)
+        _host_fence(model.params)  # drain pending work before starting the clock
         start = time.perf_counter()
         model.fit(it, epochs=epochs)
-        jax.block_until_ready(model.params)
+        _host_fence(model.params)
         return (time.perf_counter() - start) / (epochs * 8)
 
     run(warmup_iters)
@@ -96,11 +117,11 @@ def measure_resnet50(batch: int = 64, warmup_iters: int = 3, bench_iters: int = 
 
     for _ in range(warmup_iters):
         solver.fit_batch((x,), (y,))
-    jax.block_until_ready(model.params)
+    _host_fence(model.params)
     start = time.perf_counter()
     for _ in range(bench_iters):
         solver.fit_batch((x,), (y,))
-    jax.block_until_ready(model.params)
+    _host_fence(model.params)
     sec_per_step = (time.perf_counter() - start) / bench_iters
 
     sps = batch / sec_per_step
@@ -139,11 +160,11 @@ def measure_bert(batch: int = 16, seq: int = 128, warmup_iters: int = 3,
 
     for _ in range(warmup_iters):
         solver.fit_batch((ids,), (labels,))
-    jax.block_until_ready(model.params)
+    _host_fence(model.params)
     start = time.perf_counter()
     for _ in range(bench_iters):
         solver.fit_batch((ids,), (labels,))
-    jax.block_until_ready(model.params)
+    _host_fence(model.params)
     sec_per_step = (time.perf_counter() - start) / bench_iters
 
     tokens_per_sec = batch * seq / sec_per_step
@@ -161,10 +182,132 @@ def measure_bert(batch: int = 16, seq: int = 128, warmup_iters: int = 3,
     }
 
 
+def measure_bert_import(batch: int = 16, seq: int = 128, warmup_iters: int = 2,
+                        bench_iters: int = 10, hidden: int = 768, layers: int = 12,
+                        heads: int = 12, vocab: int = 30522) -> dict:
+    """THE BASELINE.json:10 metric: BERT-base via SameDiff TF import,
+    full-graph HLO compile, inference tokens/sec. A random-initialized
+    TFBertModel is frozen in-process (no network), imported with
+    TFGraphMapper, compiled to ONE XLA program, and timed with the host
+    fence. This is the imported graph, not the native BertEncoder zoo model
+    (that one is the separate "bert" row)."""
+    import numpy as np
+
+    try:
+        import tensorflow as tf  # noqa: F401
+        from transformers import BertConfig, TFBertModel
+        from tensorflow.python.framework.convert_to_constants import (
+            convert_variables_to_constants_v2,
+        )
+    except Exception as e:  # pragma: no cover - env-dependent
+        return {"error": f"tf/transformers unavailable: {e}"}
+
+    from deeplearning4j_tpu.samediff.tf_import import TFGraphMapper
+
+    cfg = BertConfig(
+        vocab_size=vocab, hidden_size=hidden, num_hidden_layers=layers,
+        num_attention_heads=heads, intermediate_size=hidden * 4,
+        max_position_embeddings=512,
+    )
+    model = TFBertModel(cfg)
+
+    @tf.function
+    def fwd(input_ids):
+        return model(input_ids, training=False).last_hidden_state
+
+    cf = fwd.get_concrete_function(tf.TensorSpec((batch, seq), tf.int32))
+    frozen = convert_variables_to_constants_v2(cf)
+    gd = frozen.graph.as_graph_def()
+    in_name = frozen.inputs[0].name.split(":")[0]
+    out_name = frozen.outputs[0].name.split(":")[0]
+
+    sd = TFGraphMapper.import_graph(gd, outputs=[out_name])
+    ids = np.random.default_rng(0).integers(0, vocab, (batch, seq)).astype(np.int32)
+    compiled = sd.compile({in_name: ids}, [out_name])
+    values = dict(sd._values)
+
+    def step():
+        return compiled(values, {in_name: ids})[out_name]
+
+    out = None
+    for _ in range(warmup_iters):
+        out = step()
+    _host_fence(out)
+    start = time.perf_counter()
+    for _ in range(bench_iters):
+        out = step()
+    _host_fence(out)
+    sec_per_step = (time.perf_counter() - start) / bench_iters
+
+    return {
+        "tokens_per_sec": batch * seq / sec_per_step,
+        "batch": batch, "seq": seq, "step_ms": sec_per_step * 1e3,
+        "model": f"TF-imported BERT-base (L={layers}, H={hidden}, vocab={vocab})",
+        "mode": "inference full-graph HLO",
+    }
+
+
+def measure_calibration(n: int = 4096, chain: int = 20, iters: int = 10) -> dict:
+    """Measured-peak calibration row + timer self-check.
+
+    Times a jitted chain of ``chain`` n*n bf16 matmuls two ways:
+      * ``fence``  — ends with a host fetch of a result-dependent scalar
+        (the trustworthy method; see _host_fence);
+      * ``block``  — ends with jax.block_until_ready (broken under axon).
+    ``measured_peak_tflops`` (fence-timed) is what the chip+plugin actually
+    sustains on pure MXU work — the honest MFU denominator ceiling.
+    ``timer_disagreement`` = block-method TFLOP/s / fence TFLOP/s; >2x means
+    block_until_ready is not waiting and any block-timed number is invalid.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.bench.peak import chip_peak_flops
+
+    @jax.jit
+    def chain_fn(x):
+        for _ in range(chain):
+            x = (x @ x) * (1.0 / n)  # rescale so values stay finite
+        return x
+
+    x = jnp.ones((n, n), jnp.bfloat16)
+    flops_per_call = 2.0 * n * n * n * chain
+
+    _host_fence(chain_fn(x))  # compile + drain the warmup execution itself
+
+    start = time.perf_counter()
+    y = x
+    for _ in range(iters):
+        y = chain_fn(y)
+    _host_fence(y)
+    fence_s = time.perf_counter() - start
+
+    start = time.perf_counter()
+    y = x
+    for _ in range(iters):
+        y = chain_fn(y)
+    jax.block_until_ready(y)
+    block_s = time.perf_counter() - start
+    _host_fence(y)  # drain whatever block_until_ready failed to wait for
+
+    fence_tflops = flops_per_call * iters / fence_s / 1e12
+    block_tflops = flops_per_call * iters / block_s / 1e12
+    peak = chip_peak_flops(jax.devices()[0], "bfloat16")
+    return {
+        "measured_peak_tflops": round(fence_tflops, 2),
+        "block_timed_tflops": round(block_tflops, 2),
+        "timer_disagreement": round(block_tflops / fence_tflops, 2),
+        "spec_peak_tflops": round(peak / 1e12, 1) if peak else None,
+        "matmul_n": n, "chain": chain, "iters": iters,
+    }
+
+
 _MEASUREMENTS = {
     "lenet": measure_lenet,
     "resnet50": measure_resnet50,
     "bert": measure_bert,
+    "bert_import": measure_bert_import,
+    "calibration": measure_calibration,
 }
 
 
@@ -234,6 +377,10 @@ def _child_measure(name: str, platform: str) -> None:
             "bert": {"batch": 2, "warmup_iters": 1, "bench_iters": 2,
                      "compute_dtype": "float32"},
             "lenet": {"warmup_iters": 8, "bench_iters": 8},
+            "bert_import": {"batch": 2, "seq": 32, "warmup_iters": 1,
+                            "bench_iters": 2, "hidden": 128, "layers": 2,
+                            "heads": 2, "vocab": 2000},
+            "calibration": {"n": 1024, "chain": 4, "iters": 2},
         }[name]
     result = _MEASUREMENTS[name](**kwargs)
     print(json.dumps(result))
@@ -249,6 +396,15 @@ def main() -> None:
     platform = probe.get("platform", "cpu") if probe["ok"] else "cpu"
     diagnostics = {} if probe["ok"] else {"tpu_probe_error": probe["error"]}
 
+    # calibration first: it is cheap, validates the timer, and gives the
+    # measured-peak MFU denominator for everything that follows
+    calibration = _run_measurement("calibration", platform)
+    if "error" in calibration and not fallback:
+        diagnostics["tpu_calibration_error"] = calibration["error"]
+        fallback = True
+        platform = "cpu"
+        calibration = _run_measurement("calibration", "cpu")
+
     device = _run_measurement("resnet50", platform)
     if "error" in device and not fallback:
         # chip passed the probe but died mid-bench: fall back BEFORE the
@@ -258,27 +414,77 @@ def main() -> None:
         fallback = True
         platform = "cpu"
         device = _run_measurement("resnet50", "cpu")
+        # the TPU-measured calibration peak must not denominate CPU rows
+        calibration = _run_measurement("calibration", "cpu")
 
     # extras run on the platform that actually worked
     extras = {
         "bert": _run_measurement("bert", platform),
+        "bert_tf_import": _run_measurement("bert_import", platform),
         "lenet_smoke": _run_measurement("lenet", platform),
+        "calibration": calibration,
     }
-    cpu_base = device if platform == "cpu" else _run_measurement("resnet50", "cpu")
 
+    measured_peak = calibration.get("measured_peak_tflops")
+    for row in (device, extras["bert"]):
+        if row.get("model_tflops_per_sec") and measured_peak:
+            row["mfu_vs_measured_peak"] = round(
+                row["model_tflops_per_sec"] / measured_peak, 4)
+
+    # timer self-check (VERDICT round 3 ask 1): MFU > 1 is physically
+    # impossible; >0.9 or a block-vs-fence disagreement >2x on the
+    # calibration matmul means the timing cannot be trusted
+    suspect = []
+    for label, row in (("resnet50", device), ("bert", extras["bert"])):
+        if row.get("mfu") and row["mfu"] > 0.9:
+            suspect.append(f"{label} mfu={row['mfu']:.3f} > 0.9")
+    if calibration.get("timer_disagreement") and calibration["timer_disagreement"] > 2.0:
+        suspect.append(
+            f"block_until_ready vs host-fence disagree {calibration['timer_disagreement']}x "
+            "on calibration matmul (expected under axon; fence timing is authoritative)")
+
+    # vs_baseline: same-metric CPU run. The denominator is a DIFFERENT
+    # config (batch 8, f32 — one slow host core can't run batch-64 bf16),
+    # so it is a cross-hardware indication, not a controlled comparison;
+    # baseline_config records exactly what was compared. Null (never a
+    # fake 1.0) when the baseline is missing or the device run fell back.
     value = device.get("samples_per_sec")
-    base = cpu_base.get("samples_per_sec")
+    vs_baseline = None
+    baseline_config = None
+    if not fallback:
+        cpu_base = _run_measurement("resnet50", "cpu")
+        base = cpu_base.get("samples_per_sec")
+        if value and base:
+            vs_baseline = round(value / base, 2)
+            baseline_config = {
+                "platform": "cpu", "batch": cpu_base.get("batch"),
+                "compute_dtype": cpu_base.get("compute_dtype"),
+                "samples_per_sec": round(base, 2),
+                "note": "per-sample throughput ratio across configs "
+                        "(device batch/dtype differ; see metric string)",
+            }
+
     result = {
         "metric": "ResNet-50 synthetic-ImageNet train samples/sec/chip "
                   f"(ComputationGraph.fit, batch={device.get('batch')}, "
                   f"{device.get('compute_dtype', 'f32')})",
         "value": round(value, 2) if value else None,
         "unit": "samples/sec",
-        "vs_baseline": round(value / base, 2) if value and base else 1.0,
+        "vs_baseline": vs_baseline,
+        "baseline_config": baseline_config,
         "platform": "cpu-fallback" if fallback else platform,
         "mfu": round(device["mfu"], 4) if device.get("mfu") else None,
+        "mfu_vs_measured_peak": device.get("mfu_vs_measured_peak"),
+        "timing_method": "host-fence (D2H scalar fetch; block_until_ready "
+                         "is a no-op under axon — see calibration row)",
         "extras": extras,
     }
+    if suspect:
+        # MFU>0.9 on a *model* bench means the timer lied; calibration
+        # disagreement alone is expected (that row exists to prove it) and
+        # only taints block-timed numbers, of which there are none left
+        result["timing_suspect"] = any("mfu" in s for s in suspect)
+        result["timing_notes"] = suspect
     if diagnostics:
         result["diagnostics"] = diagnostics
     if value is None and "error" in device:

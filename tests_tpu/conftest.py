@@ -1,0 +1,38 @@
+"""Real-TPU test configuration (VERDICT.md round 3 ask 3).
+
+Unlike tests/ (which forces a virtual 8-device CPU platform), this suite
+runs on whatever accelerator the session exposes and SKIPS everything when
+that is not a TPU. Run it directly: ``python -m pytest tests_tpu/ -q``.
+The tools/tpu_probe.py ledger harness runs it automatically in the first
+healthy TPU window.
+"""
+
+import pytest
+
+import jax
+
+
+def _is_tpu() -> bool:
+    try:
+        d = jax.devices()[0]
+    except Exception:
+        return False
+    return d.platform not in ("cpu",)
+
+
+collect_ignore_glob = []
+
+
+@pytest.fixture(scope="session")
+def tpu_device():
+    if not _is_tpu():
+        pytest.skip("no TPU attached (axon backend unavailable or cpu-only)")
+    return jax.devices()[0]
+
+
+def pytest_collection_modifyitems(config, items):
+    if _is_tpu():
+        return
+    skip = pytest.mark.skip(reason="no TPU attached")
+    for item in items:
+        item.add_marker(skip)

@@ -1,0 +1,149 @@
+"""TPU opportunity ledger (VERDICT.md round 3 ask 3).
+
+The attached axon TPU is intermittently healthy: PJRT init can hang, and a
+healthy chip can wedge mid-session (observed both ways in rounds 3-4). This
+harness probes the chip on a bounded clock, appends every attempt to
+``TPU_ATTEMPTS.jsonl``, and in a healthy window runs the real-TPU payload:
+
+  * ``python -m pytest tests_tpu/ -q``  (compiled Pallas kernels, parity +
+    timing, real train steps) -> archived to ``TPU_TEST_RESULTS.txt``
+  * ``python bench.py``                 (full bf16 bench, host-fence timing)
+    -> archived to ``BENCH_latest.json``
+
+Usage:
+  python tools/tpu_probe.py once             # one probe (+ payload if healthy)
+  python tools/tpu_probe.py probe-only       # one probe, never the payload
+  python tools/tpu_probe.py loop [interval]  # probe every N sec (default 600),
+                                             # run the payload in the FIRST
+                                             # healthy window, keep probing
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LEDGER = os.path.join(REPO, "TPU_ATTEMPTS.jsonl")
+PROBE_TIMEOUT_S = 150
+TESTS_TIMEOUT_S = 1800
+BENCH_TIMEOUT_S = 7200
+
+_PROBE_CODE = (
+    "import jax, jax.numpy as jnp;"
+    "d = jax.devices()[0];"
+    "x = jnp.ones((128, 128)) @ jnp.ones((128, 128));"
+    "s = float(jnp.sum(x));"  # host fetch: the only trustworthy sync under axon
+    "print('PROBE_OK', d.platform, d.device_kind, s)"
+)
+
+
+def _append(entry: dict) -> None:
+    entry["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(LEDGER, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
+def probe() -> dict:
+    start = time.time()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE], capture_output=True,
+            text=True, timeout=PROBE_TIMEOUT_S, cwd=REPO,
+        )
+        took = round(time.time() - start, 1)
+        for line in out.stdout.splitlines():
+            if line.startswith("PROBE_OK"):
+                _, plat, *rest = line.split(" ", 2)
+                if plat != "cpu":
+                    return {"kind": "probe", "ok": True, "platform": plat,
+                            "device": rest[0] if rest else "", "took_s": took}
+                return {"kind": "probe", "ok": False, "took_s": took,
+                        "error": "resolved to cpu (no TPU attached)"}
+        return {"kind": "probe", "ok": False, "took_s": took,
+                "error": (out.stderr or "no PROBE_OK line").strip()[-300:]}
+    except subprocess.TimeoutExpired:
+        return {"kind": "probe", "ok": False,
+                "took_s": round(time.time() - start, 1),
+                "error": f"probe timed out after {PROBE_TIMEOUT_S}s (PJRT hang)"}
+
+
+def run_payload() -> None:
+    """Real-TPU test suite + full bench; everything archived."""
+    start = time.time()
+    tests = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests_tpu/", "-q", "--tb=short"],
+        capture_output=True, text=True, timeout=TESTS_TIMEOUT_S, cwd=REPO,
+    )
+    with open(os.path.join(REPO, "TPU_TEST_RESULTS.txt"), "w") as f:
+        f.write(tests.stdout[-20000:] + "\n--- stderr ---\n" + tests.stderr[-5000:])
+    _append({"kind": "tpu_tests", "rc": tests.returncode,
+             "tail": tests.stdout.strip().splitlines()[-1] if tests.stdout.strip() else "",
+             "took_s": round(time.time() - start, 1)})
+
+    start = time.time()
+    bench = subprocess.run(
+        [sys.executable, "bench.py"], capture_output=True, text=True,
+        timeout=BENCH_TIMEOUT_S, cwd=REPO,
+    )
+    last_json = ""
+    for line in bench.stdout.splitlines():
+        if line.strip().startswith("{"):
+            last_json = line.strip()
+    if last_json:
+        with open(os.path.join(REPO, "BENCH_latest.json"), "w") as f:
+            f.write(last_json + "\n")
+    _append({"kind": "bench", "rc": bench.returncode,
+             "archived": bool(last_json),
+             "platform": (json.loads(last_json).get("platform")
+                          if last_json else None),
+             "took_s": round(time.time() - start, 1)})
+
+
+def payload_already_ran() -> bool:
+    """True once BOTH payload halves have succeeded on a real TPU (a bench
+    row alone — e.g. captured manually — must not stop the test suite)."""
+    if not os.path.exists(LEDGER):
+        return False
+    bench_ok = tests_ok = False
+    with open(LEDGER) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            e = json.loads(line)
+            if e.get("kind") == "bench" and e.get("platform") not in (None, "cpu-fallback"):
+                bench_ok = True
+            if e.get("kind") == "tpu_tests" and e.get("rc") == 0:
+                tests_ok = True
+    return bench_ok and tests_ok
+
+
+def main() -> None:
+    mode = sys.argv[1] if len(sys.argv) > 1 else "once"
+    if mode in ("once", "probe-only"):
+        result = probe()
+        _append(result)
+        print(json.dumps(result))
+        if mode == "once" and result["ok"]:
+            run_payload()
+        return
+    if mode == "loop":
+        interval = int(sys.argv[2]) if len(sys.argv) > 2 else 600
+        while True:
+            result = probe()
+            _append(result)
+            print(json.dumps(result), flush=True)
+            if result["ok"] and not payload_already_ran():
+                try:
+                    run_payload()
+                except Exception as e:  # keep the ledger alive
+                    _append({"kind": "payload_error", "error": str(e)[-300:]})
+            time.sleep(interval)
+    raise SystemExit(f"unknown mode {mode!r}")
+
+
+if __name__ == "__main__":
+    main()
