@@ -16,6 +16,7 @@ from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 # name -> callable(*arrays, **attrs)
@@ -198,6 +199,35 @@ def _split_v(x, size_splits=None, axis=0):
 
 sd_op("tile")(lambda x, reps=None: jnp.tile(x, [int(r) for r in reps]))
 sd_op("flip")(lambda x, axis=0: jnp.flip(x, int(axis)))
+sd_op("broadcast_to")(
+    lambda x, shape=None: jnp.broadcast_to(x, tuple(int(s) for s in shape)))
+sd_op("flatten2d")(lambda x: jnp.reshape(x, (x.shape[0], -1)))
+
+
+@sd_op("reshape_onnx")
+def _reshape_onnx(x, shape=None):
+    """ONNX Reshape semantics: 0 copies the input dim, -1 infers."""
+    out = [x.shape[i] if s == 0 else int(s) for i, s in enumerate(shape)]
+    return jnp.reshape(x, tuple(out))
+
+
+@sd_op("slice_onnx")
+def _slice_onnx(x, starts=None, ends=None, axes=None, steps=None):
+    """ONNX Slice semantics: per-axis [start:end:step] with negative
+    indices and INT64_MAX/INT64_MIN sentinels clamped to the dim."""
+    idx = [slice(None)] * x.ndim
+    for start, end, ax, st in zip(starts, ends, axes, steps):
+        ax = int(ax) % x.ndim
+        dim = x.shape[ax]
+        start, end, st = int(start), int(end), int(st)
+        if start > dim:
+            start = dim
+        if end > dim:
+            end = dim
+        if end < -dim:
+            end = None if st < 0 else -dim
+        idx[ax] = slice(start, end, st)
+    return x[tuple(idx)]
 
 
 @sd_op("slice")
@@ -397,8 +427,9 @@ def _dropout(x, rate=0.5, rng=None, deterministic=True):
 
 @sd_op("conv2d")
 def _conv2d(x, w, bias=None, strides=(1, 1), padding="SAME", data_format="NCHW",
-            dilations=(1, 1)):
-    """w layout: [kH, kW, inC, outC] (TF) — converted internally."""
+            dilations=(1, 1), groups=1):
+    """w layout: [kH, kW, inC/groups, outC] (TF HWIO) — converted internally.
+    ``groups`` maps to XLA feature_group_count (grouped/depthwise conv)."""
     df = str(data_format).upper()
     dn = (df, "HWIO", df)
     strides = tuple(int(s) for s in strides)
@@ -408,14 +439,16 @@ def _conv2d(x, w, bias=None, strides=(1, 1), padding="SAME", data_format="NCHW",
     y = lax.conv_general_dilated(
         x, w, window_strides=strides, padding=padding, rhs_dilation=dilations,
         dimension_numbers=lax.conv_dimension_numbers(x.shape, w.shape, dn),
+        feature_group_count=int(groups),
     )
     if bias is not None:
         y = _bias_add(y, bias, data_format=df)
     return y
 
 
-@sd_op("max_pool2d")
-def _max_pool2d(x, kernel=(2, 2), strides=(2, 2), padding="VALID", data_format="NCHW"):
+def _pool_geometry(kernel, strides, padding, data_format):
+    """Window/stride/padding in full-rank form. ``padding`` is either a lax
+    string or explicit per-spatial-dim (lo, hi) pairs (the ONNX pads form)."""
     df = str(data_format).upper()
     if df == "NCHW":
         window = (1, 1) + tuple(int(k) for k in kernel)
@@ -423,21 +456,31 @@ def _max_pool2d(x, kernel=(2, 2), strides=(2, 2), padding="VALID", data_format="
     else:
         window = (1,) + tuple(int(k) for k in kernel) + (1,)
         str_ = (1,) + tuple(int(s) for s in strides) + (1,)
-    return lax.reduce_window(x, -jnp.inf, lax.max, window, str_, str(padding).upper())
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        spatial = [(int(a), int(b)) for a, b in padding]
+        pad = ([(0, 0), (0, 0)] + spatial) if df == "NCHW" \
+            else ([(0, 0)] + spatial + [(0, 0)])
+    return window, str_, pad
+
+
+@sd_op("max_pool2d")
+def _max_pool2d(x, kernel=(2, 2), strides=(2, 2), padding="VALID", data_format="NCHW"):
+    window, str_, pad = _pool_geometry(kernel, strides, padding, data_format)
+    return lax.reduce_window(x, -jnp.inf, lax.max, window, str_, pad)
 
 
 @sd_op("avg_pool2d")
-def _avg_pool2d(x, kernel=(2, 2), strides=(2, 2), padding="VALID", data_format="NCHW"):
-    df = str(data_format).upper()
-    if df == "NCHW":
-        window = (1, 1) + tuple(int(k) for k in kernel)
-        str_ = (1, 1) + tuple(int(s) for s in strides)
-    else:
-        window = (1,) + tuple(int(k) for k in kernel) + (1,)
-        str_ = (1,) + tuple(int(s) for s in strides) + (1,)
-    summed = lax.reduce_window(x, 0.0, lax.add, window, str_, str(padding).upper())
+def _avg_pool2d(x, kernel=(2, 2), strides=(2, 2), padding="VALID", data_format="NCHW",
+                count_include_pad=False):
+    window, str_, pad = _pool_geometry(kernel, strides, padding, data_format)
+    summed = lax.reduce_window(x, 0.0, lax.add, window, str_, pad)
+    if count_include_pad:
+        return summed / float(np.prod([int(k) for k in kernel]))
+    # exclude-pad: divide by the true (unpadded) window population
     ones = jnp.ones_like(x)
-    counts = lax.reduce_window(ones, 0.0, lax.add, window, str_, str(padding).upper())
+    counts = lax.reduce_window(ones, 0.0, lax.add, window, str_, pad)
     return summed / counts
 
 

@@ -1,0 +1,426 @@
+"""ONNX import golden tests (VERDICT.md round 3 ask 4).
+
+No ``onnx`` package exists in this environment (and torch.onnx.export
+requires it), so fixtures are genuine ONNX ModelProtos built directly with
+the vendored protoc schema — byte-identical to what a serializer would
+produce — and golden outputs come from an INDEPENDENT implementation of the
+same math (torch CPU functional ops on the same weights). Two golden
+models: a ResNet-style residual CNN and a BERT-style transformer encoder
+block (the two families SURVEY.md:119 names for the reference importer).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+from deeplearning4j_tpu.modelimport.onnx import OnnxGraphMapper, tensor_to_numpy  # noqa: E402
+from deeplearning4j_tpu.modelimport.onnx_proto import onnx_pb2 as P  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# ModelProto builder (the serializer side of the fixture)
+# ---------------------------------------------------------------------------
+
+_NP_TO_ONNX = {np.dtype(np.float32): 1, np.dtype(np.int64): 7,
+               np.dtype(np.int32): 6, np.dtype(np.float64): 11,
+               np.dtype(np.bool_): 9}
+
+
+def make_tensor(name: str, arr: np.ndarray) -> P.TensorProto:
+    t = P.TensorProto()
+    t.name = name
+    t.data_type = _NP_TO_ONNX[np.dtype(arr.dtype)]
+    t.dims.extend(arr.shape)
+    t.raw_data = np.ascontiguousarray(arr).tobytes()
+    return t
+
+
+def make_attr(name: str, value) -> P.AttributeProto:
+    a = P.AttributeProto()
+    a.name = name
+    if isinstance(value, bool):
+        a.type, a.i = P.AttributeProto.INT, int(value)
+    elif isinstance(value, int):
+        a.type, a.i = P.AttributeProto.INT, value
+    elif isinstance(value, float):
+        a.type, a.f = P.AttributeProto.FLOAT, value
+    elif isinstance(value, str):
+        a.type, a.s = P.AttributeProto.STRING, value.encode()
+    elif isinstance(value, np.ndarray):
+        a.type = P.AttributeProto.TENSOR
+        a.t.CopyFrom(make_tensor("", value))
+    elif isinstance(value, (list, tuple)) and all(isinstance(v, int) for v in value):
+        a.type = P.AttributeProto.INTS
+        a.ints.extend(value)
+    elif isinstance(value, (list, tuple)):
+        a.type = P.AttributeProto.FLOATS
+        a.floats.extend(float(v) for v in value)
+    else:
+        raise TypeError(f"attr {name}: {type(value)}")
+    return a
+
+
+def make_node(op: str, inputs, outputs, **attrs) -> P.NodeProto:
+    n = P.NodeProto()
+    n.op_type = op
+    n.name = outputs[0]
+    n.input.extend(inputs)
+    n.output.extend(outputs)
+    for k, v in attrs.items():
+        n.attribute.append(make_attr(k, v))
+    return n
+
+
+def make_vi(name: str, dtype: np.dtype, shape) -> P.ValueInfoProto:
+    vi = P.ValueInfoProto()
+    vi.name = name
+    tt = vi.type.tensor_type
+    tt.elem_type = _NP_TO_ONNX[np.dtype(dtype)]
+    for d in shape:
+        dim = tt.shape.dim.add()
+        dim.dim_value = d
+    return vi
+
+
+def make_model(nodes, inputs, outputs, initializers, opset: int = 17) -> bytes:
+    m = P.ModelProto()
+    m.ir_version = 8
+    m.producer_name = "dl4j-tpu-test"
+    op = m.opset_import.add()
+    op.domain = ""
+    op.version = opset
+    g = m.graph
+    g.name = "g"
+    g.node.extend(nodes)
+    g.input.extend(inputs)
+    g.output.extend(outputs)
+    g.initializer.extend(initializers)
+    return m.SerializeToString()
+
+
+def test_tensor_roundtrip():
+    arr = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    np.testing.assert_array_equal(tensor_to_numpy(make_tensor("x", arr)), arr)
+
+
+# ---------------------------------------------------------------------------
+# golden model 1: ResNet-style residual CNN
+# ---------------------------------------------------------------------------
+
+def _resnet_style_fixture(rng):
+    """Conv-BN-Relu-MaxPool stem, one residual block, GAP-Flatten-Gemm head."""
+    p = {
+        "w0": rng.randn(8, 3, 3, 3).astype(np.float32) * 0.2,
+        "b0": rng.randn(8).astype(np.float32) * 0.1,
+        "bn0_s": rng.rand(8).astype(np.float32) + 0.5,
+        "bn0_b": rng.randn(8).astype(np.float32) * 0.1,
+        "bn0_m": rng.randn(8).astype(np.float32) * 0.1,
+        "bn0_v": rng.rand(8).astype(np.float32) + 0.5,
+        "w1": rng.randn(8, 8, 3, 3).astype(np.float32) * 0.2,
+        "bn1_s": rng.rand(8).astype(np.float32) + 0.5,
+        "bn1_b": rng.randn(8).astype(np.float32) * 0.1,
+        "bn1_m": rng.randn(8).astype(np.float32) * 0.1,
+        "bn1_v": rng.rand(8).astype(np.float32) + 0.5,
+        "w2": rng.randn(8, 8, 3, 3).astype(np.float32) * 0.2,
+        "bn2_s": rng.rand(8).astype(np.float32) + 0.5,
+        "bn2_b": rng.randn(8).astype(np.float32) * 0.1,
+        "bn2_m": rng.randn(8).astype(np.float32) * 0.1,
+        "bn2_v": rng.rand(8).astype(np.float32) + 0.5,
+        "wfc": rng.randn(8, 5).astype(np.float32) * 0.3,
+        "bfc": rng.randn(5).astype(np.float32) * 0.1,
+    }
+    nodes = [
+        make_node("Conv", ["x", "w0", "b0"], ["c0"], kernel_shape=[3, 3],
+                  pads=[1, 1, 1, 1], strides=[1, 1]),
+        make_node("BatchNormalization",
+                  ["c0", "bn0_s", "bn0_b", "bn0_m", "bn0_v"], ["n0"],
+                  epsilon=1e-5),
+        make_node("Relu", ["n0"], ["r0"]),
+        make_node("MaxPool", ["r0"], ["p0"], kernel_shape=[2, 2], strides=[2, 2]),
+        # residual block
+        make_node("Conv", ["p0", "w1"], ["c1"], kernel_shape=[3, 3],
+                  pads=[1, 1, 1, 1]),
+        make_node("BatchNormalization",
+                  ["c1", "bn1_s", "bn1_b", "bn1_m", "bn1_v"], ["n1"],
+                  epsilon=1e-5),
+        make_node("Relu", ["n1"], ["r1"]),
+        make_node("Conv", ["r1", "w2"], ["c2"], kernel_shape=[3, 3],
+                  pads=[1, 1, 1, 1]),
+        make_node("BatchNormalization",
+                  ["c2", "bn2_s", "bn2_b", "bn2_m", "bn2_v"], ["n2"],
+                  epsilon=1e-5),
+        make_node("Add", ["p0", "n2"], ["res"]),
+        make_node("Relu", ["res"], ["r2"]),
+        # head
+        make_node("GlobalAveragePool", ["r2"], ["gap"]),
+        make_node("Flatten", ["gap"], ["flat"], axis=1),
+        make_node("Gemm", ["flat", "wfc", "bfc"], ["y"], alpha=1.0, beta=1.0),
+    ]
+    model = make_model(
+        nodes,
+        inputs=[make_vi("x", np.float32, (2, 3, 16, 16))],
+        outputs=[make_vi("y", np.float32, (2, 5))],
+        initializers=[make_tensor(k, v) for k, v in p.items()],
+    )
+    return model, p
+
+
+def _torch_resnet_style(p, x):
+    """Independent reference implementation of the fixture graph."""
+    t = {k: torch.from_numpy(v) for k, v in p.items()}
+    h = F.conv2d(torch.from_numpy(x), t["w0"], t["b0"], padding=1)
+    h = F.batch_norm(h, t["bn0_m"], t["bn0_v"], t["bn0_s"], t["bn0_b"], eps=1e-5)
+    h = F.relu(h)
+    h = F.max_pool2d(h, 2, 2)
+    r = F.conv2d(h, t["w1"], padding=1)
+    r = F.batch_norm(r, t["bn1_m"], t["bn1_v"], t["bn1_s"], t["bn1_b"], eps=1e-5)
+    r = F.relu(r)
+    r = F.conv2d(r, t["w2"], padding=1)
+    r = F.batch_norm(r, t["bn2_m"], t["bn2_v"], t["bn2_s"], t["bn2_b"], eps=1e-5)
+    h = F.relu(h + r)
+    h = h.mean(dim=(2, 3))
+    return (h @ t["wfc"] + t["bfc"]).numpy()
+
+
+def test_onnx_resnet_style_golden():
+    rng = np.random.RandomState(0)
+    model_bytes, params = _resnet_style_fixture(rng)
+    x = rng.randn(2, 3, 16, 16).astype(np.float32)
+    expected = _torch_resnet_style(params, x)
+
+    sd = OnnxGraphMapper.import_model(model_bytes, outputs=["y"])
+    got = np.asarray(sd.output({"x": x}, ["y"])["y"])
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_onnx_resnet_style_full_graph_compiles():
+    rng = np.random.RandomState(1)
+    model_bytes, params = _resnet_style_fixture(rng)
+    x = rng.randn(2, 3, 16, 16).astype(np.float32)
+    sd = OnnxGraphMapper.import_model(model_bytes, outputs=["y"])
+    compiled = sd.compile({"x": x}, ["y"])
+    out = compiled(dict(sd._values), {"x": x})
+    np.testing.assert_allclose(
+        np.asarray(out["y"]), _torch_resnet_style(params, x), rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# golden model 2: BERT-style transformer encoder block
+# ---------------------------------------------------------------------------
+
+def _bert_style_fixture(rng, vocab=100, hidden=16, heads=2, seq=8, batch=2, ffn=32):
+    hd = hidden // heads
+    p = {
+        "emb": rng.randn(vocab, hidden).astype(np.float32) * 0.2,
+        "wq": rng.randn(hidden, hidden).astype(np.float32) * 0.2,
+        "wk": rng.randn(hidden, hidden).astype(np.float32) * 0.2,
+        "wv": rng.randn(hidden, hidden).astype(np.float32) * 0.2,
+        "wo": rng.randn(hidden, hidden).astype(np.float32) * 0.2,
+        "bq": rng.randn(hidden).astype(np.float32) * 0.1,
+        "bk": rng.randn(hidden).astype(np.float32) * 0.1,
+        "bv": rng.randn(hidden).astype(np.float32) * 0.1,
+        "bo": rng.randn(hidden).astype(np.float32) * 0.1,
+        "ln1_g": rng.rand(hidden).astype(np.float32) + 0.5,
+        "ln1_b": rng.randn(hidden).astype(np.float32) * 0.1,
+        "wf1": rng.randn(hidden, ffn).astype(np.float32) * 0.2,
+        "bf1": rng.randn(ffn).astype(np.float32) * 0.1,
+        "wf2": rng.randn(ffn, hidden).astype(np.float32) * 0.2,
+        "bf2": rng.randn(hidden).astype(np.float32) * 0.1,
+        "ln2_g": rng.rand(hidden).astype(np.float32) + 0.5,
+        "ln2_b": rng.randn(hidden).astype(np.float32) * 0.1,
+        # shape/scale constants the exporters emit as initializers
+        "split_shape": np.asarray([batch, seq, heads, hd], np.int64),
+        "merge_shape": np.asarray([batch, seq, hidden], np.int64),
+        "scale": np.asarray(1.0 / np.sqrt(hd), np.float32),
+        "half": np.asarray(0.5, np.float32),
+        "one": np.asarray(1.0, np.float32),
+        "inv_sqrt2": np.asarray(1.0 / np.sqrt(2.0), np.float32),
+    }
+
+    def proj(x, w, b, out):
+        return [make_node("MatMul", [x, w], [f"{out}_mm"]),
+                make_node("Add", [f"{out}_mm", b], [out])]
+
+    def heads_split(x, out):  # [b,s,h] -> [b,heads,s,hd]
+        return [make_node("Reshape", [x, "split_shape"], [f"{out}_r"]),
+                make_node("Transpose", [f"{out}_r"], [out], perm=[0, 2, 1, 3])]
+
+    nodes = [
+        make_node("Gather", ["emb", "ids"], ["x0"], axis=0),
+        *proj("x0", "wq", "bq", "q"), *heads_split("q", "qh"),
+        *proj("x0", "wk", "bk", "k"), *heads_split("k", "kh"),
+        *proj("x0", "wv", "bv", "v"), *heads_split("v", "vh"),
+        make_node("Transpose", ["kh"], ["kt"], perm=[0, 1, 3, 2]),
+        make_node("MatMul", ["qh", "kt"], ["scores_raw"]),
+        make_node("Mul", ["scores_raw", "scale"], ["scores"]),
+        make_node("Softmax", ["scores"], ["probs"], axis=-1),
+        make_node("MatMul", ["probs", "vh"], ["ctx_h"]),
+        make_node("Transpose", ["ctx_h"], ["ctx_t"], perm=[0, 2, 1, 3]),
+        make_node("Reshape", ["ctx_t", "merge_shape"], ["ctx"]),
+        *proj("ctx", "wo", "bo", "attn_out"),
+        make_node("Add", ["x0", "attn_out"], ["res1"]),
+        make_node("LayerNormalization", ["res1", "ln1_g", "ln1_b"], ["ln1"],
+                  axis=-1, epsilon=1e-5),
+        # FFN with exact erf-GELU, spelled out the way exporters decompose it
+        *proj("ln1", "wf1", "bf1", "f1"),
+        make_node("Mul", ["f1", "inv_sqrt2"], ["f1_s"]),
+        make_node("Erf", ["f1_s"], ["f1_erf"]),
+        make_node("Add", ["f1_erf", "one"], ["f1_e1"]),
+        make_node("Mul", ["f1", "f1_e1"], ["f1_xe"]),
+        make_node("Mul", ["f1_xe", "half"], ["gelu"]),
+        *proj("gelu", "wf2", "bf2", "f2"),
+        make_node("Add", ["ln1", "f2"], ["res2"]),
+        make_node("LayerNormalization", ["res2", "ln2_g", "ln2_b"], ["out"],
+                  axis=-1, epsilon=1e-5),
+    ]
+    model = make_model(
+        nodes,
+        inputs=[make_vi("ids", np.int64, (batch, seq))],
+        outputs=[make_vi("out", np.float32, (batch, seq, hidden))],
+        initializers=[make_tensor(k, v) for k, v in p.items()],
+    )
+    return model, p
+
+
+def _torch_bert_style(p, ids, heads=2):
+    t = {k: torch.from_numpy(np.asarray(v)) for k, v in p.items()}
+    x0 = t["emb"][torch.from_numpy(ids)]
+    b, s, h = x0.shape
+    hd = h // heads
+
+    def split(x):
+        return x.reshape(b, s, heads, hd).permute(0, 2, 1, 3)
+
+    q = split(x0 @ t["wq"] + t["bq"])
+    k = split(x0 @ t["wk"] + t["bk"])
+    v = split(x0 @ t["wv"] + t["bv"])
+    probs = torch.softmax(q @ k.transpose(-1, -2) / np.sqrt(hd), dim=-1)
+    ctx = (probs @ v).permute(0, 2, 1, 3).reshape(b, s, h)
+    res1 = x0 + ctx @ t["wo"] + t["bo"]
+    ln1 = F.layer_norm(res1, (h,), t["ln1_g"], t["ln1_b"], eps=1e-5)
+    f1 = ln1 @ t["wf1"] + t["bf1"]
+    gelu = 0.5 * f1 * (1.0 + torch.erf(f1 / np.sqrt(2.0)))
+    res2 = ln1 + gelu @ t["wf2"] + t["bf2"]
+    return F.layer_norm(res2, (h,), t["ln2_g"], t["ln2_b"], eps=1e-5).numpy()
+
+
+def test_onnx_bert_style_golden():
+    rng = np.random.RandomState(2)
+    model_bytes, params = _bert_style_fixture(rng)
+    ids = rng.randint(0, 100, (2, 8)).astype(np.int64)
+    expected = _torch_bert_style(params, ids)
+
+    sd = OnnxGraphMapper.import_model(model_bytes, outputs=["out"])
+    got = np.asarray(sd.output({"ids": ids}, ["out"])["out"])
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_onnx_bert_style_full_graph_compiles():
+    rng = np.random.RandomState(3)
+    model_bytes, params = _bert_style_fixture(rng)
+    ids = rng.randint(0, 100, (2, 8)).astype(np.int64)
+    sd = OnnxGraphMapper.import_model(model_bytes, outputs=["out"])
+    compiled = sd.compile({"ids": ids}, ["out"])
+    out = compiled(dict(sd._values), {"ids": ids})
+    np.testing.assert_allclose(
+        np.asarray(out["out"]), _torch_bert_style(params, ids),
+        rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# op-level coverage beyond the two golden models
+# ---------------------------------------------------------------------------
+
+def _run_single(op, inputs, outputs=("y",), input_arrays=None, opset=17, **attrs):
+    arrays = dict(input_arrays or {})
+    inits = [make_tensor(k, v) for k, v in arrays.items() if k not in ("x",)]
+    vis = [make_vi("x", arrays["x"].dtype, arrays["x"].shape)]
+    model = make_model([make_node(op, list(inputs), list(outputs), **attrs)],
+                       inputs=vis, outputs=[], initializers=inits, opset=opset)
+    sd = OnnxGraphMapper.import_model(model)
+    return {o: np.asarray(v) for o, v in
+            sd.output({"x": arrays["x"]}, list(outputs)).items()}
+
+
+def test_onnx_gemm_transB():
+    rng = np.random.RandomState(4)
+    x = rng.randn(3, 6).astype(np.float32)
+    w = rng.randn(4, 6).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    out = _run_single("Gemm", ["x", "w", "b"], input_arrays={"x": x, "w": w, "b": b},
+                      alpha=1.0, beta=1.0, transB=1)["y"]
+    np.testing.assert_allclose(out, x @ w.T + b, rtol=1e-5, atol=1e-5)
+
+
+def test_onnx_slice_and_reduce():
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 10, 6).astype(np.float32)
+    arrays = {"x": x, "starts": np.asarray([2], np.int64),
+              "ends": np.asarray([9], np.int64),
+              "axes": np.asarray([1], np.int64),
+              "steps": np.asarray([2], np.int64)}
+    model = make_model(
+        [make_node("Slice", ["x", "starts", "ends", "axes", "steps"], ["s"]),
+         make_node("ReduceMean", ["s"], ["y"], axes=[2], keepdims=0)],
+        inputs=[make_vi("x", np.float32, x.shape)], outputs=[],
+        initializers=[make_tensor(k, v) for k, v in arrays.items() if k != "x"])
+    sd = OnnxGraphMapper.import_model(model)
+    out = np.asarray(sd.output({"x": x}, ["y"])["y"])
+    np.testing.assert_allclose(out, x[:, 2:9:2].mean(axis=2), rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_grouped_conv():
+    rng = np.random.RandomState(6)
+    x = rng.randn(1, 4, 8, 8).astype(np.float32)
+    w = rng.randn(4, 2, 3, 3).astype(np.float32)  # groups=2
+    out = _run_single("Conv", ["x", "w"], input_arrays={"x": x, "w": w},
+                      kernel_shape=[3, 3], pads=[1, 1, 1, 1], group=2)["y"]
+    expected = F.conv2d(torch.from_numpy(x), torch.from_numpy(w),
+                        padding=1, groups=2).numpy()
+    np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_maxpool_explicit_pads():
+    """ResNet-stem pattern: MaxPool with pads=[1,1,1,1] (explicit, nonzero)."""
+    rng = np.random.RandomState(7)
+    x = rng.randn(1, 3, 9, 9).astype(np.float32)
+    out = _run_single("MaxPool", ["x"], input_arrays={"x": x},
+                      kernel_shape=[3, 3], strides=[2, 2], pads=[1, 1, 1, 1])["y"]
+    expected = F.max_pool2d(torch.from_numpy(x), 3, 2, padding=1).numpy()
+    np.testing.assert_allclose(out, expected, rtol=1e-6)
+
+
+@pytest.mark.parametrize("include_pad", [0, 1])
+def test_onnx_avgpool_explicit_pads(include_pad):
+    rng = np.random.RandomState(8)
+    x = rng.randn(1, 2, 8, 8).astype(np.float32)
+    out = _run_single("AveragePool", ["x"], input_arrays={"x": x},
+                      kernel_shape=[3, 3], strides=[2, 2], pads=[1, 1, 1, 1],
+                      count_include_pad=include_pad)["y"]
+    expected = F.avg_pool2d(torch.from_numpy(x), 3, 2, padding=1,
+                            count_include_pad=bool(include_pad)).numpy()
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_float_range():
+    model = make_model(
+        [make_node("Range", ["r_start", "r_limit", "r_delta"], ["y"])],
+        inputs=[make_vi("x", np.float32, (1,))], outputs=[],
+        initializers=[make_tensor("r_start", np.asarray(0.0, np.float32)),
+                      make_tensor("r_limit", np.asarray(1.0, np.float32)),
+                      make_tensor("r_delta", np.asarray(0.25, np.float32))])
+    sd = OnnxGraphMapper.import_model(model)
+    out = np.asarray(sd.output({"x": np.zeros(1, np.float32)}, ["y"])["y"])
+    np.testing.assert_allclose(out, np.arange(0.0, 1.0, 0.25, dtype=np.float32))
+
+
+def test_onnx_unknown_op_message():
+    model = make_model([make_node("TotallyMadeUpOp", ["x"], ["y"])],
+                       inputs=[make_vi("x", np.float32, (2,))], outputs=[],
+                       initializers=[])
+    with pytest.raises(NotImplementedError, match="TotallyMadeUpOp"):
+        OnnxGraphMapper.import_model(model)
