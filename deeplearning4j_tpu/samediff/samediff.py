@@ -259,14 +259,85 @@ class SameDiff:
             return x
         return self.constant(x)
 
+    # ops evaluated structurally by _eval_graph, not via the op registry
+    _STRUCTURAL_OPS = ("getitem", "while_loop", "cond")
+
     def _op(self, op: str, *inputs: SDVariable, name: Optional[str] = None, **attrs) -> Union[SDVariable, Tuple[SDVariable, ...]]:
-        if op != "getitem":
+        if op not in self._STRUCTURAL_OPS:
             get_sd_op(op)  # validate early
         node = self._new_node(name, "op", op=op, inputs=tuple(v.node.id for v in inputs),
                               attrs=attrs)
         # multi-output ops (split/unstack/svd/qr) produce view nodes lazily via
         # n_outputs attr when known
         return SDVariable(self, node)
+
+    # ------------------------------------------------------- control flow
+    def while_loop(self, loop_vars: Sequence[SDVariable], cond_fn, body_fn,
+                   name: Optional[str] = None) -> List[SDVariable]:
+        """Structured while loop (reference: SameDiff.whileLoop; SURVEY.md
+        §2.2 "THE thing XLA while replaces"): compiles to ONE
+        ``lax.while_loop`` HLO instead of the reference's
+        Switch/Merge/Enter/Exit interpreter frames.
+
+        ``cond_fn(sub_sd, *args) -> SDVariable`` builds the scalar-bool
+        predicate; ``body_fn(sub_sd, *args) -> sequence`` builds the next
+        carry (same arity/dtypes as ``loop_vars``). Both receive a fresh
+        sub-SameDiff whose placeholders ``arg0..argN`` are the loop carry.
+        Returns one SDVariable per loop var (the final carry).
+        """
+        n = len(loop_vars)
+        cond_sd, cond_outs = self._build_subgraph(cond_fn, n)
+        body_sd, body_outs = self._build_subgraph(body_fn, n)
+        if len(cond_outs) != 1:
+            raise ValueError("while_loop cond must produce exactly one value")
+        if len(body_outs) != n:
+            raise ValueError(
+                f"while_loop body must return {n} values (the carry), got {len(body_outs)}")
+        node_var = self._op(
+            "while_loop", *loop_vars, name=name,
+            cond_graph=cond_sd, cond_outputs=cond_outs,
+            body_graph=body_sd, body_outputs=body_outs, n_vars=n,
+        )
+        node_var.node.n_outputs = n
+        return [self._op("getitem", node_var, item=i) for i in range(n)]
+
+    whileLoop = while_loop
+
+    def ifCond(self, pred: SDVariable, operands: Sequence[SDVariable],
+               true_fn, false_fn, name: Optional[str] = None) -> List[SDVariable]:
+        """Structured conditional (reference: SameDiff.ifCond) compiling to
+        ``lax.cond``. ``true_fn/false_fn(sub_sd, *args) -> sequence`` must
+        return the same structure."""
+        n = len(operands)
+        t_sd, t_outs = self._build_subgraph(true_fn, n)
+        f_sd, f_outs = self._build_subgraph(false_fn, n)
+        if len(t_outs) != len(f_outs):
+            raise ValueError("ifCond branches must return the same arity")
+        node_var = self._op(
+            "cond", pred, *operands, name=name,
+            true_graph=t_sd, true_outputs=t_outs,
+            false_graph=f_sd, false_outputs=f_outs, n_vars=n,
+        )
+        node_var.node.n_outputs = len(t_outs)
+        return [self._op("getitem", node_var, item=i) for i in range(len(t_outs))]
+
+    if_cond = ifCond
+
+    @staticmethod
+    def _build_subgraph(fn, n_args: int):
+        sub = SameDiff()
+        args = [sub.placeholder(f"arg{i}") for i in range(n_args)]
+        outs = fn(sub, *args)
+        if isinstance(outs, SDVariable):
+            outs = [outs]
+        return sub, [o.name for o in outs]
+
+    def _subgraph_call(self, sub: "SameDiff", out_names: Sequence[str], args,
+                      rng, training: bool):
+        feeds = {f"arg{i}": v for i, v in enumerate(args)}
+        res = sub._eval_graph(feeds, dict(sub._values), list(out_names),
+                              rng=rng, training=training)
+        return [res[o] for o in out_names]
 
     # ------------------------------------------------------------ accessors
     def get_variable(self, name: str) -> SDVariable:
@@ -310,6 +381,10 @@ class SameDiff:
                 ins = [value_of(i) for i in node.inputs]
                 if node.op == "getitem":
                     out = ins[0][node.attrs["item"]]
+                elif node.op == "while_loop":
+                    out = self._eval_while(node, ins, rng, training)
+                elif node.op == "cond":
+                    out = self._eval_cond(node, ins, rng, training)
                 else:
                     fn = get_sd_op(node.op)
                     attrs = dict(node.attrs)
@@ -322,6 +397,52 @@ class SameDiff:
             return out
 
         return {t: value_of(self._names[t]) for t in targets}
+
+    def _eval_while(self, node: Node, ins, rng, training: bool):
+        """Compile a while_loop node to ``lax.while_loop``. The carry is the
+        loop-var tuple; dtypes/shapes must be loop-invariant (XLA's rule —
+        and the reason this beats an interpreter: one HLO While, resident on
+        device, no per-iteration host round-trips)."""
+        cond_sd, cond_outs = node.attrs["cond_graph"], node.attrs["cond_outputs"]
+        body_sd, body_outs = node.attrs["body_graph"], node.attrs["body_outputs"]
+
+        def cond(carry):
+            res = self._subgraph_call(cond_sd, cond_outs, carry, rng, training)
+            return jnp.reshape(jnp.asarray(res[0], jnp.bool_), ())
+
+        def body(carry):
+            res = self._subgraph_call(body_sd, body_outs, carry, rng, training)
+            # lax requires carry-structure (incl. dtype) invariance
+            return tuple(
+                jnp.asarray(r, jnp.asarray(c).dtype) for r, c in zip(res, carry))
+
+        init = tuple(jnp.asarray(v) for v in ins)
+        return jax.lax.while_loop(cond, body, init)
+
+    def _eval_cond(self, node: Node, ins, rng, training: bool):
+        """Compile a cond node to ``lax.cond`` (both branches traced, one
+        executed — XLA's conditional HLO)."""
+        t_sd, t_outs = node.attrs["true_graph"], node.attrs["true_outputs"]
+        f_sd, f_outs = node.attrs["false_graph"], node.attrs["false_outputs"]
+        pred, operands = ins[0], tuple(jnp.asarray(v) for v in ins[1:])
+
+        def true_fn(args):
+            return tuple(self._subgraph_call(t_sd, t_outs, args, rng, training))
+
+        def false_fn(args):
+            res = tuple(self._subgraph_call(f_sd, f_outs, args, rng, training))
+            # unify branch output dtypes (lax.cond requires identical pytrees)
+            return res
+
+        t_shapes = jax.eval_shape(true_fn, operands)
+        f_fn = false_fn
+
+        def false_cast(args):
+            return tuple(
+                jnp.asarray(r, s.dtype) for r, s in zip(f_fn(args), t_shapes))
+
+        p = jnp.reshape(jnp.asarray(pred, jnp.bool_), ())
+        return jax.lax.cond(p, true_fn, false_cast, operands)
 
     def output(self, feeds: Dict[str, Any], outputs: Sequence[str],
                training: bool = False) -> Dict[str, np.ndarray]:
@@ -429,6 +550,8 @@ def _jsonable_attrs(attrs: Dict[str, Any]) -> Dict[str, Any]:
             out[k] = {"@slice": [v.start, v.stop, v.step]}
         elif isinstance(v, tuple):
             out[k] = {"@tuple": list(v)}
+        elif isinstance(v, SameDiff):  # control-flow subgraph
+            out[k] = {"@subgraph": _sd_to_dict(v)}
         else:
             out[k] = v
     return out
@@ -443,6 +566,49 @@ def _restore_attrs(attrs: Dict[str, Any]) -> Dict[str, Any]:
             out[k] = slice(*v["@slice"])
         elif isinstance(v, dict) and "@tuple" in v:
             out[k] = tuple(v["@tuple"])
+        elif isinstance(v, dict) and "@subgraph" in v:
+            out[k] = _sd_from_dict(v["@subgraph"])
         else:
             out[k] = v
     return out
+
+
+def _sd_to_dict(sd: SameDiff) -> Dict[str, Any]:
+    """Inline-JSON form of a (sub)graph, values included — used for
+    control-flow subgraphs stored in node attrs."""
+    return {
+        "nodes": [
+            {
+                "id": n.id, "name": n.name, "kind": n.kind, "op": n.op,
+                "inputs": list(n.inputs), "attrs": _jsonable_attrs(n.attrs),
+                "shape": n.shape, "dtype": n.dtype,
+            }
+            for n in sd._nodes.values()
+        ],
+        "loss": sd._loss_name,
+        "values": {
+            str(nid): {"data": np.asarray(v).tolist(), "dtype": str(np.asarray(v).dtype)}
+            for nid, v in sd._values.items()
+        },
+    }
+
+
+def _sd_from_dict(d: Dict[str, Any]) -> SameDiff:
+    sd = SameDiff()
+    for nd in d["nodes"]:
+        node = Node(
+            id=nd["id"], name=nd["name"], kind=nd["kind"], op=nd.get("op"),
+            inputs=tuple(nd.get("inputs", ())),
+            attrs=_restore_attrs(nd.get("attrs", {})),
+            shape=None if nd.get("shape") is None else tuple(nd["shape"]),
+            dtype=nd.get("dtype"),
+        )
+        sd._nodes[node.id] = node
+        sd._names[node.name] = node.id
+        sd._next_id = max(sd._next_id, node.id + 1)
+    sd._values = {
+        int(k): jnp.asarray(np.array(v["data"], dtype=v["dtype"]))
+        for k, v in d.get("values", {}).items()
+    }
+    sd._loss_name = d.get("loss")
+    return sd

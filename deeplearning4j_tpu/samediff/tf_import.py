@@ -12,9 +12,11 @@ Design notes (TPU-first):
   indices) as tensor inputs; XLA wants static shapes. Const-backed operands
   are folded into op attrs at import time; truly dynamic shape operands are
   rejected with a clear error instead of tracing data-dependent shapes.
-* Control flow (while/cond) maps to lax primitives at the SameDiff level —
-  out of scope for the frozen-BERT closure, which is control-flow-free after
-  freezing.
+* Control flow: functional While/StatelessWhile/If/StatelessIf map to the
+  SameDiff structured while_loop/cond nodes (one lax.while_loop / lax.cond
+  HLO each); legacy V1 Switch/Merge/Enter/Exit/NextIteration/LoopCond
+  frames are rewritten to functional While first, and frameless V1
+  Switch/Merge conditionals become where-selects (tf_control_flow.py).
 
 The mapping registry is ``TF_OP_RULES``: tf_op_name -> rule(ctx) returning
 (sd_op_name, input_ids, attrs) or a direct SDVariable.
@@ -34,6 +36,36 @@ def _tf():
     import tensorflow as tf
 
     return tf
+
+
+def _iterative_topo(names, deps, cycle_msg: str):
+    """Dependency-first ordering via an explicit stack (graphs can be
+    thousands of nodes deep — Python recursion would overflow).
+    ``deps`` maps name -> prerequisite names; unknown names are ignored."""
+    order: List[str] = []
+    state: Dict[str, int] = {}  # 1 = on stack, 2 = emitted
+    for root in names:
+        if state.get(root) == 2:
+            continue
+        stack = [(root, False)]
+        while stack:
+            name, expanded = stack.pop()
+            if state.get(name) == 2:
+                continue
+            if expanded:
+                state[name] = 2
+                order.append(name)
+                continue
+            if state.get(name) == 1:
+                raise ValueError(cycle_msg.format(name))
+            state[name] = 1
+            stack.append((name, True))
+            for dep in deps.get(name, ()):
+                if state.get(dep) != 2 and dep in deps:
+                    if state.get(dep) == 1:
+                        raise ValueError(cycle_msg.format(dep))
+                    stack.append((dep, False))
+    return order
 
 
 @dataclasses.dataclass
@@ -447,6 +479,9 @@ class TFGraphMapper:
         self.const_values: Dict[str, np.ndarray] = {}
         self._produced: Dict[str, SDVariable] = {}
         self._multi_outputs: Dict[str, Dict[int, SDVariable]] = {}
+        self.graph_def = None  # set in run(); function library lookups
+        self._gd_by_name: Dict[str, Any] = {}
+        self._branch_of: Dict[str, SDVariable] = {}  # Switch name -> pred
 
     # ---- public entry points ----------------------------------------------
     @staticmethod
@@ -466,15 +501,40 @@ class TFGraphMapper:
 
         from tensorflow.python.framework import tensor_util
 
+        from .tf_control_flow import has_v1_control_flow, rewrite_v1_loops
+
+        if has_v1_control_flow(gd):
+            # V1 while frames -> functional StatelessWhile; frameless
+            # Switch/Merge (v1 cond) survive and hit their own rules
+            gd = rewrite_v1_loops(gd)
+        self.graph_def = gd
+        self._gd_by_name = {n.name: n for n in gd.node}
+
         needed = None
         if outputs:
             needed = self._dependency_closure(gd, outputs)
 
-        for node in gd.node:
+        for node in self._topo_order(gd):
             if needed is not None and node.name not in needed:
                 continue
             self._import_node(node, tensor_util)
         return self.sd
+
+    @staticmethod
+    def _topo_order(gd):
+        """Dependency-ordered nodes. GraphDef carries no ordering guarantee
+        (V1 cond graphs interleave Switch after its consumers); cycles are
+        impossible here because V1 while frames were rewritten to functional
+        While before this runs."""
+        by_name = {n.name: n for n in gd.node}
+        deps = {
+            n.name: [i.lstrip("^").split(":")[0] for i in n.input]
+            for n in gd.node
+        }
+        order = _iterative_topo(
+            [n.name for n in gd.node], deps,
+            cycle_msg="GraphDef cycle at {!r} (unrewritten V1 loop?)")
+        return [by_name[name] for name in order if name in by_name]
 
     # ---- internals --------------------------------------------------------
     @staticmethod
@@ -553,3 +613,37 @@ class TFGraphMapper:
                        importer=self)
         result = rule(ctx)
         self._produced[name] = result
+
+    def trace_branch(self, ref: str):
+        """Walk the GraphDef backwards from ``ref`` to the nearest Switch;
+        returns (pred_var, side) where side is True for the :1 output, or
+        None if no Switch feeds this ref. Used by the frameless V1
+        Switch/Merge conditional rules (tf_control_flow.py)."""
+        stack = [self._canon(ref)]
+        seen = set()
+        while stack:
+            r = stack.pop()
+            base, _, idx = r.partition(":")
+            if base in seen:
+                continue
+            seen.add(base)
+            node = self._gd_by_name.get(base)
+            if node is None:
+                continue
+            if node.op in ("Switch", "RefSwitch"):
+                pred = self._branch_of.get(base)
+                if pred is not None:
+                    return pred, idx == "1"
+                continue
+            stack.extend(self._canon(i) for i in node.input
+                         if not i.startswith("^"))
+        return None
+
+
+from .tf_control_flow import (  # noqa: E402 — rules need TFGraphMapper defined
+    register_functional_rules,
+    register_v1_cond_rules,
+)
+
+register_functional_rules(tf_rule, TF_OP_RULES)
+register_v1_cond_rules(tf_rule, TF_OP_RULES)
