@@ -81,22 +81,28 @@ def _map_activation(name: Optional[str]) -> Activation:
 
 
 def _collect_weights(group) -> Dict[str, np.ndarray]:
-    """Leaf datasets under a layer's weight group, keyed by basename with
-    any Keras-2 ':0' suffix stripped."""
+    """Leaf datasets under a layer's weight group, keyed by FULL path with
+    any Keras-2 ':0' suffix stripped (wrappers like Bidirectional hold
+    same-named leaves for each direction — basenames alone collide)."""
     import h5py
 
     out: Dict[str, np.ndarray] = {}
 
-    def walk(g):
+    def walk(g, prefix: str):
         for k in g:
             item = g[k]
+            key = f"{prefix}/{k}" if prefix else k
             if isinstance(item, h5py.Dataset):
-                out[k.split(":")[0]] = np.asarray(item)
+                out[key.split(":")[0]] = np.asarray(item)
             else:
-                walk(item)
+                walk(item, key)
 
-    walk(group)
+    walk(group, "")
     return out
+
+
+def _by_basename(weights: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    return {k.rsplit("/", 1)[-1]: v for k, v in weights.items()}
 
 
 def _lstm_reorder(arr: np.ndarray, units: int) -> np.ndarray:
@@ -181,7 +187,7 @@ class _SequentialImporter:
     # --- per-class handlers -------------------------------------------
 
     def _weights(self, conf) -> Dict[str, np.ndarray]:
-        return self.weights_by_layer.get(conf["name"], {})
+        return _by_basename(self.weights_by_layer.get(conf["name"], {}))
 
     def _import_Dense(self, conf):
         s = self.shape
@@ -262,6 +268,22 @@ class _SequentialImporter:
                                      pooling_type=PoolingType.MAX))
         s.kind, s.n = "ff", s.c
 
+    def _import_GlobalAveragePooling1D(self, conf):
+        s = self.shape
+        if s.kind != "rnn":
+            raise KerasImportError("GlobalAveragePooling1D needs sequence input")
+        self._add(GlobalPoolingLayer(name=conf["name"],
+                                     pooling_type=PoolingType.AVG))
+        s.kind, s.n = "ff", s.f
+
+    def _import_GlobalMaxPooling1D(self, conf):
+        s = self.shape
+        if s.kind != "rnn":
+            raise KerasImportError("GlobalMaxPooling1D needs sequence input")
+        self._add(GlobalPoolingLayer(name=conf["name"],
+                                     pooling_type=PoolingType.MAX))
+        s.kind, s.n = "ff", s.f
+
     def _import_Flatten(self, conf):
         s = self.shape
         if s.kind == "conv":
@@ -330,6 +352,89 @@ class _SequentialImporter:
                 "epsilon", 1e-3)), decay=float(conf.get("momentum", 0.99))),
             params, state)
 
+    def _import_SeparableConv2D(self, conf):
+        s = self.shape
+        if s.kind != "conv":
+            raise KerasImportError("SeparableConv2D on non-convolutional input")
+        if conf.get("data_format") not in (None, "channels_last"):
+            raise KerasImportError("only channels_last Keras models supported")
+        from ..nn.layers import SeparableConvolution2DLayer
+
+        mode = _pad_mode(conf.get("padding", "valid"))
+        kh, kw = conf["kernel_size"]
+        sh, sw = conf.get("strides", (1, 1))
+        dm = int(conf.get("depth_multiplier", 1))
+        w = self._weights(conf)
+        params = {
+            # keras depthwise [kh, kw, in, mult] == our W layout directly
+            "W": w["depthwise_kernel"],
+            # keras pointwise [1, 1, in*mult, out] -> our [out, in*mult, 1, 1]
+            "pW": w["pointwise_kernel"].transpose(3, 2, 0, 1),
+        }
+        if conf.get("use_bias", True):
+            params["b"] = w["bias"]
+        self._add(SeparableConvolution2DLayer(
+            name=conf["name"], n_in=int(s.c), n_out=int(conf["filters"]),
+            depth_multiplier=dm, kernel_size=(kh, kw), stride=(sh, sw),
+            convolution_mode=mode,
+            activation=_map_activation(conf.get("activation")),
+            has_bias=conf.get("use_bias", True)), params)
+        s.h = _conv_out(s.h, kh, sh, mode)
+        s.w = _conv_out(s.w, kw, sw, mode)
+        s.c = conf["filters"]
+
+    def _import_Bidirectional(self, conf):
+        s = self.shape
+        if s.kind != "rnn":
+            raise KerasImportError("Bidirectional needs sequence input")
+        inner = conf["layer"]
+        if inner["class_name"] != "LSTM":
+            raise KerasImportError(
+                f"Bidirectional({inner['class_name']}) unsupported (LSTM only)")
+        icfg = inner["config"]
+        if not icfg.get("return_sequences", False):
+            # keras's backward half would return its LAST state (original
+            # position 0); our LastTimeStep extraction reads position T-1 —
+            # semantically different, so reject rather than silently differ
+            raise KerasImportError(
+                "Bidirectional with return_sequences=False unsupported "
+                "(re-export with return_sequences=True + pooling)")
+        from ..nn.layers import BidirectionalLayer, BidirectionalMode, LSTMLayer
+
+        mode = {
+            "concat": BidirectionalMode.CONCAT, "sum": BidirectionalMode.ADD,
+            "mul": BidirectionalMode.MUL, "ave": BidirectionalMode.AVERAGE,
+        }.get(conf.get("merge_mode", "concat"))
+        if mode is None:
+            raise KerasImportError(
+                f"Bidirectional merge_mode {conf.get('merge_mode')!r} unsupported")
+        units = int(icfg["units"])
+        full = self.weights_by_layer.get(conf["name"], {})
+
+        def side(tag: str) -> Dict[str, np.ndarray]:
+            got = {}
+            for path, arr in full.items():
+                if f"{tag}_" not in path and not path.startswith(tag):
+                    continue
+                base = path.rsplit("/", 1)[-1].split(":")[0]
+                got[base] = arr
+            if "kernel" not in got:
+                raise KerasImportError(
+                    f"Bidirectional {conf['name']}: no {tag} weights found")
+            return got
+
+        params = {}
+        for prefix, tag in (("f", "forward"), ("b", "backward")):
+            w = side(tag)
+            params[f"{prefix}_W"] = _lstm_reorder(w["kernel"], units)
+            params[f"{prefix}_RW"] = _lstm_reorder(w["recurrent_kernel"], units)
+            if icfg.get("use_bias", True):
+                params[f"{prefix}_b"] = _lstm_reorder(w["bias"], units)
+        self._add(BidirectionalLayer(
+            name=conf["name"], mode=mode,
+            fwd=LSTMLayer(n_in=int(s.f), n_out=units)), params)
+        s.f = units * 2 if mode is BidirectionalMode.CONCAT else units
+
     def _import_LSTM(self, conf):
         s = self.shape
         if s.kind != "rnn":
@@ -353,6 +458,168 @@ class _SequentialImporter:
         if not conf.get("return_sequences", False):
             self._add(LastTimeStepLayer(name=conf["name"] + "_last"))
             s.kind, s.n = "ff", units
+
+
+def _inbound_names(layer_cfg: dict) -> List[str]:
+    """Producer layer names feeding this functional-API layer — handles the
+    Keras 2 nested-list node format and the Keras 3 keras_history format."""
+    nodes = layer_cfg.get("inbound_nodes") or []
+    if not nodes:
+        return []
+    node = nodes[0]
+    names: List[str] = []
+    if isinstance(node, dict):  # keras 3
+        def walk(o):
+            if isinstance(o, dict):
+                if o.get("class_name") == "__keras_tensor__":
+                    names.append(o["config"]["keras_history"][0])
+                else:
+                    for v in o.values():
+                        walk(v)
+            elif isinstance(o, (list, tuple)):
+                for v in o:
+                    walk(v)
+
+        walk(node.get("args", []))
+        walk(node.get("kwargs", {}))
+    else:  # keras 2: [["name", node_idx, tensor_idx, kwargs], ...]
+        for entry in node:
+            names.append(entry[0])
+    return names
+
+
+_MERGE_CLASSES = ("Add", "Subtract", "Multiply", "Average", "Maximum",
+                  "Concatenate")
+
+
+class _FunctionalImporter(_SequentialImporter):
+    """Functional Keras model -> ComputationGraph specs. Reuses every
+    per-class handler from the Sequential importer: before each node the
+    current tensor shape is staged into ``self.shape``, and ``_add`` is
+    redirected to record graph vertices with explicit inbound edges
+    (reference: KerasModel.getComputationGraphConfiguration)."""
+
+    def __init__(self, layer_configs, weights_by_layer) -> None:
+        super().__init__(layer_configs, weights_by_layer)
+        import copy as _copy
+
+        self._copy = _copy
+        self.specs: List[Tuple[str, str, Any, List[str]]] = []
+        self.shapes: Dict[str, _Shape] = {}
+        self.perms: Dict[str, Optional[np.ndarray]] = {}
+        self.graph_inputs: List[str] = []
+        self.alias: Dict[str, str] = {}  # keras layer name -> final vertex
+        self._current_inputs: List[str] = []
+        self._last_added: Optional[str] = None
+
+    def _add(self, layer, params=None, state=None):
+        name = layer.name or f"vertex_{len(self.specs)}"
+        self.specs.append(("layer", name, layer, list(self._current_inputs)))
+        if params:
+            self.params[name] = params
+        if state:
+            self.state[name] = state
+        self._current_inputs = [name]  # chained _adds stack onto this node
+        self._last_added = name
+
+    def run_graph(self):
+        from ..nn.vertices import ElementWiseOp, ElementWiseVertex, MergeVertex
+
+        for cfg in self.configs:
+            cls = cfg["class_name"]
+            conf = cfg["config"]
+            name = conf["name"]
+            inbound = [self.alias.get(n, n) for n in _inbound_names(cfg)]
+            if cls == "InputLayer":
+                shape = conf.get("batch_shape") or conf.get("batch_input_shape")
+                self.shapes[name] = _Shape(tuple(shape[1:]))
+                self.perms[name] = None
+                self.graph_inputs.append(name)
+                continue
+            if cls in _MERGE_CLASSES:
+                if cls == "Concatenate":
+                    axis = conf.get("axis", -1)
+                    # MergeVertex concatenates the feature/channel axis only;
+                    # keras spells that axis differently per input rank
+                    kind = self.shapes[inbound[0]].kind
+                    chan_axes = {"conv": (-1, 3), "rnn": (-1, 2),
+                                 "ff": (-1, 1)}[kind]
+                    if axis not in chan_axes:
+                        raise KerasImportError(
+                            f"Concatenate axis {axis} on {kind} input "
+                            f"unsupported (channel/feature axis only: "
+                            f"{chan_axes})")
+                    vertex = MergeVertex()
+                    out_shape = self._copy.copy(self.shapes[inbound[0]])
+                    sizes = [self._feat(self.shapes[i]) for i in inbound]
+                    self._set_feat(out_shape, sum(sizes))
+                else:
+                    op = {"Add": ElementWiseOp.ADD,
+                          "Subtract": ElementWiseOp.SUBTRACT,
+                          "Multiply": ElementWiseOp.PRODUCT,
+                          "Average": ElementWiseOp.AVERAGE,
+                          "Maximum": ElementWiseOp.MAX}[cls]
+                    vertex = ElementWiseVertex(op=op)
+                    out_shape = self._copy.copy(self.shapes[inbound[0]])
+                self.specs.append(("vertex", name, vertex, inbound))
+                self.shapes[name] = out_shape
+                self.perms[name] = None
+                continue
+            handler = getattr(self, f"_import_{cls}", None)
+            if handler is None:
+                raise KerasImportError(
+                    f"unsupported Keras layer {cls!r} ({name})")
+            if len(inbound) != 1:
+                raise KerasImportError(
+                    f"{cls} ({name}): expected exactly one inbound tensor")
+            self.shape = self._copy.copy(self.shapes[inbound[0]])
+            self.dense_perm = self.perms.get(inbound[0])
+            self._current_inputs = [inbound[0]]
+            self._last_added = None
+            handler(conf)
+            if self._last_added is None:
+                # no-op handlers (Flatten on already-flat input): the keras
+                # tensor aliases straight to its producer
+                self.alias[name] = inbound[0]
+                self.shapes[name] = self.shape
+                self.perms[name] = self.dense_perm
+                continue
+            self.alias[name] = self._last_added
+            self.shapes[name] = self.shape
+            self.shapes[self._last_added] = self.shape
+            self.perms[name] = self.perms[self._last_added] = self.dense_perm
+        return self
+
+    @staticmethod
+    def _feat(s: _Shape) -> int:
+        return s.c if s.kind == "conv" else (s.f if s.kind == "rnn" else s.n)
+
+    @staticmethod
+    def _set_feat(s: _Shape, v: int) -> None:
+        if s.kind == "conv":
+            s.c = v
+        elif s.kind == "rnn":
+            s.f = v
+        else:
+            s.n = v
+
+
+def _load_params(model, params, state) -> None:
+    """Copy imported arrays into an initialized model, shape-checked."""
+    dtype = model.dtype
+    for lname, lparams in params.items():
+        if lname not in model.params:
+            raise KerasImportError(f"internal: no params slot {lname}")
+        for pname, arr in lparams.items():
+            have = model.params[lname][pname]
+            if tuple(have.shape) != tuple(arr.shape):
+                raise KerasImportError(
+                    f"shape mismatch for {lname}/{pname}: "
+                    f"{arr.shape} vs {have.shape}")
+            model.params[lname][pname] = np.asarray(arr, dtype)
+    for lname, lstate in state.items():
+        for sname, arr in lstate.items():
+            model.state[lname][sname] = np.asarray(arr, dtype)
 
 
 class KerasModelImport:
@@ -383,10 +650,11 @@ class KerasModelImport:
             for lname in wg:
                 weights_by_layer[lname] = _collect_weights(wg[lname])
 
+        if cfg["class_name"] in ("Functional", "Model"):
+            return KerasModelImport._import_functional(cfg, weights_by_layer)
         if cfg["class_name"] != "Sequential":
             raise KerasImportError(
-                f"unsupported model class {cfg['class_name']!r} (functional "
-                "import: use the TF GraphDef path, samediff/tf_import.py)")
+                f"unsupported model class {cfg['class_name']!r}")
         layer_cfgs = cfg["config"]["layers"]
         importer = _SequentialImporter(layer_cfgs, weights_by_layer)
         layers, params, state = importer.run()
@@ -411,18 +679,52 @@ class KerasModelImport:
         for layer in layers:
             lb.layer(layer)
         model = MultiLayerNetwork(lb.build()).init()
-        dtype = model.dtype
-        for lname, lparams in params.items():
-            if lname not in model.params:
-                raise KerasImportError(f"internal: no params slot {lname}")
-            for pname, arr in lparams.items():
-                have = model.params[lname][pname]
-                if tuple(have.shape) != tuple(arr.shape):
-                    raise KerasImportError(
-                        f"shape mismatch for {lname}/{pname}: "
-                        f"{arr.shape} vs {have.shape}")
-                model.params[lname][pname] = np.asarray(arr, dtype)
-        for lname, lstate in state.items():
-            for sname, arr in lstate.items():
-                model.state[lname][sname] = np.asarray(arr, dtype)
+        _load_params(model, params, state)
+        return model
+
+    @staticmethod
+    def _import_functional(cfg: dict, weights_by_layer):
+        """Functional model -> ComputationGraph (reference: KerasModel ->
+        ComputationGraphConfiguration for non-Sequential models)."""
+        from ..nn.graph import ComputationGraph
+        from ..nn.layers import OutputLayer
+        from ..nn.losses import LossFunction
+
+        imp = _FunctionalImporter(cfg["config"]["layers"], weights_by_layer)
+        imp.run_graph()
+
+        out_refs = cfg["config"]["output_layers"]
+        # single-output models serialize as a flat ["name", 0, 0]
+        if out_refs and isinstance(out_refs[0], str):
+            out_refs = [out_refs]
+        raw_names = [
+            r["config"]["keras_history"][0] if isinstance(r, dict) else r[0]
+            for r in out_refs
+        ]
+        out_names = [imp.alias.get(n, n) for n in raw_names]
+
+        # trailing Dense outputs become OutputLayers (directly trainable),
+        # exactly as the Sequential path does
+        specs = []
+        for kind, name, obj, inputs in imp.specs:
+            if kind == "layer" and name in out_names and isinstance(obj, DenseLayer):
+                act = obj.activation or Activation.IDENTITY
+                loss = {Activation.SOFTMAX: LossFunction.MCXENT,
+                        Activation.SIGMOID: LossFunction.XENT}.get(
+                            act, LossFunction.MSE)
+                obj = OutputLayer(name=obj.name, n_in=obj.n_in, n_out=obj.n_out,
+                                  activation=act, has_bias=obj.has_bias,
+                                  loss=loss)
+            specs.append((kind, name, obj, inputs))
+
+        g = NeuralNetConfiguration.builder().graph_builder()
+        g.add_inputs(*imp.graph_inputs)
+        for kind, name, obj, inputs in specs:
+            if kind == "layer":
+                g.add_layer(name, obj, *inputs)
+            else:
+                g.add_vertex(name, obj, *inputs)
+        g.set_outputs(*out_names)
+        model = ComputationGraph(g.build()).init()
+        _load_params(model, imp.params, imp.state)
         return model

@@ -99,13 +99,13 @@ def test_lstm_return_sequences(tmp_path):
 def test_unsupported_layer_raises(tmp_path):
     m = keras.Sequential([
         keras.layers.Input((4, 4, 1)),
-        keras.layers.SeparableConv2D(2, 3),
+        keras.layers.Conv2DTranspose(2, 3),
         keras.layers.Flatten(),
         keras.layers.Dense(2),
     ])
     path = str(tmp_path / "model.h5")
     m.save(path)
-    with pytest.raises(KerasImportError, match="SeparableConv2D"):
+    with pytest.raises(KerasImportError, match="Conv2DTranspose"):
         KerasModelImport.import_keras_model_and_weights(path)
 
 
@@ -154,4 +154,109 @@ def test_go_backwards_lstm_rejected(tmp_path):
     path = str(tmp_path / "model.h5")
     m.save(path)
     with pytest.raises(KerasImportError, match="go_backwards"):
+        KerasModelImport.import_keras_model_and_weights(path)
+
+
+# ---------------------------------------------------------------------------
+# functional API -> ComputationGraph (VERDICT.md round 3 ask 6)
+# ---------------------------------------------------------------------------
+
+def _import_graph_and_compare(tmp_path, kmodel, x_keras, to_ours, atol=1e-4):
+    path = str(tmp_path / "model.h5")
+    kmodel.save(path)
+    expected = np.asarray(kmodel(x_keras))
+    ours = KerasModelImport.import_keras_model_and_weights(path)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    assert isinstance(ours, ComputationGraph)
+    got = np.asarray(ours.output(to_ours(x_keras)))
+    np.testing.assert_allclose(got, expected, atol=atol, rtol=1e-3)
+    return ours
+
+
+def test_functional_resnet_style_import(tmp_path):
+    """Residual Add + Concatenate branch + SeparableConv2D — the functional
+    vertex set the reference maps onto ComputationGraph."""
+    inp = keras.layers.Input((12, 12, 3))
+    stem = keras.layers.Conv2D(8, 3, padding="same", use_bias=False)(inp)
+    stem = keras.layers.BatchNormalization()(stem)
+    stem = keras.layers.Activation("relu")(stem)
+    # residual block
+    r = keras.layers.Conv2D(8, 3, padding="same", activation="relu")(stem)
+    r = keras.layers.Conv2D(8, 3, padding="same")(r)
+    res = keras.layers.Add()([stem, r])
+    res = keras.layers.Activation("relu")(res)
+    # parallel branch + concat
+    b1 = keras.layers.Conv2D(4, 1, padding="same", activation="relu")(res)
+    b2 = keras.layers.SeparableConv2D(6, 3, padding="same",
+                                      activation="relu")(res)
+    merged = keras.layers.Concatenate()([b1, b2])
+    pooled = keras.layers.GlobalAveragePooling2D()(merged)
+    out = keras.layers.Dense(5, activation="softmax")(pooled)
+    m = keras.Model(inp, out)
+
+    x = np.random.RandomState(3).rand(2, 12, 12, 3).astype(np.float32)
+    _import_graph_and_compare(tmp_path, m, x, lambda a: a.transpose(0, 3, 1, 2))
+
+
+def test_functional_bidirectional_lstm_import(tmp_path):
+    inp = keras.layers.Input((7, 5))  # [t, features]
+    h = keras.layers.Bidirectional(
+        keras.layers.LSTM(6, return_sequences=True), merge_mode="concat")(inp)
+    h = keras.layers.GlobalAveragePooling1D()(h)
+    out = keras.layers.Dense(3, activation="softmax")(h)
+    m = keras.Model(inp, out)
+    x = np.random.RandomState(4).rand(2, 7, 5).astype(np.float32)
+    # ours takes [batch, features, time]
+    _import_graph_and_compare(tmp_path, m, x, lambda a: a.transpose(0, 2, 1),
+                              atol=1e-3)
+
+
+def test_functional_multi_branch_elementwise(tmp_path):
+    inp = keras.layers.Input((10,))
+    a = keras.layers.Dense(8, activation="tanh")(inp)
+    b = keras.layers.Dense(8, activation="relu")(inp)
+    avg = keras.layers.Average()([a, b])
+    mx = keras.layers.Maximum()([a, b])
+    cat = keras.layers.Concatenate()([avg, mx])
+    out = keras.layers.Dense(4, activation="softmax")(cat)
+    m = keras.Model(inp, out)
+    x = np.random.RandomState(5).randn(3, 10).astype(np.float32)
+    _import_graph_and_compare(tmp_path, m, x, lambda a: a)
+
+
+def test_functional_bidirectional_no_return_sequences_rejected(tmp_path):
+    inp = keras.layers.Input((7, 5))
+    h = keras.layers.Bidirectional(keras.layers.LSTM(6))(inp)
+    out = keras.layers.Dense(3)(h)
+    m = keras.Model(inp, out)
+    path = str(tmp_path / "model.h5")
+    m.save(path)
+    with pytest.raises(KerasImportError, match="return_sequences"):
+        KerasModelImport.import_keras_model_and_weights(path)
+
+
+def test_functional_noop_flatten_aliases_producer(tmp_path):
+    """Regression: a handler that adds no layer (Flatten on flat input)
+    must alias the keras tensor to its producer, not to a stale vertex."""
+    inp = keras.layers.Input((10,))
+    flat = keras.layers.Flatten()(inp)
+    out = keras.layers.Dense(4, activation="softmax")(flat)
+    m = keras.Model(inp, out)
+    x = np.random.RandomState(6).randn(3, 10).astype(np.float32)
+    _import_graph_and_compare(tmp_path, m, x, lambda a: a)
+
+
+def test_functional_concatenate_height_axis_rejected(tmp_path):
+    """Concatenate over a spatial axis has no MergeVertex equivalent and
+    must fail loudly instead of silently concatenating channels."""
+    inp = keras.layers.Input((8, 8, 3))
+    a = keras.layers.Conv2D(4, 1)(inp)
+    b = keras.layers.Conv2D(4, 1)(inp)
+    cat = keras.layers.Concatenate(axis=1)([a, b])  # height concat
+    out = keras.layers.Dense(2)(keras.layers.GlobalAveragePooling2D()(cat))
+    m = keras.Model(inp, out)
+    path = str(tmp_path / "model.h5")
+    m.save(path)
+    with pytest.raises(KerasImportError, match="Concatenate axis 1"):
         KerasModelImport.import_keras_model_and_weights(path)
