@@ -1,4 +1,6 @@
-from .evaluation import ROC, Evaluation, EvaluationBinary, RegressionEvaluation
+from .evaluation import (ROC, Evaluation, EvaluationBinary,
+                         EvaluationCalibration, ROCBinary, ROCMultiClass,
+                         RegressionEvaluation)
 from .schedules import (
     CycleSchedule,
     ExponentialSchedule,

@@ -36,10 +36,14 @@ def _flatten_time(labels: np.ndarray, preds: np.ndarray, mask: Optional[np.ndarr
 class Evaluation:
     """Multiclass classification metrics (reference: Evaluation)."""
 
-    def __init__(self, num_classes: Optional[int] = None, labels_names: Optional[List[str]] = None) -> None:
+    def __init__(self, num_classes: Optional[int] = None, labels_names: Optional[List[str]] = None,
+                 top_n: int = 1) -> None:
         self.num_classes = num_classes
         self.labels_names = labels_names
         self.confusion: Optional[np.ndarray] = None
+        self.top_n = int(top_n)  # reference: Evaluation(int topN)
+        self._topn_correct = 0
+        self._topn_total = 0
 
     def eval(self, labels, predictions, mask=None) -> None:
         labels = np.asarray(labels)
@@ -47,6 +51,10 @@ class Evaluation:
         labels, predictions = _flatten_time(labels, predictions, mask)
         truth = _to_class_indices(labels)
         guess = _to_class_indices(predictions)
+        if self.top_n > 1 and predictions.ndim >= 2 and predictions.shape[-1] > 1:
+            topk = np.argsort(-predictions, axis=-1)[:, : self.top_n]
+            self._topn_correct += int((topk == truth[:, None]).any(axis=1).sum())
+            self._topn_total += len(truth)
         n = self.num_classes
         if n is None:
             n = int(max(truth.max(initial=0), guess.max(initial=0))) + 1
@@ -97,6 +105,15 @@ class Evaluation:
         p = self.precision(cls)
         r = self.recall(cls)
         return 2 * p * r / (p + r) if (p + r) > 0 else 0.0
+
+    def top_n_accuracy(self) -> float:
+        """Top-N accuracy (reference: Evaluation(topN).topNAccuracy()) —
+        only populated when probability outputs were evaluated."""
+        if self.top_n <= 1:
+            return self.accuracy()
+        if self._topn_total == 0:
+            raise ValueError("No probability predictions evaluated for top-N")
+        return self._topn_correct / self._topn_total
 
     def false_positive_rate(self, cls: int) -> float:
         c = self._check()
@@ -286,4 +303,158 @@ class RegressionEvaluation:
                 f"{c:<9} {self.mean_squared_error(c):<14.6f} {self.mean_absolute_error(c):<14.6f} "
                 f"{self.root_mean_squared_error(c):<14.6f} {self.r_squared(c):<14.6f}"
             )
+        return "\n".join(lines)
+
+
+class ROCBinary:
+    """Per-output binary ROC for multi-label sigmoid outputs (reference:
+    ROCBinary): one exact-AUC ROC per output column."""
+
+    def __init__(self) -> None:
+        self._rocs: List[ROC] = []
+
+    def eval(self, labels, predictions, mask=None) -> None:
+        labels = np.asarray(labels)
+        preds = np.asarray(predictions)
+        if labels.ndim == 1:
+            labels = labels[:, None]
+            preds = preds[:, None]
+        if mask is not None:
+            mask = np.asarray(mask)
+            if mask.ndim == 1:  # per-example mask applies to every output
+                mask = np.broadcast_to(mask[:, None], labels.shape)
+        while len(self._rocs) < labels.shape[1]:
+            self._rocs.append(ROC())
+        for i in range(labels.shape[1]):
+            m = None if mask is None else mask[:, i]
+            self._rocs[i].eval(labels[:, i], preds[:, i], mask=m)
+
+    def calculate_auc(self, output: int) -> float:
+        return self._rocs[output].calculate_auc()
+
+    def calculate_average_auc(self) -> float:
+        aucs = [r.calculate_auc() for r in self._rocs]
+        return float(np.nanmean(aucs)) if aucs else float("nan")
+
+
+class ROCMultiClass:
+    """One-vs-all ROC per class for softmax outputs (reference:
+    ROCMultiClass). ``eval`` takes one-hot (or index) labels and class
+    probabilities [n, k]; AUC per class is exact (rank-sum)."""
+
+    def __init__(self) -> None:
+        self._rocs: List[ROC] = []
+
+    def eval(self, labels, predictions, mask=None) -> None:
+        labels = np.asarray(labels)
+        preds = np.asarray(predictions)
+        labels, preds = _flatten_time(labels, preds, mask)
+        k = preds.shape[1]
+        truth = _to_class_indices(labels)
+        while len(self._rocs) < k:
+            self._rocs.append(ROC())
+        for c in range(k):
+            self._rocs[c].eval((truth == c).astype(np.float64), preds[:, c])
+
+    def calculate_auc(self, cls: int) -> float:
+        return self._rocs[cls].calculate_auc()
+
+    def calculate_auprc(self, cls: int) -> float:
+        return self._rocs[cls].calculate_auprc()
+
+    def calculate_average_auc(self) -> float:
+        aucs = [r.calculate_auc() for r in self._rocs]
+        return float(np.nanmean(aucs)) if aucs else float("nan")
+
+
+class EvaluationCalibration:
+    """Probability-calibration diagnostics (reference: EvaluationCalibration):
+    reliability diagram (mean predicted probability vs observed frequency per
+    confidence bin), expected calibration error, per-class probability
+    histograms, and the residual-plot histogram |label - p|."""
+
+    def __init__(self, reliability_bins: int = 10, histogram_bins: int = 50) -> None:
+        self.reliability_bins = int(reliability_bins)
+        self.histogram_bins = int(histogram_bins)
+        self._probs: List[np.ndarray] = []
+        self._labels: List[np.ndarray] = []
+
+    def eval(self, labels, predictions, mask=None) -> None:
+        labels = np.asarray(labels, dtype=np.float64)
+        preds = np.asarray(predictions, dtype=np.float64)
+        labels, preds = _flatten_time(labels, preds, mask)
+        if preds.ndim == 1:  # binary sigmoid output: one probability column
+            preds = preds[:, None]
+            labels = labels.reshape(-1, 1)
+        elif labels.ndim == 1 or (labels.ndim == 2 and labels.shape[1] == 1
+                                  and preds.shape[1] > 1):
+            idx = labels.reshape(-1).astype(np.int64)
+            onehot = np.zeros_like(preds)
+            onehot[np.arange(len(idx)), idx] = 1.0
+            labels = onehot
+        self._labels.append(labels)
+        self._probs.append(preds)
+
+    def _cat(self):
+        if not self._probs:
+            raise ValueError("No data evaluated")
+        return np.concatenate(self._labels), np.concatenate(self._probs)
+
+    def get_reliability_info(self, cls: Optional[int] = None):
+        """(mean_predicted, observed_frequency, counts) per confidence bin.
+        With ``cls`` the curve is for that class's probability column;
+        without, all columns pool (the reference's aggregate diagram)."""
+        y, p = self._cat()
+        if cls is not None:
+            y, p = y[:, cls], p[:, cls]
+        y, p = y.reshape(-1), p.reshape(-1)
+        edges = np.linspace(0.0, 1.0, self.reliability_bins + 1)
+        idx = np.clip(np.digitize(p, edges) - 1, 0, self.reliability_bins - 1)
+        counts = np.bincount(idx, minlength=self.reliability_bins)
+        sum_p = np.bincount(idx, weights=p, minlength=self.reliability_bins)
+        sum_y = np.bincount(idx, weights=y, minlength=self.reliability_bins)
+        with np.errstate(invalid="ignore"):
+            mean_p = np.where(counts > 0, sum_p / counts, np.nan)
+            freq = np.where(counts > 0, sum_y / counts, np.nan)
+        return mean_p, freq, counts
+
+    def expected_calibration_error(self, cls: Optional[int] = None) -> float:
+        mean_p, freq, counts = self.get_reliability_info(cls)
+        total = counts.sum()
+        if total == 0:
+            return float("nan")
+        valid = counts > 0
+        return float(np.sum(counts[valid] * np.abs(mean_p[valid] - freq[valid])) / total)
+
+    def get_probability_histogram(self, cls: int):
+        """(bin_edges, counts) of predicted probabilities for ``cls``."""
+        _, p = self._cat()
+        counts, edges = np.histogram(p[:, cls], bins=self.histogram_bins,
+                                     range=(0.0, 1.0))
+        return edges, counts
+
+    def get_residual_plot(self, cls: Optional[int] = None):
+        """(bin_edges, counts) of |label - p| residuals (reference:
+        getResidualPlot)."""
+        y, p = self._cat()
+        if cls is not None:
+            y, p = y[:, cls], p[:, cls]
+        res = np.abs(y.reshape(-1) - p.reshape(-1))
+        counts, edges = np.histogram(res, bins=self.histogram_bins,
+                                     range=(0.0, 1.0))
+        return edges, counts
+
+    def stats(self) -> str:
+        y, p = self._cat()
+        lines = [
+            "==================Calibration Evaluation==================",
+            f" examples:  {len(y)}",
+            f" classes:   {y.shape[1]}",
+            f" ECE:       {self.expected_calibration_error():.4f}",
+        ]
+        mean_p, freq, counts = self.get_reliability_info()
+        lines.append(" bin  mean_p  obs_freq  count")
+        for i in range(self.reliability_bins):
+            if counts[i]:
+                lines.append(f" {i:>3}  {mean_p[i]:.4f}  {freq[i]:.4f}    {counts[i]}")
         return "\n".join(lines)
