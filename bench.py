@@ -247,6 +247,55 @@ def measure_bert_import(batch: int = 16, seq: int = 128, warmup_iters: int = 2,
     }
 
 
+def measure_input_pipeline(n_images: int = 256, height: int = 224,
+                           width: int = 224) -> dict:
+    """ImageNet-shaped input-path throughput (decode + augment + resize +
+    batch), host-side — the number to compare against the ResNet-50 device
+    step rate for the input-bound-vs-compute-bound statement
+    (SURVEY.md:124 'the ImageNet input path')."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from deeplearning4j_tpu.data.image_transform import (
+        FlipImageTransform, PipelineImageTransform, RandomCropTransform,
+    )
+    from deeplearning4j_tpu.data.records import (
+        ImageRecordReader, RecordReaderDataSetIterator,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="bench_imgs_")
+    try:
+        rng = np.random.RandomState(0)
+        raw_h, raw_w = height + 32, width + 32
+        for cls in ("a", "b"):
+            os.makedirs(os.path.join(tmp, cls), exist_ok=True)
+        header = f"P6 {raw_w} {raw_h} 255\n".encode()
+        for i in range(n_images):
+            body = rng.randint(0, 256, (raw_h, raw_w, 3), np.uint8).tobytes()
+            with open(os.path.join(tmp, "ab"[i % 2], f"{i}.ppm"), "wb") as f:
+                f.write(header + body)
+
+        aug = PipelineImageTransform(
+            (FlipImageTransform(mode=1), 0.5),
+            RandomCropTransform(height=height, width=width),
+        )
+        reader = ImageRecordReader(height, width, 3, root=tmp, transform=aug)
+        it = RecordReaderDataSetIterator(reader, batch_size=32, label_index=1,
+                                         num_classes=2)
+        start = time.perf_counter()
+        n_seen = 0
+        for ds in it:
+            n_seen += ds.features.shape[0]
+        took = time.perf_counter() - start
+        return {"images_per_sec": n_seen / took, "n_images": n_seen,
+                "shape": [height, width, 3],
+                "augmentation": "flip(p=0.5) + random_crop"}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def measure_calibration(n: int = 4096, chain: int = 20, iters: int = 10) -> dict:
     """Measured-peak calibration row + timer self-check.
 
@@ -308,6 +357,7 @@ _MEASUREMENTS = {
     "bert": measure_bert,
     "bert_import": measure_bert_import,
     "calibration": measure_calibration,
+    "input_pipeline": measure_input_pipeline,
 }
 
 
@@ -381,6 +431,7 @@ def _child_measure(name: str, platform: str) -> None:
                             "bench_iters": 2, "hidden": 128, "layers": 2,
                             "heads": 2, "vocab": 2000},
             "calibration": {"n": 1024, "chain": 4, "iters": 2},
+            "input_pipeline": {"n_images": 64},
         }[name]
     result = _MEASUREMENTS[name](**kwargs)
     print(json.dumps(result))
@@ -423,7 +474,16 @@ def main() -> None:
         "bert_tf_import": _run_measurement("bert_import", platform),
         "lenet_smoke": _run_measurement("lenet", platform),
         "calibration": calibration,
+        "input_pipeline": _run_measurement("input_pipeline", platform),
     }
+
+    # input-bound vs compute-bound: one host input pipeline vs the device
+    # step rate (SURVEY.md:124). > 1 means the single-threaded host path
+    # keeps up; < 1 quantifies how many parallel input workers are needed.
+    ipl = extras["input_pipeline"]
+    if ipl.get("images_per_sec") and device.get("samples_per_sec"):
+        ipl["vs_resnet50_step"] = round(
+            ipl["images_per_sec"] / device["samples_per_sec"], 4)
 
     measured_peak = calibration.get("measured_peak_tflops")
     for row in (device, extras["bert"]):

@@ -1,4 +1,15 @@
 from .dataset import DataSet, MultiDataSet
+from .fetchers import Cifar10DataSetIterator, EmnistDataSetIterator
+from .image_transform import (
+    BrightnessTransform,
+    CropImageTransform,
+    FlipImageTransform,
+    ImageTransform,
+    PipelineImageTransform,
+    RandomCropTransform,
+    ResizeImageTransform,
+    RotateImageTransform,
+)
 from .records import (
     CollectionRecordReader,
     CSVRecordReader,
@@ -15,11 +26,21 @@ from .transform import (
 )
 
 __all__ = [
+    "BrightnessTransform",
+    "Cifar10DataSetIterator",
     "CollectionRecordReader",
     "CSVRecordReader",
     "CSVSequenceRecordReader",
+    "CropImageTransform",
     "DataSet",
+    "EmnistDataSetIterator",
+    "FlipImageTransform",
     "ImageRecordReader",
+    "ImageTransform",
+    "PipelineImageTransform",
+    "RandomCropTransform",
+    "ResizeImageTransform",
+    "RotateImageTransform",
     "LineRecordReader",
     "MultiDataSet",
     "RecordReader",

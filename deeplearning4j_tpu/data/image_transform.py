@@ -1,0 +1,183 @@
+"""Image augmentation transforms.
+
+Reference: datavec-data-image's ImageTransform hierarchy (FlipImageTransform,
+CropImageTransform, RandomCropTransform, RotateImageTransform,
+ResizeImageTransform, PipelineImageTransform — SURVEY.md §2.2 "DataVec
+image", "the ImageNet input path"). Host-side numpy/PIL on [h, w, c] float32
+arrays, composable via PipelineImageTransform, pluggable into
+ImageRecordReader(transform=...).
+
+TPU-first note: the heavy lifting (normalize, random flip/crop at batch
+granularity) can also run ON DEVICE via ``batch_random_flip`` /
+``batch_random_crop`` — jitted, batched augmentation is the right answer
+when the host is one slow core and the accelerator is idle between steps
+(the reference leans on OpenCV + host thread pools instead).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class ImageTransform:
+    """Base: ``call(image, rng)`` -> image, both [h, w, c] float32."""
+
+    def call(self, image: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
+        raise NotImplementedError
+
+    def __call__(self, image: np.ndarray,
+                 rng: Optional[np.random.RandomState] = None) -> np.ndarray:
+        return self.call(np.asarray(image, np.float32),
+                         rng or np.random.RandomState())
+
+
+@dataclasses.dataclass
+class FlipImageTransform(ImageTransform):
+    """Reference: FlipImageTransform(flipMode). mode: 0 = vertical,
+    1 = horizontal, -1 = both, None = random choice per call."""
+
+    mode: Optional[int] = 1
+
+    def call(self, image, rng):
+        mode = self.mode
+        if mode is None:
+            mode = rng.choice([-1, 0, 1])
+        if mode in (0, -1):
+            image = image[::-1]
+        if mode in (1, -1):
+            image = image[:, ::-1]
+        return np.ascontiguousarray(image)
+
+
+@dataclasses.dataclass
+class CropImageTransform(ImageTransform):
+    """Deterministic border crop (reference: CropImageTransform)."""
+
+    top: int = 0
+    left: int = 0
+    bottom: int = 0
+    right: int = 0
+
+    def call(self, image, rng):
+        h, w = image.shape[:2]
+        return image[self.top: h - self.bottom or h,
+                     self.left: w - self.right or w]
+
+
+@dataclasses.dataclass
+class RandomCropTransform(ImageTransform):
+    """Random crop to (height, width) (reference: RandomCropTransform)."""
+
+    height: int = 0
+    width: int = 0
+
+    def call(self, image, rng):
+        h, w = image.shape[:2]
+        if h < self.height or w < self.width:
+            raise ValueError(f"image {h}x{w} smaller than crop "
+                             f"{self.height}x{self.width}")
+        top = rng.randint(0, h - self.height + 1)
+        left = rng.randint(0, w - self.width + 1)
+        return image[top: top + self.height, left: left + self.width]
+
+
+@dataclasses.dataclass
+class RotateImageTransform(ImageTransform):
+    """Rotate by ``angle`` degrees, or uniformly in [-angle, angle] when
+    ``random`` (reference: RotateImageTransform). Right-angle rotations are
+    exact (np.rot90); others resample bilinearly via PIL."""
+
+    angle: float = 0.0
+    random: bool = False
+
+    def call(self, image, rng):
+        angle = float(self.angle)
+        if self.random:
+            angle = float(rng.uniform(-self.angle, self.angle))
+        if angle % 90.0 == 0.0:
+            return np.ascontiguousarray(np.rot90(image, int(angle // 90) % 4))
+        from PIL import Image
+
+        chans = []
+        for c in range(image.shape[2]):
+            im = Image.fromarray(image[:, :, c].astype(np.float32), mode="F")
+            chans.append(np.asarray(im.rotate(angle, resample=Image.BILINEAR)))
+        return np.stack(chans, axis=2)
+
+
+@dataclasses.dataclass
+class ResizeImageTransform(ImageTransform):
+    """Bilinear resize (reference: ResizeImageTransform)."""
+
+    height: int = 0
+    width: int = 0
+
+    def call(self, image, rng):
+        from .. import native
+
+        return native.resize_bilinear(image, self.height, self.width)
+
+
+@dataclasses.dataclass
+class BrightnessTransform(ImageTransform):
+    """Additive brightness jitter in [-delta, delta] (for [0, 255] or
+    [0, 1] ranged images alike — delta is in image units)."""
+
+    delta: float = 0.0
+
+    def call(self, image, rng):
+        return image + rng.uniform(-self.delta, self.delta)
+
+
+class PipelineImageTransform(ImageTransform):
+    """Chain transforms, each applied with a probability (reference:
+    PipelineImageTransform with (transform, probability) pairs)."""
+
+    def __init__(self, *steps, shuffle: bool = False) -> None:
+        self.steps: List[Tuple[ImageTransform, float]] = [
+            s if isinstance(s, tuple) else (s, 1.0) for s in steps
+        ]
+        self.shuffle = shuffle
+
+    def call(self, image, rng):
+        order = list(range(len(self.steps)))
+        if self.shuffle:
+            rng.shuffle(order)
+        for i in order:
+            t, p = self.steps[i]
+            if p >= 1.0 or rng.rand() < p:
+                image = t.call(image, rng)
+        return image
+
+
+# ---------------------------------------------------------------------------
+# device-side batched augmentation (jit-friendly; [n, c, h, w])
+# ---------------------------------------------------------------------------
+
+def batch_random_flip(x, key):
+    """Per-image random horizontal flip on device. x: [n, c, h, w]."""
+    import jax
+    import jax.numpy as jnp
+
+    flip = jax.random.bernoulli(key, 0.5, (x.shape[0],))
+    return jnp.where(flip[:, None, None, None], x[..., ::-1], x)
+
+
+def batch_random_crop(x, key, height: int, width: int):
+    """Per-image random crop on device via one dynamic_slice per image
+    under vmap. x: [n, c, h, w] -> [n, c, height, width]."""
+    import jax
+    import jax.numpy as jnp
+
+    n, c, h, w = x.shape
+    k1, k2 = jax.random.split(key)
+    tops = jax.random.randint(k1, (n,), 0, h - height + 1)
+    lefts = jax.random.randint(k2, (n,), 0, w - width + 1)
+
+    def crop(img, top, left):
+        return jax.lax.dynamic_slice(img, (0, top, left), (c, height, width))
+
+    return jax.vmap(crop)(x, tops, lefts)

@@ -128,26 +128,49 @@ class CSVSequenceRecordReader(RecordReader):
             yield list(reader)
 
 
+def _pil():
+    try:
+        from PIL import Image
+
+        return Image
+    except ImportError:  # pragma: no cover - env-dependent
+        return None
+
+
 class ImageRecordReader(RecordReader):
     """Image directory reader (reference: ImageRecordReader +
     NativeImageLoader — SURVEY.md §2.2 'the ImageNet input path').
 
-    Walks ``root`` for netpbm images (P5/P6 — the local no-OpenCV format),
-    decodes + bilinearly resizes to [height, width, channels], and when
-    ``label_from_path`` appends the parent-directory label index. Record:
-    ``[ndarray(h, w, c), label_idx]``.
+    Walks ``root`` for images, decodes + bilinearly resizes to
+    [height, width, channels], and when ``label_from_path`` appends the
+    parent-directory label index. Record: ``[ndarray(h, w, c), label_idx]``.
+
+    Decode story: netpbm (P5/P6) through the native C++ codec always;
+    PNG/JPEG/BMP/GIF through Pillow when it is importable (it is in this
+    environment). ``transform`` applies an
+    :class:`~..data.image_transform.ImageTransform` (augmentation pipeline)
+    to every decoded image, the reference's ImageRecordReader(transform)
+    seam.
     """
 
-    EXTENSIONS = (".ppm", ".pgm", ".pnm")
+    NETPBM_EXTENSIONS = (".ppm", ".pgm", ".pnm")
+    PIL_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".gif")
 
     def __init__(self, height: int, width: int, channels: int = 3, *,
                  root: Optional[str] = None,
                  paths: Optional[Sequence[str]] = None,
-                 label_from_path: bool = True) -> None:
+                 label_from_path: bool = True,
+                 transform=None, seed: int = 0) -> None:
         if (root is None) == (paths is None):
             raise ValueError("provide exactly one of root= or paths=")
         self.height, self.width, self.channels = height, width, channels
         self.label_from_path = label_from_path
+        self.transform = transform
+        self._rng = np.random.RandomState(seed)
+        # resolved once: PIL availability can't change mid-scan, and the
+        # walk below tests this per file at ImageNet scale
+        self.EXTENSIONS = self.NETPBM_EXTENSIONS + (
+            self.PIL_EXTENSIONS if _pil() is not None else ())
         if root is not None:
             found: List[str] = []
             for dirpath, _dirnames, filenames in sorted(os.walk(root)):
@@ -168,9 +191,28 @@ class ImageRecordReader(RecordReader):
     def labels(self) -> Optional[List[str]]:
         return self._labels or None
 
+    def _decode(self, path: str) -> np.ndarray:
+        if path.lower().endswith(self.NETPBM_EXTENSIONS):
+            with open(path, "rb") as f:
+                return native.decode_netpbm(f.read())
+        Image = _pil()
+        if Image is None:
+            raise ValueError(
+                f"{path}: only netpbm is decodable without Pillow "
+                "(convert with e.g. `mogrify -format ppm`)")
+        with Image.open(path) as im:
+            if im.mode not in ("RGB", "L"):
+                im = im.convert("RGB" if self.channels == 3 else "L")
+            arr = np.asarray(im, dtype=np.float32) / 255.0  # match netpbm [0,1]
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr
+
     def _load(self, path: str) -> np.ndarray:
-        with open(path, "rb") as f:
-            img = native.decode_netpbm(f.read())
+        img = self._decode(path)
+        if self.transform is not None:
+            img = np.asarray(self.transform.call(
+                np.asarray(img, np.float32), self._rng))
         if img.shape[:2] != (self.height, self.width):
             img = native.resize_bilinear(img, self.height, self.width)
         if img.shape[2] != self.channels:

@@ -1,0 +1,167 @@
+"""Image input pipeline: decode (netpbm native + PNG/JPEG via Pillow),
+augmentation transforms, and the input-vs-compute throughput statement
+(VERDICT.md round 3 ask 8; SURVEY.md:124 'the ImageNet input path')."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.image_transform import (
+    BrightnessTransform,
+    CropImageTransform,
+    FlipImageTransform,
+    PipelineImageTransform,
+    RandomCropTransform,
+    ResizeImageTransform,
+    RotateImageTransform,
+)
+from deeplearning4j_tpu.data.records import (
+    ImageRecordReader,
+    RecordReaderDataSetIterator,
+)
+
+
+def _img(h=8, w=10, c=3, seed=0):
+    return np.random.RandomState(seed).rand(h, w, c).astype(np.float32) * 255
+
+
+# ---------------------------------------------------------------------------
+# transforms
+# ---------------------------------------------------------------------------
+
+def test_flip_modes():
+    x = _img()
+    assert np.array_equal(FlipImageTransform(mode=1)(x), x[:, ::-1])
+    assert np.array_equal(FlipImageTransform(mode=0)(x), x[::-1])
+    assert np.array_equal(FlipImageTransform(mode=-1)(x), x[::-1, ::-1])
+
+
+def test_crop_and_random_crop():
+    x = _img(12, 12)
+    out = CropImageTransform(top=2, left=1, bottom=3, right=2)(x)
+    assert out.shape == (7, 9, 3)
+    np.testing.assert_array_equal(out, x[2:9, 1:10])
+
+    rc = RandomCropTransform(height=5, width=6)
+    rng = np.random.RandomState(0)
+    for _ in range(5):
+        out = rc.call(x, rng)
+        assert out.shape == (5, 6, 3)
+    with pytest.raises(ValueError):
+        RandomCropTransform(height=20, width=5)(x)
+
+
+def test_rotate_right_angle_exact_and_arbitrary():
+    x = _img(6, 6)
+    assert np.array_equal(RotateImageTransform(angle=90)(x), np.rot90(x))
+    assert np.array_equal(RotateImageTransform(angle=180)(x), np.rot90(x, 2))
+    out = RotateImageTransform(angle=30)(x)  # PIL bilinear path
+    assert out.shape == x.shape
+    assert np.isfinite(out).all()
+
+
+def test_pipeline_probability_and_order():
+    x = _img()
+    always = PipelineImageTransform(
+        FlipImageTransform(mode=1), FlipImageTransform(mode=1))
+    np.testing.assert_array_equal(always(x), x)  # double flip = identity
+    never = PipelineImageTransform((BrightnessTransform(delta=100.0), 0.0))
+    np.testing.assert_array_equal(never(x), x)
+
+
+def test_device_batch_augmentation():
+    import jax
+
+    from deeplearning4j_tpu.data.image_transform import (
+        batch_random_crop, batch_random_flip,
+    )
+
+    x = np.random.RandomState(0).rand(4, 3, 12, 12).astype(np.float32)
+    key = jax.random.PRNGKey(0)
+    flipped = np.asarray(jax.jit(batch_random_flip)(x, key))
+    for i in range(4):
+        ok_same = np.array_equal(flipped[i], x[i])
+        ok_flip = np.array_equal(flipped[i], x[i][..., ::-1])
+        assert ok_same or ok_flip
+    cropped = jax.jit(
+        lambda a, k: batch_random_crop(a, k, 8, 8))(x, key)
+    assert cropped.shape == (4, 3, 8, 8)
+
+
+# ---------------------------------------------------------------------------
+# decode
+# ---------------------------------------------------------------------------
+
+def _write_ppm(path, arr):
+    h, w, _ = arr.shape
+    with open(path, "wb") as f:
+        f.write(f"P6 {w} {h} 255\n".encode())
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+def test_png_and_jpeg_decode(tmp_path):
+    PIL = pytest.importorskip("PIL.Image")
+    rng = np.random.RandomState(0)
+    arr = rng.randint(0, 256, (10, 12, 3), np.uint8)
+    for cls in ("a", "b"):
+        os.makedirs(tmp_path / cls, exist_ok=True)
+    PIL.fromarray(arr).save(str(tmp_path / "a" / "x.png"))
+    PIL.fromarray(arr).save(str(tmp_path / "b" / "y.jpg"), quality=95)
+    _write_ppm(str(tmp_path / "a" / "z.ppm"), arr)
+
+    reader = ImageRecordReader(10, 12, 3, root=str(tmp_path))
+    recs = list(reader)
+    assert len(recs) == 3
+    assert reader.labels() == ["a", "b"]
+    png_rec = recs[0][0]  # a/x.png sorts first
+    # all decoders normalize to [0, 1] (the native netpbm convention)
+    np.testing.assert_allclose(png_rec, arr.astype(np.float32) / 255.0,
+                               atol=0.5 / 255.0)
+
+
+def test_reader_applies_augmentation(tmp_path):
+    rng = np.random.RandomState(0)
+    arr = rng.randint(0, 256, (10, 10, 3), np.uint8)
+    os.makedirs(tmp_path / "a", exist_ok=True)
+    _write_ppm(str(tmp_path / "a" / "x.ppm"), arr)
+    reader = ImageRecordReader(
+        10, 10, 3, root=str(tmp_path),
+        transform=FlipImageTransform(mode=1))
+    rec = next(iter(reader))[0]
+    np.testing.assert_allclose(rec, arr[:, ::-1].astype(np.float32) / 255.0,
+                               rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# throughput: input path vs compute step
+# ---------------------------------------------------------------------------
+
+def test_input_pipeline_throughput_vs_resnet_step(tmp_path, capsys):
+    """The honest input-bound-vs-compute-bound statement: measure the
+    augmented 224x224 input path (decode+flip+crop+batch) and compare to
+    the last TPU-measured ResNet-50 step rate. Asserts a conservative
+    host-throughput floor; prints the ratio for the record."""
+    rng = np.random.RandomState(0)
+    os.makedirs(tmp_path / "a", exist_ok=True)
+    n = 48
+    for i in range(n):
+        _write_ppm(str(tmp_path / "a" / f"{i}.ppm"),
+                   rng.randint(0, 256, (256, 256, 3), np.uint8))
+    aug = PipelineImageTransform(
+        (FlipImageTransform(mode=1), 0.5),
+        RandomCropTransform(height=224, width=224))
+    reader = ImageRecordReader(224, 224, 3, root=str(tmp_path), transform=aug)
+    it = RecordReaderDataSetIterator(reader, batch_size=16, label_index=1,
+                                     num_classes=1)
+    start = time.perf_counter()
+    seen = sum(ds.features.shape[0] for ds in it)
+    rate = seen / (time.perf_counter() - start)
+    assert seen == n
+    assert rate > 30  # single slow core; TPU feeding needs parallel workers
+    resnet_tpu_sps = 1794.89  # BENCH_latest.json, round 4
+    with capsys.disabled():
+        print(f"\n[input-pipeline] {rate:.0f} img/s host vs "
+              f"{resnet_tpu_sps:.0f} samples/s ResNet-50/TPU -> "
+              f"need ~{resnet_tpu_sps / rate:.1f} input workers")
