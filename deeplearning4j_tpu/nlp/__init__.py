@@ -8,6 +8,8 @@ data prep.
 
 from .tokenization import BasicTokenizer, BertWordPieceTokenizer, Vocabulary
 from .bert_iterator import BertIterator, BertTask
+from .glove import Glove
+from .paragraph_vectors import LabelledDocument, ParagraphVectors
 from .word2vec import Word2Vec
 
 __all__ = [
@@ -15,6 +17,9 @@ __all__ = [
     "BertIterator",
     "BertTask",
     "BertWordPieceTokenizer",
+    "Glove",
+    "LabelledDocument",
+    "ParagraphVectors",
     "Vocabulary",
     "Word2Vec",
 ]

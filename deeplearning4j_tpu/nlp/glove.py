@@ -1,0 +1,182 @@
+"""GloVe — global co-occurrence vectors, trained on device.
+
+Reference: org.deeplearning4j.models.glove.Glove (SURVEY.md §2.2 "NLP"):
+windowed co-occurrence counting with 1/distance weighting, then AdaGrad
+over the weighted-least-squares GloVe objective
+f(X_ij) (w_i·~w_j + b_i + ~b_j - log X_ij)^2.
+
+TPU design: the reference shards (i, j, X) triples across CPU trainer
+threads; here the triples batch into one jitted AdaGrad step — [B] rows,
+[B] cols, [B] targets per launch, gathers/scatter-adds on the MXU-adjacent
+vector tables. Counting stays host-side (a dict pass over the corpus is
+IO-bound, not FLOP-bound).
+
+API parity with Word2Vec: fit(), get_word_vector(), similarity(),
+words_nearest().
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class Glove:
+    def __init__(
+        self,
+        *,
+        vector_size: int = 100,
+        window: int = 5,
+        min_count: int = 5,
+        x_max: float = 100.0,
+        alpha: float = 0.75,
+        learning_rate: float = 0.05,
+        epochs: int = 5,
+        batch_size: int = 4096,
+        symmetric: bool = True,
+        seed: int = 12345,
+    ) -> None:
+        self.vector_size = int(vector_size)
+        self.window = int(window)
+        self.min_count = int(min_count)
+        self.x_max = float(x_max)
+        self.alpha = float(alpha)
+        self.learning_rate = float(learning_rate)
+        self.epochs = int(epochs)
+        self.batch_size = int(batch_size)
+        self.symmetric = bool(symmetric)
+        self.seed = int(seed)
+
+        self.vocab: List[str] = []
+        self.vocab_index: Dict[str, int] = {}
+        self.syn0: np.ndarray = None  # final vectors (w + ~w) [V, D]
+
+    # ----- vocab + co-occurrence --------------------------------------
+
+    def _build_vocab(self, sentences: Sequence[Sequence[str]]) -> None:
+        freq: Dict[str, int] = {}
+        for sent in sentences:
+            for w in sent:
+                freq[w] = freq.get(w, 0) + 1
+        items = sorted(((c, w) for w, c in freq.items()
+                        if c >= self.min_count), reverse=True)
+        self.vocab = [w for _, w in items]
+        self.vocab_index = {w: i for i, w in enumerate(self.vocab)}
+        if not self.vocab:
+            raise ValueError(
+                f"no tokens with count >= min_count ({self.min_count})")
+
+    def _cooccurrences(self, sentences) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(rows, cols, X) triples; X accumulates 1/distance per pair
+        (the reference's distance weighting)."""
+        counts: Dict[Tuple[int, int], float] = {}
+        for sent in sentences:
+            ids = [self.vocab_index[w] for w in sent if w in self.vocab_index]
+            for pos, center in enumerate(ids):
+                lo = max(0, pos - self.window)
+                for ctx_pos in range(lo, pos):
+                    other = ids[ctx_pos]
+                    weight = 1.0 / (pos - ctx_pos)
+                    counts[(center, other)] = counts.get((center, other), 0.0) + weight
+                    if self.symmetric:
+                        counts[(other, center)] = counts.get((other, center), 0.0) + weight
+        rows = np.asarray([k[0] for k in counts], np.int32)
+        cols = np.asarray([k[1] for k in counts], np.int32)
+        vals = np.asarray(list(counts.values()), np.float32)
+        return rows, cols, vals
+
+    # ----- training ---------------------------------------------------
+
+    def _make_step(self):
+        x_max, alpha = self.x_max, self.alpha
+
+        @jax.jit
+        def step(w, wc, b, bc, gw, gwc, gb, gbc, rows, cols, x, valid, lr):
+            wi = w[rows]                      # [B, D]
+            wj = wc[cols]
+            diff = (jnp.einsum("bd,bd->b", wi, wj) + b[rows] + bc[cols]
+                    - jnp.log(x))
+            fx = jnp.minimum((x / x_max) ** alpha, 1.0)
+            g = fx * diff * valid             # [B]
+            loss = 0.5 * jnp.sum(fx * diff * diff * valid) / jnp.maximum(
+                jnp.sum(valid), 1.0)
+
+            grad_wi = g[:, None] * wj
+            grad_wj = g[:, None] * wi
+            # AdaGrad accumulators per table row (the reference's updater)
+            gw = gw.at[rows].add(grad_wi ** 2)
+            gwc = gwc.at[cols].add(grad_wj ** 2)
+            gb = gb.at[rows].add(g ** 2)
+            gbc = gbc.at[cols].add(g ** 2)
+            w = w.at[rows].add(-lr * grad_wi / jnp.sqrt(gw[rows] + 1e-8))
+            wc = wc.at[cols].add(-lr * grad_wj / jnp.sqrt(gwc[cols] + 1e-8))
+            b = b.at[rows].add(-lr * g / jnp.sqrt(gb[rows] + 1e-8))
+            bc = bc.at[cols].add(-lr * g / jnp.sqrt(gbc[cols] + 1e-8))
+            return w, wc, b, bc, gw, gwc, gb, gbc, loss
+
+        return step
+
+    def fit(self, sentences: Sequence[Sequence[str]],
+            verbose: bool = False) -> "Glove":
+        sentences = list(sentences)
+        self._build_vocab(sentences)
+        rows, cols, vals = self._cooccurrences(sentences)
+        rng = np.random.RandomState(self.seed)
+        v, d = len(self.vocab), self.vector_size
+
+        w = jnp.asarray((rng.rand(v, d) - 0.5) / d, jnp.float32)
+        wc = jnp.asarray((rng.rand(v, d) - 0.5) / d, jnp.float32)
+        b = jnp.zeros(v, jnp.float32)
+        bc = jnp.zeros(v, jnp.float32)
+        gw = jnp.full((v, d), 1e-8, jnp.float32)
+        gwc = jnp.full((v, d), 1e-8, jnp.float32)
+        gb = jnp.full(v, 1e-8, jnp.float32)
+        gbc = jnp.full(v, 1e-8, jnp.float32)
+        step = self._make_step()
+
+        n = len(vals)
+        bs = self.batch_size
+        for epoch in range(self.epochs):
+            order = rng.permutation(n)
+            last = 0.0
+            for start in range(0, n, bs):
+                idx = order[start: start + bs]
+                total = bs  # static shape: cyclic pad + validity mask
+                take = np.resize(idx, total)
+                valid = np.zeros(total, np.float32)
+                valid[: len(idx)] = 1.0
+                w, wc, b, bc, gw, gwc, gb, gbc, loss = step(
+                    w, wc, b, bc, gw, gwc, gb, gbc,
+                    jnp.asarray(rows[take]), jnp.asarray(cols[take]),
+                    jnp.asarray(vals[take]), jnp.asarray(valid),
+                    jnp.float32(self.learning_rate))
+                last = float(loss)
+            if verbose:
+                print(f"glove epoch {epoch}: loss {last:.4f}")
+        # the published GloVe result sums the two tables
+        self.syn0 = np.asarray(w) + np.asarray(wc)
+        return self
+
+    # ----- query API --------------------------------------------------
+
+    def has_word(self, word: str) -> bool:
+        return word in self.vocab_index
+
+    def get_word_vector(self, word: str) -> np.ndarray:
+        return self.syn0[self.vocab_index[word]]
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        denom = (np.linalg.norm(va) * np.linalg.norm(vb)) or 1e-10
+        return float(va @ vb / denom)
+
+    def words_nearest(self, word: str, n: int = 10) -> List[str]:
+        v = self.get_word_vector(word)
+        norms = np.linalg.norm(self.syn0, axis=1) * (np.linalg.norm(v) + 1e-10)
+        sims = self.syn0 @ v / np.maximum(norms, 1e-10)
+        order = np.argsort(-sims)
+        return [self.vocab[i] for i in order if self.vocab[i] != word][:n]

@@ -1,0 +1,218 @@
+"""ParagraphVectors (doc2vec) — PV-DBOW with negative sampling, on device.
+
+Reference: org.deeplearning4j.models.paragraphvectors.ParagraphVectors
+(SURVEY.md §2.2 "NLP"): document/label vectors trained against the word
+objective; inference of vectors for unseen documents by frozen-vocab
+gradient descent.
+
+TPU design: PV-DBOW is exactly the Word2Vec skip-gram negative-sampling
+step with the doc id standing in for the center word — the same batched
+jitted update over a [n_docs, D] table (the reference runs it on hogwild
+CPU threads). ``infer_vector`` optimizes ONE new row with the word tables
+frozen, also jitted.
+
+API parity: fit(), get_doc_vector()/lookup_table, infer_vector(),
+similarity(), nearest_labels().
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .word2vec import Word2Vec
+
+
+class LabelledDocument:
+    """Reference spelling (deeplearning4j-nlp LabelledDocument)."""
+
+    def __init__(self, content: Sequence[str], label: str) -> None:
+        self.content = list(content)
+        self.label = label
+
+
+class ParagraphVectors:
+    def __init__(
+        self,
+        *,
+        vector_size: int = 100,
+        window: int = 5,
+        min_count: int = 2,
+        negative: int = 5,
+        learning_rate: float = 1.5,
+        epochs: int = 5,
+        batch_size: int = 1024,
+        seed: int = 12345,
+    ) -> None:
+        self.vector_size = int(vector_size)
+        self.window = int(window)
+        self.min_count = int(min_count)
+        self.negative = int(negative)
+        self.learning_rate = float(learning_rate)
+        self.epochs = int(epochs)
+        self.batch_size = int(batch_size)
+        self.seed = int(seed)
+
+        self.labels: List[str] = []
+        self.label_index: Dict[str, int] = {}
+        self.doc_vectors: Optional[np.ndarray] = None  # [n_docs, D]
+        self._w2v: Optional[Word2Vec] = None
+
+    # ----- training ---------------------------------------------------
+
+    def _make_step(self):
+        @jax.jit
+        def step(docs, syn1, doc_ids, targets, valid, lr):
+            d_vec = docs[doc_ids]                 # [B, D]
+            t_vec = syn1[targets]                 # [B, 1+K, D]
+            logits = jnp.einsum("bd,bkd->bk", d_vec, t_vec)
+            labels = jnp.zeros_like(logits).at[:, 0].set(1.0)
+            sig = jax.nn.sigmoid(logits)
+            g = (sig - labels) * valid * (lr / logits.shape[0])
+            grad_d = jnp.einsum("bk,bkd->bd", g, t_vec)
+            grad_t = g[..., None] * d_vec[:, None, :]
+            docs = docs.at[doc_ids].add(-grad_d)
+            syn1 = syn1.at[targets.reshape(-1)].add(
+                -grad_t.reshape(-1, grad_t.shape[-1]))
+            loss = -jnp.sum(
+                valid * (labels * jnp.log(sig + 1e-10)
+                         + (1 - labels) * jnp.log(1 - sig + 1e-10))
+            ) / jnp.maximum(jnp.sum(valid), 1.0)
+            return docs, syn1, loss
+
+        return step
+
+    def fit(self, documents: Sequence[LabelledDocument],
+            verbose: bool = False) -> "ParagraphVectors":
+        documents = list(documents)
+        self.labels = [d.label for d in documents]
+        self.label_index = {l: i for i, l in enumerate(self.labels)}
+        if len(self.label_index) != len(self.labels):
+            raise ValueError("document labels must be unique")
+
+        # word vocabulary + output table come from a word2vec pass over the
+        # corpus (the reference trains words and docs jointly; sequential
+        # training keeps each phase one clean batched program)
+        self._w2v = Word2Vec(
+            vector_size=self.vector_size, window=self.window,
+            min_count=self.min_count, negative=self.negative,
+            epochs=1, batch_size=self.batch_size, seed=self.seed)
+        self._w2v.fit([d.content for d in documents])
+
+        rng = np.random.RandomState(self.seed)
+        n, dim = len(documents), self.vector_size
+        docs = jnp.asarray((rng.rand(n, dim) - 0.5) / dim, jnp.float32)
+        syn1 = jnp.asarray(self._w2v.syn1)
+        table = self._w2v._negative_table()
+        step = self._make_step()
+        vocab_index = self._w2v.vocab_index
+
+        pairs_d: List[int] = []
+        pairs_w: List[int] = []
+        for di, doc in enumerate(documents):
+            for w in doc.content:
+                wi = vocab_index.get(w)
+                if wi is not None:
+                    pairs_d.append(di)
+                    pairs_w.append(wi)
+        pairs_d_np = np.asarray(pairs_d, np.int32)
+        pairs_w_np = np.asarray(pairs_w, np.int32)
+
+        bs = self.batch_size
+        n_pairs = len(pairs_d_np)
+        total_batches = max(1, self.epochs * max(1, n_pairs) // bs)
+        batch_i = 0
+        for epoch in range(self.epochs):
+            order = rng.permutation(n_pairs)
+            last = 0.0
+            for start in range(0, n_pairs, bs):
+                idx = np.resize(order[start: start + bs], bs)
+                valid_rows = np.zeros(bs, np.float32)
+                valid_rows[: min(bs, n_pairs - start)] = 1.0
+                negs = table[rng.randint(0, table.size, (bs, self.negative))]
+                targets = np.concatenate(
+                    [pairs_w_np[idx][:, None], negs], axis=1)
+                valid = np.concatenate(
+                    [np.ones((bs, 1), np.float32),
+                     (negs != pairs_w_np[idx][:, None]).astype(np.float32)],
+                    axis=1) * valid_rows[:, None]
+                frac = min(1.0, batch_i / total_batches)
+                lr = max(1e-4, self.learning_rate * (1 - frac))
+                docs, syn1, loss = step(
+                    docs, syn1, jnp.asarray(pairs_d_np[idx]),
+                    jnp.asarray(targets), jnp.asarray(valid), jnp.float32(lr))
+                batch_i += 1
+                last = float(loss)
+            if verbose:
+                print(f"pv epoch {epoch}: loss {last:.4f}")
+        self.doc_vectors = np.asarray(docs)
+        self._syn1_final = np.asarray(syn1)
+        return self
+
+    # ----- inference --------------------------------------------------
+
+    def infer_vector(self, tokens: Sequence[str], steps: int = 50,
+                     learning_rate: float = 0.5) -> np.ndarray:
+        """Vector for an unseen document: optimize one fresh row against
+        the FROZEN output table (reference: inferVector)."""
+        if self.doc_vectors is None:
+            raise ValueError("fit() first")
+        vocab_index = self._w2v.vocab_index
+        wids = np.asarray(
+            [vocab_index[w] for w in tokens if w in vocab_index], np.int32)
+        if wids.size == 0:
+            raise ValueError("no in-vocabulary tokens in document")
+        rng = np.random.RandomState(self.seed)
+        vec = jnp.asarray((rng.rand(self.vector_size) - 0.5)
+                          / self.vector_size, jnp.float32)
+        syn1 = jnp.asarray(self._syn1_final)
+        table = self._w2v._negative_table()
+
+        @jax.jit
+        def one(vec, targets, lr):
+            t_vec = syn1[targets]                     # [P, 1+K, D]
+            logits = jnp.einsum("d,pkd->pk", vec, t_vec)
+            labels = jnp.zeros_like(logits).at[:, 0].set(1.0)
+            sig = jax.nn.sigmoid(logits)
+            g = (sig - labels) * (lr / logits.shape[0])
+            return vec - jnp.einsum("pk,pkd->d", g, t_vec)
+
+        for it in range(steps):
+            negs = table[rng.randint(0, table.size,
+                                     (wids.size, self.negative))]
+            targets = np.concatenate([wids[:, None], negs], axis=1)
+            lr = learning_rate * (1.0 - it / steps)
+            vec = one(vec, jnp.asarray(targets), jnp.float32(lr))
+        return np.asarray(vec)
+
+    # ----- query API --------------------------------------------------
+
+    def get_doc_vector(self, label: str) -> np.ndarray:
+        return self.doc_vectors[self.label_index[label]]
+
+    lookup_vector = get_doc_vector
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_doc_vector(a), self.get_doc_vector(b)
+        denom = (np.linalg.norm(va) * np.linalg.norm(vb)) or 1e-10
+        return float(va @ vb / denom)
+
+    def nearest_labels(self, tokens_or_label, n: int = 5) -> List[str]:
+        """Labels closest to a document (by label, or by raw tokens via
+        infer_vector) — reference: nearestLabels."""
+        if isinstance(tokens_or_label, str):
+            v = self.get_doc_vector(tokens_or_label)
+            exclude = tokens_or_label
+        else:
+            v = self.infer_vector(tokens_or_label)
+            exclude = None
+        norms = (np.linalg.norm(self.doc_vectors, axis=1)
+                 * (np.linalg.norm(v) + 1e-10))
+        sims = self.doc_vectors @ v / np.maximum(norms, 1e-10)
+        order = np.argsort(-sims)
+        return [self.labels[i] for i in order
+                if self.labels[i] != exclude][:n]

@@ -1,0 +1,125 @@
+"""SameDiff custom-layer escape hatch.
+
+Reference: org.deeplearning4j.nn.conf.layers.samediff.{SameDiffLayer,
+SameDiffLambdaLayer} (SURVEY.md §2.2 "Layer implementations" — the
+user-defined-op seam): a layer whose forward is built from SameDiff ops
+instead of a built-in implementation, usable inside MultiLayerNetwork and
+ComputationGraph like any other layer.
+
+TPU design: the user graph is evaluated through SameDiff._eval_graph INSIDE
+the model's traced forward, so it fuses into the same single XLA program as
+the built-in layers — no interpreter boundary, unlike the reference where a
+SameDiffLayer drops into the op-by-op SameDiff session per call. Gradients
+come from jax autodiff over the traced ops; defineGradient does not exist.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...core.config import register_config
+from ..input_type import FeedForwardType, InputType
+from ..weights import WeightInit, init_weights
+from .base import Layer, LayerContext, Params, State, apply_input_dropout
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class SameDiffLambdaLayer(Layer):
+    """Parameterless custom op (reference: SameDiffLambdaLayer).
+
+    ``fn(sd, x) -> SDVariable`` builds the forward from SameDiff ops; a
+    plain jnp function ``fn(x) -> array`` is also accepted (the TPU-native
+    shortcut — both trace into the same program).
+    """
+
+    fn: Optional[Callable] = None
+    # output shape relative to input; None = unchanged
+    output_size: Optional[int] = None
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if self.output_size is not None:
+            return FeedForwardType(size=self.output_size)
+        return input_type
+
+    def apply(self, params: Params, state: State, x: jax.Array,
+              ctx: LayerContext) -> Tuple[jax.Array, State]:
+        x = apply_input_dropout(self, x, ctx)
+        out = _run_user_graph(self.fn, x, {})
+        return out, state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class SameDiffLayer(Layer):
+    """Parameterized custom layer (reference: SameDiffLayer).
+
+    * ``param_shapes``: name -> shape (reference: defineParameters +
+      SDLayerParams.addWeightParam)
+    * ``define_layer(sd, x, params) -> SDVariable``: the forward, built
+      from SameDiff ops on the ``sd`` handle; params arrive as SDVariables
+      keyed by name. A plain-jnp ``define_layer(x, params)`` (no sd arg,
+      by arity) is also accepted.
+    * ``n_out``: declared output size (shape inference).
+    """
+
+    param_shapes: Optional[Dict[str, Tuple[int, ...]]] = None
+    define_layer: Optional[Callable] = None
+    n_out: int = 0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return FeedForwardType(size=self.n_out) if self.n_out else input_type
+
+    def has_params(self) -> bool:
+        return bool(self.param_shapes)
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return tuple(self.param_shapes or ())
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        out: Params = {}
+        shapes = self.param_shapes or {}
+        keys = jax.random.split(key, max(1, len(shapes)))
+        for k, (name, shape) in zip(keys, sorted(shapes.items())):
+            if len(shape) >= 2:
+                out[name] = init_weights(
+                    k, tuple(shape), self.weight_init or WeightInit.XAVIER,
+                    fan_in=shape[-2], fan_out=shape[-1],
+                    distribution=self.weight_init_distribution, dtype=dtype)
+            else:  # vectors (biases) start at bias_init
+                out[name] = jnp.full(tuple(shape), self.bias_init, dtype)
+        return out
+
+    def apply(self, params: Params, state: State, x: jax.Array,
+              ctx: LayerContext) -> Tuple[jax.Array, State]:
+        x = apply_input_dropout(self, x, ctx)
+        out = _run_user_graph(self.define_layer, x, params)
+        return out, state
+
+
+def _run_user_graph(fn: Callable, x: jax.Array, params: Params) -> jax.Array:
+    """Dispatch by arity: SameDiff-graph builders get (sd, x[, params]),
+    plain jnp functions get (x[, params]). Both run inside the outer jit
+    trace, compiling into the model's single XLA program."""
+    import inspect
+
+    if fn is None:
+        raise ValueError("SameDiffLayer needs define_layer/fn")
+    n_args = len(inspect.signature(fn).parameters)
+    takes_params = bool(params)
+    if n_args == (3 if takes_params else 2):
+        from ...samediff.samediff import SameDiff
+
+        sd = SameDiff.create()
+        xv = sd.placeholder("input")
+        pvars = {k: sd.placeholder(f"param_{k}") for k in params}
+        out_var = fn(sd, xv, pvars) if takes_params else fn(sd, xv)
+        feeds = {"input": x}
+        feeds.update({f"param_{k}": v for k, v in params.items()})
+        res = sd._eval_graph(feeds, dict(sd._values), [out_var.name])
+        return res[out_var.name]
+    return fn(x, params) if takes_params else fn(x)

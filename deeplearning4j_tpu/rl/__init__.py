@@ -9,9 +9,12 @@ environment loop stays host-side (tiny, sequential by nature).
 from .mdp import MDP, CartPole, StepReply
 from .replay import ExpReplay, Transition
 from .policy import EpsGreedyPolicy, GreedyPolicy
+from .a3c import A3CConfiguration, A3CDiscreteDense
 from .dqn import QLearningConfiguration, QLearningDiscreteDense
 
 __all__ = [
+    "A3CConfiguration",
+    "A3CDiscreteDense",
     "CartPole",
     "EpsGreedyPolicy",
     "ExpReplay",

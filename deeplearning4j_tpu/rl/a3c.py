@@ -1,0 +1,196 @@
+"""A3C — advantage actor-critic (reference: rl4j A3CDiscrete/
+A3CDiscreteDense + AsyncNStepQLearning's worker machinery, SURVEY.md §2.2
+"RL4J").
+
+TPU design note (same stance as the hogwild Word2Vec and
+ThresholdCompressedSync divergence docs): the reference's "async" is N CPU
+worker threads with local nets racing updates into a global param store —
+a scheme built for many weak cores. On one strong accelerator the
+equivalent work batches: N environment "workers" step in lockstep, their
+observations stack into one policy/value forward, and the n-step
+advantage-actor-critic update is ONE jitted program (policy gradient +
+entropy bonus + value MSE). Objective and hyperparameter vocabulary follow
+the reference; the execution schedule is synchronous (A2C) by design.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .mdp import MDP
+
+
+@dataclasses.dataclass
+class A3CConfiguration:
+    """Reference vocabulary: A3CConfiguration(seed, maxEpochStep, maxStep,
+    numThread, nstep, gamma, ...)."""
+
+    seed: int = 123
+    max_epoch_step: int = 500
+    max_step: int = 20000
+    num_threads: int = 8          # reference numThread -> batched workers
+    n_step: int = 16
+    gamma: float = 0.99
+    learning_rate: float = 1e-3
+    entropy_coef: float = 0.01
+    value_coef: float = 0.5
+    hidden: tuple = (64, 64)
+
+
+class A3CDiscreteDense:
+    """Dense-observation discrete-action A3C (reference:
+    A3CDiscreteDense). ``train()`` runs batched synchronous workers;
+    ``get_policy()`` returns the greedy softmax policy."""
+
+    def __init__(self, mdp_factory: Callable[[], MDP],
+                 conf: Optional[A3CConfiguration] = None) -> None:
+        self.conf = conf or A3CConfiguration()
+        c = self.conf
+        self.envs: List[MDP] = [mdp_factory() for _ in range(c.num_threads)]
+        probe = self.envs[0]
+        self.obs_size = probe.observation_size
+        self.n_actions = probe.action_size
+
+        # shared trunk with policy + value heads, as one param pytree
+        rng = np.random.RandomState(c.seed)
+        key = jax.random.PRNGKey(c.seed)
+        sizes = (self.obs_size,) + tuple(c.hidden)
+        params = {}
+        for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+            key, k = jax.random.split(key)
+            params[f"W{i}"] = (jax.random.normal(k, (a, b), jnp.float32)
+                               * np.sqrt(2.0 / a))
+            params[f"b{i}"] = jnp.zeros(b, jnp.float32)
+        key, k1, k2 = jax.random.split(key, 3)
+        h = sizes[-1]
+        params["Wp"] = jax.random.normal(k1, (h, self.n_actions)) * 0.01
+        params["bp"] = jnp.zeros(self.n_actions, jnp.float32)
+        params["Wv"] = jax.random.normal(k2, (h, 1)) * 0.01
+        params["bv"] = jnp.zeros(1, jnp.float32)
+        self.params = params
+        self.opt = optax.adam(c.learning_rate)
+        self.opt_state = self.opt.init(params)
+        self.episode_rewards: List[float] = []
+        self._rng = rng
+        self._fwd_jit = jax.jit(self._forward)
+        self._update_jit = jax.jit(self._update)
+
+    # --- the jitted pieces --------------------------------------------
+
+    def _forward(self, params, obs):
+        h = obs
+        i = 0
+        while f"W{i}" in params:
+            h = jax.nn.relu(h @ params[f"W{i}"] + params[f"b{i}"])
+            i += 1
+        logits = h @ params["Wp"] + params["bp"]
+        value = (h @ params["Wv"] + params["bv"])[:, 0]
+        return logits, value
+
+    def _update(self, params, opt_state, obs, actions, returns):
+        c = self.conf
+
+        def loss_fn(p):
+            logits, value = self._forward(p, obs)
+            logp = jax.nn.log_softmax(logits)
+            probs = jnp.exp(logp)
+            adv = returns - value
+            # batch-normalized advantages: the synchronous batch replaces
+            # the reference's per-thread updates, whose implicit staggering
+            # kept early huge advantages from saturating the policy
+            adv_n = jax.lax.stop_gradient(
+                (adv - jnp.mean(adv)) / (jnp.std(adv) + 1e-8))
+            chosen = jnp.take_along_axis(logp, actions[:, None], axis=1)[:, 0]
+            policy_loss = -jnp.mean(chosen * adv_n)
+            entropy = -jnp.mean(jnp.sum(probs * logp, axis=1))
+            value_loss = jnp.mean(adv ** 2)
+            return (policy_loss + c.value_coef * value_loss
+                    - c.entropy_coef * entropy)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self.opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # --- environment interaction --------------------------------------
+
+    def _act(self, obs_batch: np.ndarray) -> np.ndarray:
+        logits, _ = self._fwd_jit(self.params, jnp.asarray(obs_batch))
+        probs = np.asarray(jax.nn.softmax(logits))
+        return np.asarray([
+            self._rng.choice(self.n_actions, p=probs[i] / probs[i].sum())
+            for i in range(len(probs))
+        ])
+
+    def train(self, on_episode_end: Optional[Callable[[int, float], None]]
+              = None) -> "A3CDiscreteDense":
+        c = self.conf
+        obs = np.stack([e.reset() for e in self.envs]).astype(np.float32)
+        ep_reward = np.zeros(len(self.envs))
+        steps = 0
+        episode = 0
+        while steps < c.max_step:
+            # n-step rollout across all workers, in lockstep
+            roll_obs, roll_act, roll_rew, roll_done = [], [], [], []
+            for _ in range(c.n_step):
+                actions = self._act(obs)
+                next_obs = np.empty_like(obs)
+                rewards = np.zeros(len(self.envs), np.float32)
+                dones = np.zeros(len(self.envs), np.float32)
+                for i, env in enumerate(self.envs):
+                    reply = env.step(int(actions[i]))
+                    rewards[i] = reply.reward
+                    ep_reward[i] += reply.reward
+                    if reply.done:
+                        dones[i] = 1.0
+                        self.episode_rewards.append(float(ep_reward[i]))
+                        if on_episode_end:
+                            on_episode_end(episode, float(ep_reward[i]))
+                        episode += 1
+                        ep_reward[i] = 0.0
+                        next_obs[i] = env.reset()
+                    else:
+                        next_obs[i] = reply.observation
+                roll_obs.append(obs.copy())
+                roll_act.append(actions)
+                roll_rew.append(rewards)
+                roll_done.append(dones)
+                obs = next_obs.astype(np.float32)
+                steps += len(self.envs)
+
+            # n-step discounted returns bootstrapped from V(s_{t+n})
+            _, boot = self._fwd_jit(self.params, jnp.asarray(obs))
+            ret = np.asarray(boot, np.float32)
+            returns = []
+            for t in reversed(range(len(roll_rew))):
+                ret = roll_rew[t] + c.gamma * ret * (1.0 - roll_done[t])
+                returns.append(ret.copy())
+            returns.reverse()
+
+            flat_obs = np.concatenate(roll_obs)
+            flat_act = np.concatenate(roll_act).astype(np.int32)
+            flat_ret = np.concatenate(returns).astype(np.float32)
+            self.params, self.opt_state, _ = self._update_jit(
+                self.params, self.opt_state, jnp.asarray(flat_obs),
+                jnp.asarray(flat_act), jnp.asarray(flat_ret))
+        return self
+
+    # --- reference API surface ----------------------------------------
+
+    def get_policy(self):
+        fwd = self._fwd_jit
+        params = self.params
+
+        class _Policy:
+            def next_action(self, observation: np.ndarray) -> int:
+                logits, _ = fwd(params, jnp.asarray(
+                    observation[None], jnp.float32))
+                return int(np.argmax(np.asarray(logits)[0]))
+
+        return _Policy()

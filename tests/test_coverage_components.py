@@ -1,0 +1,227 @@
+"""Coverage-gap components (VERDICT.md round 3 missing 6-9): dataset
+fetchers (CIFAR-10/EMNIST shapes), GloVe, ParagraphVectors, the
+SameDiffLayer escape hatch, and A3C."""
+
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# fetchers
+# ---------------------------------------------------------------------------
+
+def test_cifar10_iterator_shapes_and_determinism():
+    from deeplearning4j_tpu.data.fetchers import Cifar10DataSetIterator
+
+    it = Cifar10DataSetIterator(32, train=True, num_examples=128, shuffle=False)
+    ds = next(iter(it))
+    assert ds.features.shape == (32, 3, 32, 32)
+    assert ds.labels.shape == (32, 10)
+    assert 0.0 <= float(np.min(ds.features)) and float(np.max(ds.features)) <= 1.0
+    it2 = Cifar10DataSetIterator(32, train=True, num_examples=128, shuffle=False)
+    np.testing.assert_array_equal(ds.features, next(iter(it2)).features)
+    # test split differs from train
+    te = next(iter(Cifar10DataSetIterator(32, train=False, num_examples=64,
+                                          shuffle=False)))
+    assert not np.array_equal(ds.features[:32], te.features[:32])
+
+
+def test_cifar10_is_learnable():
+    from deeplearning4j_tpu.data.fetchers import Cifar10DataSetIterator
+    from deeplearning4j_tpu.nn import (
+        Activation, InputType, LossFunction, NeuralNetConfiguration, WeightInit,
+    )
+    from deeplearning4j_tpu.nn.layers import (
+        ConvolutionLayer, GlobalPoolingLayer, OutputLayer, PoolingType,
+    )
+    from deeplearning4j_tpu.nn.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(3e-3))
+            .weight_init(WeightInit.XAVIER).list()
+            .layer(ConvolutionLayer(n_out=12, kernel_size=(3, 3),
+                                    activation=Activation.RELU))
+            .layer(GlobalPoolingLayer(pooling_type=PoolingType.AVG))
+            .layer(OutputLayer(n_out=10, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.convolutional(32, 32, 3)).build())
+    net = MultiLayerNetwork(conf).init()
+    it = Cifar10DataSetIterator(64, train=True, num_examples=512)
+    net.fit(it, epochs=20)
+    ev = net.evaluate(Cifar10DataSetIterator(64, train=True, num_examples=512,
+                                             shuffle=False))
+    assert ev.accuracy() > 0.35  # 10-class chance is 0.1
+
+
+def test_emnist_splits():
+    from deeplearning4j_tpu.data.fetchers import EmnistDataSetIterator
+
+    it = EmnistDataSetIterator("letters", 16, num_examples=64)
+    ds = next(iter(it))
+    assert ds.features.shape == (16, 784)
+    assert ds.labels.shape == (16, 26)
+    it2 = EmnistDataSetIterator("balanced", 8, num_examples=32)
+    assert next(iter(it2)).labels.shape == (8, 47)
+    with pytest.raises(ValueError, match="unknown EMNIST split"):
+        EmnistDataSetIterator("nope", 8)
+
+
+# ---------------------------------------------------------------------------
+# GloVe / ParagraphVectors
+# ---------------------------------------------------------------------------
+
+def _corpus(n=300, seed=0):
+    """Two topic clusters; co-occurrence should pull topic words together."""
+    rng = np.random.RandomState(seed)
+    animals = ["cat", "dog", "horse", "sheep", "goat"]
+    tech = ["cpu", "gpu", "tpu", "ram", "disk"]
+    sents = []
+    for _ in range(n):
+        pool = animals if rng.rand() < 0.5 else tech
+        sents.append([pool[rng.randint(5)] for _ in range(rng.randint(4, 9))])
+    return sents, animals, tech
+
+
+def test_glove_trains_and_clusters():
+    from deeplearning4j_tpu.nlp import Glove
+
+    sents, animals, tech = _corpus()
+    g = Glove(vector_size=16, window=3, min_count=1, epochs=12,
+              batch_size=256, seed=1)
+    g.fit(sents)
+    assert g.has_word("cat") and g.get_word_vector("cat").shape == (16,)
+    within = np.mean([g.similarity("cat", w) for w in animals if w != "cat"])
+    across = np.mean([g.similarity("cat", w) for w in tech])
+    assert within > across, f"within={within:.3f} across={across:.3f}"
+    assert "cat" not in g.words_nearest("cat", 3)
+
+
+def test_paragraph_vectors_fit_and_infer():
+    from deeplearning4j_tpu.nlp import LabelledDocument, ParagraphVectors
+
+    sents, animals, tech = _corpus(200)
+    docs = [LabelledDocument(s, f"doc_{i}") for i, s in enumerate(sents)]
+    pv = ParagraphVectors(vector_size=16, min_count=1, epochs=60,
+                          learning_rate=5.0, batch_size=256, seed=2)
+    pv.fit(docs)
+    assert pv.get_doc_vector("doc_0").shape == (16,)
+    # an inferred vector for an animal-topic doc should land nearer animal
+    # docs than tech docs on average
+    vec = pv.infer_vector(["cat", "dog", "horse", "cat"])
+    assert vec.shape == (16,) and np.isfinite(vec).all()
+    near = pv.nearest_labels(["cat", "dog", "horse", "cat"], n=10)
+    animal_docs = {f"doc_{i}" for i, s in enumerate(sents)
+                   if s[0] in animals}
+    hits = sum(1 for l in near if l in animal_docs)
+    assert hits >= 6, f"only {hits}/10 nearest docs share the topic"
+    assert "doc_0" not in pv.nearest_labels("doc_0", 3)
+
+
+# ---------------------------------------------------------------------------
+# SameDiffLayer escape hatch
+# ---------------------------------------------------------------------------
+
+def test_samediff_lambda_layer_in_sequential():
+    from deeplearning4j_tpu.nn import (
+        Activation, InputType, LossFunction, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.layers import (
+        DenseLayer, OutputLayer, SameDiffLambdaLayer,
+    )
+    from deeplearning4j_tpu.nn.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.train.updaters import Sgd
+
+    def double_it(sd, x):  # SameDiff-graph spelling
+        return sd._op("mul", x, sd.constant(np.float32(2.0)))
+
+    conf = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=8, activation=Activation.TANH))
+            .layer(SameDiffLambdaLayer(fn=double_it))
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(6)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 6).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 4)]
+    out = np.asarray(net.output(x))
+    assert out.shape == (4, 3)
+    losses = [float(net.fit(x, y, epochs=1).score_value) for _ in range(15)]
+    assert losses[-1] < losses[0]  # trains THROUGH the custom op
+
+
+def test_samediff_layer_with_params_gradient_flow():
+    from deeplearning4j_tpu.nn import (
+        Activation, InputType, LossFunction, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.layers import OutputLayer, SameDiffLayer
+    from deeplearning4j_tpu.nn.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.train.updaters import Sgd
+
+    def custom_dense(sd, x, params):  # reference defineLayer idiom
+        y = sd._op("matmul", x, params["W"])
+        return sd._op("tanh", sd._op("add", y, params["b"]))
+
+    layer = SameDiffLayer(
+        param_shapes={"W": (5, 7), "b": (7,)},
+        define_layer=custom_dense, n_out=7)
+    conf = (NeuralNetConfiguration.builder().seed(4).updater(Sgd(0.2)).list()
+            .layer(layer)
+            .layer(OutputLayer(n_out=2, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(5)).build())
+    net = MultiLayerNetwork(conf).init()
+    assert net.params["layer_0"]["W"].shape == (5, 7)
+    w_before = np.asarray(net.params["layer_0"]["W"]).copy()
+    rng = np.random.RandomState(1)
+    x = rng.randn(8, 5).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 8)]
+    for _ in range(10):
+        net.fit(x, y, epochs=1)
+    assert not np.allclose(w_before, np.asarray(net.params["layer_0"]["W"]))
+
+
+def test_samediff_lambda_plain_jnp_spelling():
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn import (
+        Activation, InputType, LossFunction, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.layers import OutputLayer, SameDiffLambdaLayer
+    from deeplearning4j_tpu.nn.sequential import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(5).list()
+            .layer(SameDiffLambdaLayer(fn=lambda x: jnp.tanh(x) * 3.0))
+            .layer(OutputLayer(n_out=2, loss=LossFunction.MSE,
+                               activation=Activation.IDENTITY))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    out = net.output(np.ones((2, 4), np.float32))
+    assert out.shape == (2, 2)
+
+
+# ---------------------------------------------------------------------------
+# A3C
+# ---------------------------------------------------------------------------
+
+def test_a3c_cartpole_improves():
+    from deeplearning4j_tpu.rl import A3CConfiguration, A3CDiscreteDense, CartPole
+
+    conf = A3CConfiguration(seed=7, num_threads=8, n_step=16,
+                            max_step=16000, learning_rate=1e-3,
+                            entropy_coef=0.01, hidden=(32, 32))
+    a3c = A3CDiscreteDense(lambda: CartPole(max_steps=200, seed=7), conf)
+    a3c.train()
+    rewards = np.asarray(a3c.episode_rewards)
+    assert len(rewards) >= 10
+    # RL learning curves are noisy; assert the robust signals: the second
+    # half of training out-earns the first, and peak episodes far exceed
+    # the untrained baseline (~14 steps on this seed)
+    half = len(rewards) // 2
+    assert rewards[half:].mean() > rewards[:half].mean(), (
+        f"no improvement: {rewards[:half].mean():.1f} -> "
+        f"{rewards[half:].mean():.1f}")
+    assert np.sort(rewards)[-10:].mean() > 45, (
+        f"best episodes never took off: {np.sort(rewards)[-10:].mean():.1f}")
+    policy = a3c.get_policy()
+    assert policy.next_action(CartPole(seed=1).reset()) in (0, 1)
