@@ -265,6 +265,17 @@ class SameDiff:
     def _op(self, op: str, *inputs: SDVariable, name: Optional[str] = None, **attrs) -> Union[SDVariable, Tuple[SDVariable, ...]]:
         if op not in self._STRUCTURAL_OPS:
             get_sd_op(op)  # validate early
+        for v in inputs:
+            if v.sd is not self:
+                # node ids are per-graph; a foreign id would silently bind
+                # to an unrelated node (classic footgun: outer-graph vars
+                # inside a while_loop/cond subgraph builder)
+                raise ValueError(
+                    f"{op}: input {v.name!r} belongs to a different SameDiff "
+                    "graph. Control-flow subgraphs are closed: thread outer "
+                    "values through loop_vars/operands, or recreate "
+                    "constants on the subgraph handle."
+                )
         node = self._new_node(name, "op", op=op, inputs=tuple(v.node.id for v in inputs),
                               attrs=attrs)
         # multi-output ops (split/unstack/svd/qr) produce view nodes lazily via
@@ -586,10 +597,9 @@ def _sd_to_dict(sd: SameDiff) -> Dict[str, Any]:
             for n in sd._nodes.values()
         ],
         "loss": sd._loss_name,
-        "values": {
-            str(nid): {"data": np.asarray(v).tolist(), "dtype": str(np.asarray(v).dtype)}
-            for nid, v in sd._values.items()
-        },
+        # binary npz in base64 (~1.33x raw bytes) — loop bodies can carry
+        # weight-sized constants, which JSON float lists would blow up ~10x
+        "values_npz_b64": _values_to_b64(sd._values),
     }
 
 
@@ -606,9 +616,27 @@ def _sd_from_dict(d: Dict[str, Any]) -> SameDiff:
         sd._nodes[node.id] = node
         sd._names[node.name] = node.id
         sd._next_id = max(sd._next_id, node.id + 1)
-    sd._values = {
-        int(k): jnp.asarray(np.array(v["data"], dtype=v["dtype"]))
-        for k, v in d.get("values", {}).items()
-    }
+    if "values_npz_b64" in d:
+        sd._values = _values_from_b64(d["values_npz_b64"])
+    else:  # graphs saved by earlier revisions used inline JSON lists
+        sd._values = {
+            int(k): jnp.asarray(np.array(v["data"], dtype=v["dtype"]))
+            for k, v in d.get("values", {}).items()
+        }
     sd._loss_name = d.get("loss")
     return sd
+
+
+def _values_to_b64(values: Dict[int, Any]) -> str:
+    import base64
+
+    buf = io.BytesIO()
+    np.savez(buf, **{str(nid): np.asarray(v) for nid, v in values.items()})
+    return base64.b64encode(buf.getvalue()).decode("ascii")
+
+
+def _values_from_b64(payload: str) -> Dict[int, Any]:
+    import base64
+
+    z = np.load(io.BytesIO(base64.b64decode(payload)))
+    return {int(k): jnp.asarray(z[k]) for k in z.files}
