@@ -582,3 +582,7 @@ def _random_uniform(shape=None, minval=0.0, maxval=1.0, rng=None, dtype=jnp.floa
 @sd_op("random_bernoulli")
 def _random_bernoulli(shape=None, p=0.5, rng=None):
     return jax.random.bernoulli(rng, p, [int(s) for s in shape]).astype(jnp.float32)
+
+
+# the extended op families register themselves on import
+from . import ops_extended  # noqa: E402,F401  (SURVEY §2.1 op breadth)

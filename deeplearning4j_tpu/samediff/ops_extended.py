@@ -1,0 +1,605 @@
+"""Extended SameDiff op families.
+
+Reference: the long tail of libnd4j declarable ops / nd4j op classes
+(SURVEY.md §2.1 "Declarable ops (~500)", §2.2 "op class hierarchy") beyond
+the core closure in ops.py: special functions, extended reductions and
+index accumulations, segment ops, sorting/top-k, spatial rearrangement,
+conv1d/3d + transpose conv + pooling variants, cell-level RNN primitives,
+color-space transforms, the full loss family, extended linalg, random
+distributions, and numeric hygiene ops (clip-by-norm family, moments).
+
+Same registration contract as ops.py: jnp-thin pure functions in SD_OPS —
+XLA fuses; nothing here owns a kernel. Ops whose reference semantics need
+dynamic output shapes (unique, where-without-branches) take the XLA-honest
+form: static ``k``/``num_segments``/size attrs, as the TPU compilation
+model requires (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .ops import sd_op
+
+# ---- special functions -----------------------------------------------------
+sd_op("erfinv")(jax.scipy.special.erfinv)
+sd_op("lgamma")(jax.scipy.special.gammaln)
+sd_op("digamma")(jax.scipy.special.digamma)
+sd_op("betainc")(jax.scipy.special.betainc)
+sd_op("igamma")(jax.scipy.special.gammainc)
+sd_op("igammac")(jax.scipy.special.gammaincc)
+sd_op("log_sigmoid")(jax.nn.log_sigmoid)
+sd_op("exp2")(jnp.exp2)
+sd_op("log10")(jnp.log10)
+sd_op("rint")(jnp.rint)
+sd_op("trunc")(jnp.trunc)
+sd_op("frac")(lambda x: x - jnp.trunc(x))
+sd_op("fmod")(jnp.fmod)
+sd_op("hypot")(jnp.hypot)
+sd_op("logaddexp")(jnp.logaddexp)
+sd_op("xlogy")(lambda x, y: jnp.where(x == 0.0, 0.0, x * jnp.log(y)))
+sd_op("xdivy")(lambda x, y: jnp.where(x == 0.0, 0.0, x / y))
+sd_op("lerp")(lambda a, b, w=0.5: a + w * (b - a))
+sd_op("logit")(lambda x, eps=1e-7: jnp.log(jnp.clip(x, eps, 1 - eps)
+                                           / (1 - jnp.clip(x, eps, 1 - eps))))
+sd_op("safe_divide")(lambda a, b: jnp.where(b == 0.0, 0.0, a / b))
+sd_op("nan_to_num")(lambda x, nan=0.0, posinf=None, neginf=None:
+                    jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf))
+sd_op("replace_nans")(lambda x, value=0.0: jnp.nan_to_num(x, nan=value))
+
+# ---- extended reductions / index accumulations -----------------------------
+sd_op("logsumexp")(lambda x, axis=None, keepdims=False:
+                   jax.scipy.special.logsumexp(
+                       x, axis=None if axis is None else tuple(
+                           int(a) for a in np.atleast_1d(axis)),
+                       keepdims=bool(keepdims)))
+sd_op("reduce_median")(lambda x, axis=None, keepdims=False:
+                       jnp.median(x, axis=None if axis is None else tuple(
+                           int(a) for a in np.atleast_1d(axis)),
+                           keepdims=bool(keepdims)))
+sd_op("percentile")(lambda x, q=50.0, axis=None:
+                    jnp.percentile(x, q, axis=None if axis is None else tuple(
+                        int(a) for a in np.atleast_1d(axis))))
+sd_op("count_nonzero")(lambda x, axis=None:
+                       jnp.count_nonzero(x, axis=None if axis is None else
+                                         tuple(int(a) for a in np.atleast_1d(axis))))
+sd_op("count_zero")(lambda x, axis=None:
+                    jnp.sum(x == 0, axis=None if axis is None else
+                            tuple(int(a) for a in np.atleast_1d(axis))))
+sd_op("iamax")(lambda x, axis=-1: jnp.argmax(jnp.abs(x), axis=int(axis)))
+sd_op("iamin")(lambda x, axis=-1: jnp.argmin(jnp.abs(x), axis=int(axis)))
+sd_op("amax")(lambda x, axis=None, keepdims=False:
+              jnp.max(jnp.abs(x), axis=None if axis is None else tuple(
+                  int(a) for a in np.atleast_1d(axis)), keepdims=keepdims))
+sd_op("amin")(lambda x, axis=None, keepdims=False:
+              jnp.min(jnp.abs(x), axis=None if axis is None else tuple(
+                  int(a) for a in np.atleast_1d(axis)), keepdims=keepdims))
+sd_op("amean")(lambda x, axis=None, keepdims=False:
+               jnp.mean(jnp.abs(x), axis=None if axis is None else tuple(
+                   int(a) for a in np.atleast_1d(axis)), keepdims=keepdims))
+sd_op("asum")(lambda x, axis=None, keepdims=False:
+              jnp.sum(jnp.abs(x), axis=None if axis is None else tuple(
+                  int(a) for a in np.atleast_1d(axis)), keepdims=keepdims))
+
+
+@sd_op("entropy")
+def _entropy(x, axis=None):
+    ax = None if axis is None else tuple(int(a) for a in np.atleast_1d(axis))
+    return -jnp.sum(x * jnp.log(jnp.clip(x, 1e-12, None)), axis=ax)
+
+
+@sd_op("shannon_entropy")
+def _shannon_entropy(x, axis=None):
+    ax = None if axis is None else tuple(int(a) for a in np.atleast_1d(axis))
+    return -jnp.sum(x * jnp.log2(jnp.clip(x, 1e-12, None)), axis=ax)
+
+
+sd_op("log_entropy")(lambda x, axis=None: jnp.log(_entropy(x, axis)))
+sd_op("squared_norm")(lambda x, axis=None, keepdims=False:
+                      jnp.sum(jnp.square(x), axis=None if axis is None else
+                              tuple(int(a) for a in np.atleast_1d(axis)),
+                              keepdims=keepdims))
+
+
+@sd_op("moments")
+def _moments(x, axis=None, keepdims=False):
+    ax = None if axis is None else tuple(int(a) for a in np.atleast_1d(axis))
+    mean = jnp.mean(x, axis=ax, keepdims=keepdims)
+    var = jnp.var(x, axis=ax, keepdims=keepdims)
+    return mean, var
+
+
+@sd_op("normalize_moments")
+def _normalize_moments(counts, mean_ss, variance_ss, shift=0.0):
+    mean = mean_ss / counts + shift
+    variance = variance_ss / counts - jnp.square(mean_ss / counts)
+    return mean, variance
+
+
+@sd_op("standardize")
+def _standardize(x, axis=-1, eps=1e-8):
+    ax = tuple(int(a) for a in np.atleast_1d(axis))
+    mean = jnp.mean(x, axis=ax, keepdims=True)
+    std = jnp.std(x, axis=ax, keepdims=True)
+    return (x - mean) / (std + eps)
+
+
+@sd_op("confusion_matrix")
+def _confusion_matrix(labels, predictions, num_classes=None, weights=None):
+    n = int(num_classes)
+    idx = labels.astype(jnp.int32) * n + predictions.astype(jnp.int32)
+    w = jnp.ones_like(idx, jnp.float32) if weights is None else weights
+    return jnp.zeros(n * n, w.dtype).at[idx].add(w).reshape(n, n)
+
+
+# ---- segment ops -----------------------------------------------------------
+def _seg(reducer):
+    def op(data, segment_ids, num_segments=None):
+        return reducer(data, segment_ids.astype(jnp.int32),
+                       num_segments=int(num_segments))
+
+    return op
+
+
+sd_op("segment_sum")(_seg(jax.ops.segment_sum))
+sd_op("segment_prod")(_seg(jax.ops.segment_prod))
+sd_op("segment_max")(_seg(jax.ops.segment_max))
+sd_op("segment_min")(_seg(jax.ops.segment_min))
+sd_op("unsorted_segment_sum")(_seg(jax.ops.segment_sum))
+sd_op("unsorted_segment_prod")(_seg(jax.ops.segment_prod))
+sd_op("unsorted_segment_max")(_seg(jax.ops.segment_max))
+sd_op("unsorted_segment_min")(_seg(jax.ops.segment_min))
+
+
+@sd_op("segment_mean")
+def _segment_mean(data, segment_ids, num_segments=None):
+    ids = segment_ids.astype(jnp.int32)
+    n = int(num_segments)
+    s = jax.ops.segment_sum(data, ids, num_segments=n)
+    c = jax.ops.segment_sum(jnp.ones_like(data), ids, num_segments=n)
+    return s / jnp.maximum(c, 1.0)
+
+
+sd_op("unsorted_segment_mean")(_segment_mean)
+
+
+# ---- scatter family (completing update/add from ops.py) --------------------
+sd_op("scatter_sub")(lambda ref, indices, updates:
+                     ref.at[indices.astype(jnp.int32)].add(-updates))
+sd_op("scatter_mul")(lambda ref, indices, updates:
+                     ref.at[indices.astype(jnp.int32)].multiply(updates))
+sd_op("scatter_div")(lambda ref, indices, updates:
+                     ref.at[indices.astype(jnp.int32)].divide(updates))
+sd_op("scatter_max")(lambda ref, indices, updates:
+                     ref.at[indices.astype(jnp.int32)].max(updates))
+sd_op("scatter_min")(lambda ref, indices, updates:
+                     ref.at[indices.astype(jnp.int32)].min(updates))
+
+
+# ---- sorting / top-k -------------------------------------------------------
+sd_op("sort")(lambda x, axis=-1, descending=False:
+              -jnp.sort(-x, axis=int(axis)) if descending
+              else jnp.sort(x, axis=int(axis)))
+sd_op("argsort")(lambda x, axis=-1, descending=False:
+                 jnp.argsort(-x, axis=int(axis)) if descending
+                 else jnp.argsort(x, axis=int(axis)))
+
+
+@sd_op("top_k")
+def _top_k(x, k=1, sorted=True):
+    values, indices = lax.top_k(x, int(k))
+    return values, indices
+
+
+@sd_op("in_top_k")
+def _in_top_k(predictions, targets, k=1):
+    _, idx = lax.top_k(predictions, int(k))
+    return jnp.any(idx == targets.astype(idx.dtype)[:, None], axis=-1)
+
+
+@sd_op("unique_with_counts_padded")
+def _unique_padded(x, size=None):
+    """XLA-honest unique: fixed ``size`` output padded with the first value
+    (the reference's dynamic-shape unique cannot compile on TPU)."""
+    vals, counts = jnp.unique(x, return_counts=True, size=int(size))
+    return vals, counts
+
+
+# ---- spatial rearrangement -------------------------------------------------
+@sd_op("space_to_depth")
+def _space_to_depth(x, block_size=2, data_format="NHWC"):
+    b = int(block_size)
+    if str(data_format).upper() == "NHWC":
+        n, h, w, c = x.shape
+        x = x.reshape(n, h // b, b, w // b, b, c)
+        return x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // b, w // b, c * b * b)
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    return x.transpose(0, 3, 5, 1, 2, 4).reshape(n, c * b * b, h // b, w // b)
+
+
+@sd_op("depth_to_space")
+def _depth_to_space(x, block_size=2, data_format="NHWC"):
+    b = int(block_size)
+    if str(data_format).upper() == "NHWC":
+        n, h, w, c = x.shape
+        x = x.reshape(n, h, w, b, b, c // (b * b))
+        return x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h * b, w * b, c // (b * b))
+    n, c, h, w = x.shape
+    x = x.reshape(n, b, b, c // (b * b), h, w)
+    return x.transpose(0, 3, 4, 1, 5, 2).reshape(n, c // (b * b), h * b, w * b)
+
+
+@sd_op("batch_to_space")
+def _batch_to_space(x, block_shape=None, crops=None):
+    bs = [int(v) for v in block_shape]
+    crops = [(int(a), int(b)) for a, b in (crops or [(0, 0)] * len(bs))]
+    n = x.shape[0] // int(np.prod(bs))
+    spatial = x.shape[1:1 + len(bs)]
+    rest = x.shape[1 + len(bs):]
+    t = x.reshape(tuple(bs) + (n,) + spatial + rest)
+    perm = [len(bs)]
+    for i in range(len(bs)):
+        perm += [len(bs) + 1 + i, i]
+    perm += list(range(2 * len(bs) + 1, t.ndim))
+    t = t.transpose(perm)
+    out_spatial = tuple(s * b for s, b in zip(spatial, bs))
+    t = t.reshape((n,) + out_spatial + rest)
+    slices = [slice(None)] + [slice(c0, dim - c1) for (c0, c1), dim in
+                              zip(crops, out_spatial)] + [slice(None)] * len(rest)
+    return t[tuple(slices)]
+
+
+@sd_op("space_to_batch")
+def _space_to_batch(x, block_shape=None, paddings=None):
+    bs = [int(v) for v in block_shape]
+    pads = [(int(a), int(b)) for a, b in (paddings or [(0, 0)] * len(bs))]
+    full_pads = [(0, 0)] + pads + [(0, 0)] * (x.ndim - 1 - len(bs))
+    x = jnp.pad(x, full_pads)
+    n = x.shape[0]
+    spatial = x.shape[1:1 + len(bs)]
+    rest = x.shape[1 + len(bs):]
+    shape = (n,)
+    for s, b in zip(spatial, bs):
+        shape += (s // b, b)
+    shape += rest
+    t = x.reshape(shape)
+    perm = []
+    for i in range(len(bs)):
+        perm.append(2 + 2 * i)
+    perm.append(0)
+    for i in range(len(bs)):
+        perm.append(1 + 2 * i)
+    perm += list(range(1 + 2 * len(bs), t.ndim))
+    t = t.transpose(perm)
+    return t.reshape((n * int(np.prod(bs)),) +
+                     tuple(s // b for s, b in zip(spatial, bs)) + rest)
+
+
+sd_op("repeat")(lambda x, repeats=1, axis=0:
+                jnp.repeat(x, int(repeats), axis=int(axis)))
+sd_op("roll")(lambda x, shift=1, axis=None:
+              jnp.roll(x, int(shift), None if axis is None else int(axis)))
+sd_op("meshgrid")(lambda *xs, indexing="xy": jnp.meshgrid(*xs, indexing=indexing))
+sd_op("linspace")(lambda start=0.0, stop=1.0, num=50:
+                  jnp.linspace(float(start), float(stop), int(num)))
+sd_op("triu")(lambda x, k=0: jnp.triu(x, int(k)))
+sd_op("tril")(lambda x, k=0: jnp.tril(x, int(k)))
+sd_op("dynamic_partition_padded")(
+    lambda data, partitions, num_partitions=2: tuple(
+        jnp.where((partitions == i)[(...,) + (None,) * (data.ndim - partitions.ndim)],
+                  data, 0)
+        for i in range(int(num_partitions))))
+
+
+@sd_op("histogram_fixed_width")
+def _histogram_fixed_width(x, value_range=None, nbins=100):
+    lo, hi = float(value_range[0]), float(value_range[1])
+    return jnp.histogram(jnp.clip(x, lo, hi), bins=int(nbins),
+                         range=(lo, hi))[0]
+
+
+@sd_op("bincount")
+def _bincount(x, minlength=0, maxlength=None, weights=None):
+    """XLA-honest bincount: output length must be static, so a positive
+    ``minlength``/``maxlength`` is REQUIRED (values >= length are dropped,
+    jnp semantics). The reference's grow-to-max(x)+1 behavior is a dynamic
+    shape and cannot compile."""
+    length = int(maxlength if maxlength else minlength)
+    if length <= 0:
+        raise ValueError(
+            "bincount needs minlength or maxlength > 0 (static output "
+            "shape); values >= length are dropped")
+    return jnp.bincount(x.astype(jnp.int32).reshape(-1),
+                        weights=None if weights is None else weights.reshape(-1),
+                        length=length)
+
+
+# ---- conv/pool variants ----------------------------------------------------
+@sd_op("conv1d")
+def _conv1d(x, w, bias=None, stride=1, padding="SAME"):
+    """x [N, W, C], w [kW, C, out] (TF conv1d convention)."""
+    y = lax.conv_general_dilated(
+        x, w, window_strides=(int(stride),), padding=str(padding).upper(),
+        dimension_numbers=("NWC", "WIO", "NWC"))
+    return y if bias is None else y + bias
+
+
+@sd_op("conv3d")
+def _conv3d(x, w, bias=None, strides=(1, 1, 1), padding="SAME"):
+    """x [N, D, H, W, C], w [kD, kH, kW, C, out] (TF conv3d NDHWC)."""
+    y = lax.conv_general_dilated(
+        x, w, window_strides=tuple(int(s) for s in strides),
+        padding=str(padding).upper(),
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    return y if bias is None else y + bias
+
+
+@sd_op("deconv2d")
+def _deconv2d(x, w, bias=None, strides=(1, 1), padding="SAME",
+              data_format="NHWC"):
+    """Transpose conv (reference: deconv2d). ``w`` is the FORWARD-conv
+    kernel [kH, kW, out, in] (TF deconv convention): the op is the
+    gradient of that conv, matching torch.conv_transpose2d semantics
+    (spatial flip included via transpose_kernel)."""
+    df = str(data_format).upper()
+    spec = "HWIO"  # I slot holds out-channels, O slot in-channels (gradient)
+    y = lax.conv_transpose(
+        x, w, strides=tuple(int(s) for s in strides),
+        padding=str(padding).upper(),
+        dimension_numbers=(df, spec, df), transpose_kernel=True)
+    return y if bias is None else (
+        y + (bias if df == "NHWC" else bias[:, None, None]))
+
+
+def _pool_nd(x, kernel, strides, padding, reducer, init, spatial_dims):
+    window = [1] * x.ndim
+    strd = [1] * x.ndim
+    for d, k, s in zip(spatial_dims, kernel, strides):
+        window[d] = int(k)
+        strd[d] = int(s)
+    return lax.reduce_window(x, init, reducer, tuple(window), tuple(strd),
+                             str(padding).upper())
+
+
+@sd_op("max_pool1d")
+def _max_pool1d(x, kernel=2, strides=2, padding="VALID"):
+    return _pool_nd(x, [kernel], [strides], padding, lax.max, -jnp.inf, [1])
+
+
+@sd_op("avg_pool1d")
+def _avg_pool1d(x, kernel=2, strides=2, padding="VALID"):
+    s = _pool_nd(x, [kernel], [strides], padding, lax.add, 0.0, [1])
+    c = _pool_nd(jnp.ones_like(x), [kernel], [strides], padding, lax.add, 0.0, [1])
+    return s / c
+
+
+@sd_op("max_pool3d")
+def _max_pool3d(x, kernel=(2, 2, 2), strides=(2, 2, 2), padding="VALID"):
+    return _pool_nd(x, kernel, strides, padding, lax.max, -jnp.inf, [1, 2, 3])
+
+
+@sd_op("avg_pool3d")
+def _avg_pool3d(x, kernel=(2, 2, 2), strides=(2, 2, 2), padding="VALID"):
+    s = _pool_nd(x, kernel, strides, padding, lax.add, 0.0, [1, 2, 3])
+    c = _pool_nd(jnp.ones_like(x), kernel, strides, padding, lax.add, 0.0,
+                 [1, 2, 3])
+    return s / c
+
+
+@sd_op("upsampling2d")
+def _upsampling2d(x, scale=2, data_format="NCHW"):
+    s = int(scale)
+    if str(data_format).upper() == "NCHW":
+        return jnp.repeat(jnp.repeat(x, s, axis=2), s, axis=3)
+    return jnp.repeat(jnp.repeat(x, s, axis=1), s, axis=2)
+
+
+@sd_op("local_response_normalization")
+def _lrn(x, depth=5, bias=1.0, alpha=1.0, beta=0.5):
+    """NHWC LRN (reference: LocalResponseNormalization)."""
+    half = int(depth) // 2
+    sq = jnp.square(x)
+    padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
+    windows = sum(padded[..., i:i + x.shape[-1]] for i in range(2 * half + 1))
+    return x / jnp.power(bias + alpha * windows, beta)
+
+
+sd_op("l2_normalize")(lambda x, axis=-1, eps=1e-12:
+                      x / jnp.sqrt(jnp.maximum(
+                          jnp.sum(jnp.square(x), axis=int(axis),
+                                  keepdims=True), eps)))
+sd_op("prelu")(lambda x, alpha: jnp.where(x >= 0, x, alpha * x))
+sd_op("thresholded_relu")(lambda x, theta=1.0: jnp.where(x > theta, x, 0.0))
+sd_op("hard_tanh")(lambda x: jnp.clip(x, -1.0, 1.0))
+sd_op("rational_tanh")(lambda x: 1.7159 * jnp.tanh(2.0 / 3.0 * x))
+sd_op("rectified_tanh")(lambda x: jnp.maximum(0.0, jnp.tanh(x)))
+
+
+# ---- cell-level RNN primitives (reference: lstmCell/gruCell ops) ----------
+@sd_op("lstm_cell")
+def _lstm_cell(x, h_prev, c_prev, W, R, b=None):
+    """One LSTM step: gates [i, f, o, g] (the framework's column order).
+    x [B, in], h/c [B, units], W [in, 4u], R [u, 4u], b [4u]."""
+    z = x @ W + h_prev @ R
+    if b is not None:
+        z = z + b
+    u = h_prev.shape[-1]
+    i, f, o, g = (z[:, :u], z[:, u:2 * u], z[:, 2 * u:3 * u], z[:, 3 * u:])
+    c = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return h, c
+
+
+@sd_op("gru_cell")
+def _gru_cell(x, h_prev, W, R, b=None):
+    """One GRU step: gates [r, z, n]. W [in, 3u], R [u, 3u], b [3u]."""
+    u = h_prev.shape[-1]
+    zx = x @ W
+    zh = h_prev @ R
+    if b is not None:
+        zx = zx + b
+    r = jax.nn.sigmoid(zx[:, :u] + zh[:, :u])
+    z = jax.nn.sigmoid(zx[:, u:2 * u] + zh[:, u:2 * u])
+    n = jnp.tanh(zx[:, 2 * u:] + r * zh[:, 2 * u:])
+    return (1 - z) * n + z * h_prev
+
+
+# ---- color space -----------------------------------------------------------
+@sd_op("rgb_to_hsv")
+def _rgb_to_hsv(x):
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx = jnp.maximum(jnp.maximum(r, g), b)
+    mn = jnp.minimum(jnp.minimum(r, g), b)
+    d = mx - mn
+    safe = jnp.where(d == 0, 1.0, d)
+    h = jnp.where(
+        mx == r, (g - b) / safe % 6.0,
+        jnp.where(mx == g, (b - r) / safe + 2.0, (r - g) / safe + 4.0)) / 6.0
+    h = jnp.where(d == 0, 0.0, h)
+    s = jnp.where(mx == 0, 0.0, d / jnp.where(mx == 0, 1.0, mx))
+    return jnp.stack([h, s, mx], axis=-1)
+
+
+@sd_op("hsv_to_rgb")
+def _hsv_to_rgb(x):
+    h, s, v = x[..., 0] * 6.0, x[..., 1], x[..., 2]
+    c = v * s
+    xx = c * (1 - jnp.abs(h % 2.0 - 1))
+    m = v - c
+    z = jnp.zeros_like(c)
+    idx = jnp.floor(h).astype(jnp.int32) % 6
+    rs = jnp.stack([c, xx, z, z, xx, c], -1)
+    gs = jnp.stack([xx, c, c, xx, z, z], -1)
+    bs = jnp.stack([z, z, xx, c, c, xx], -1)
+    pick = jax.nn.one_hot(idx, 6, dtype=x.dtype)
+    return jnp.stack([jnp.sum(rs * pick, -1) + m,
+                      jnp.sum(gs * pick, -1) + m,
+                      jnp.sum(bs * pick, -1) + m], axis=-1)
+
+
+sd_op("rgb_to_grs")(lambda x: (0.2989 * x[..., 0] + 0.587 * x[..., 1]
+                               + 0.114 * x[..., 2])[..., None])
+sd_op("rgb_to_yuv")(lambda x: jnp.stack([
+    0.299 * x[..., 0] + 0.587 * x[..., 1] + 0.114 * x[..., 2],
+    -0.14714119 * x[..., 0] - 0.28886916 * x[..., 1] + 0.43601035 * x[..., 2],
+    0.61497538 * x[..., 0] - 0.51496512 * x[..., 1] - 0.10001026 * x[..., 2],
+], axis=-1))
+@sd_op("adjust_saturation")
+def _adjust_saturation(x, factor=1.0):
+    hsv = _rgb_to_hsv(x)
+    return _hsv_to_rgb(hsv.at[..., 1].set(
+        jnp.clip(hsv[..., 1] * factor, 0.0, 1.0)))
+
+
+@sd_op("adjust_hue")
+def _adjust_hue(x, delta=0.0):
+    hsv = _rgb_to_hsv(x)
+    return _hsv_to_rgb(hsv.at[..., 0].set((hsv[..., 0] + delta) % 1.0))
+
+
+# ---- loss family -----------------------------------------------------------
+sd_op("hinge_loss")(lambda labels, logits:
+                    jnp.mean(jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits)))
+sd_op("squared_hinge_loss")(lambda labels, logits: jnp.mean(
+    jnp.square(jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits))))
+sd_op("poisson_loss")(lambda labels, predictions: jnp.mean(
+    predictions - labels * jnp.log(jnp.clip(predictions, 1e-12, None))))
+sd_op("kl_divergence")(lambda labels, predictions: jnp.sum(
+    labels * jnp.log(jnp.clip(labels, 1e-12, None)
+                     / jnp.clip(predictions, 1e-12, None)), axis=-1))
+sd_op("mean_pairwise_squared_error")(
+    lambda labels, predictions: jnp.mean(jnp.square(
+        (predictions[:, :, None] - predictions[:, None, :])
+        - (labels[:, :, None] - labels[:, None, :]))))
+sd_op("weighted_cross_entropy_with_logits")(
+    lambda labels, logits, pos_weight=1.0: jnp.mean(
+        (1 - labels) * logits
+        + (1 + (pos_weight - 1) * labels)
+        * jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        + (1 + (pos_weight - 1) * labels) * jnp.maximum(-logits, 0.0)))
+
+
+@sd_op("ctc_loss")
+def _ctc_loss(log_probs, labels, logit_lengths, label_lengths, blank_id=0):
+    """CTC (reference: ctc_loss). log_probs [B, T, C]."""
+    import optax
+
+    logit_pads = (jnp.arange(log_probs.shape[1])[None, :]
+                  >= logit_lengths[:, None]).astype(jnp.float32)
+    label_pads = (jnp.arange(labels.shape[1])[None, :]
+                  >= label_lengths[:, None]).astype(jnp.float32)
+    return optax.ctc_loss(log_probs, logit_pads, labels, label_pads,
+                          blank_id=int(blank_id))
+
+
+# ---- linalg extensions -----------------------------------------------------
+sd_op("slogdet")(lambda x: jnp.linalg.slogdet(x))
+sd_op("pinv")(jnp.linalg.pinv)
+sd_op("matrix_rank")(lambda x, tol=None: jnp.linalg.matrix_rank(x, tol))
+sd_op("kron")(jnp.kron)
+sd_op("cross")(lambda a, b, axis=-1: jnp.cross(a, b, axis=int(axis)))
+sd_op("matrix_set_diag")(lambda x, diag: x.at[
+    ..., jnp.arange(min(x.shape[-2], x.shape[-1])),
+    jnp.arange(min(x.shape[-2], x.shape[-1]))].set(diag))
+sd_op("lu")(lambda x: jax.scipy.linalg.lu(x))
+sd_op("triangular_solve")(
+    lambda a, b, lower=True: jax.scipy.linalg.solve_triangular(
+        a, b, lower=bool(lower)))
+
+
+# ---- random distributions --------------------------------------------------
+sd_op("random_gamma")(lambda shape=None, alpha=1.0, beta=1.0, rng=None:
+                      jax.random.gamma(rng, alpha,
+                                       [int(s) for s in shape]) / beta)
+sd_op("random_poisson")(lambda shape=None, lam=1.0, rng=None:
+                        jax.random.poisson(rng, lam, [int(s) for s in shape]))
+sd_op("random_exponential")(lambda shape=None, rate=1.0, rng=None:
+                            jax.random.exponential(
+                                rng, [int(s) for s in shape]) / rate)
+sd_op("random_shuffle")(lambda x, rng=None: jax.random.permutation(rng, x))
+sd_op("random_truncated_normal")(
+    lambda shape=None, mean=0.0, stddev=1.0, rng=None:
+    mean + stddev * jax.random.truncated_normal(
+        rng, -2.0, 2.0, [int(s) for s in shape]))
+
+
+# ---- clipping family -------------------------------------------------------
+@sd_op("clip_by_norm")
+def _clip_by_norm(x, clip_norm=1.0, axis=None):
+    ax = None if axis is None else tuple(int(a) for a in np.atleast_1d(axis))
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=True))
+    return jnp.where(norm > clip_norm, x * clip_norm / norm, x)
+
+
+@sd_op("clip_by_avg_norm")
+def _clip_by_avg_norm(x, clip_norm=1.0):
+    avg = jnp.sqrt(jnp.mean(jnp.square(x)))
+    return jnp.where(avg > clip_norm, x * clip_norm / avg, x)
+
+
+@sd_op("clip_by_global_norm")
+def _clip_by_global_norm(*xs, clip_norm=1.0):
+    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(x)) for x in xs))
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gnorm, 1e-12))
+    out = tuple(x * scale for x in xs)
+    return out if len(out) > 1 else out[0]
+
+
+# ---- comparison utilities --------------------------------------------------
+sd_op("isclose")(lambda a, b, rtol=1e-5, atol=1e-8:
+                 jnp.isclose(a, b, rtol=rtol, atol=atol))
+sd_op("is_non_decreasing")(lambda x: jnp.all(x[1:] >= x[:-1]))
+sd_op("is_strictly_increasing")(lambda x: jnp.all(x[1:] > x[:-1]))
+sd_op("is_numeric_tensor")(lambda x: jnp.asarray(
+    jnp.issubdtype(x.dtype, jnp.number)))
+
+
+@sd_op("assert_equals")
+def _assert_equals(a, b):
+    """Value-level equality checked via checkify-style select: returns a
+    which equals b; under jit the check is best-effort (NaN poison)."""
+    return jnp.where(jnp.all(a == b), a, jnp.full_like(a, jnp.nan))

@@ -1,0 +1,228 @@
+"""Extended SameDiff op families vs independent references
+(SURVEY.md §2.1 op breadth). One representative per family plus the
+tricky-semantics ops (segment, space/batch, cells, color, CTC)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.samediff.ops import SD_OPS, get_sd_op
+
+
+def op(name, *args, **kw):
+    return np.asarray(get_sd_op(name)(*[jnp.asarray(a) for a in args], **kw))
+
+
+def test_registry_breadth():
+    assert len(SD_OPS) >= 290, f"op registry shrank: {len(SD_OPS)}"
+
+
+def test_special_functions():
+    # identities (no scipy in the image): erfinv(erf(x)) == x, lgamma vs
+    # factorial, xlogy zero handling
+    x = np.asarray([0.1, 0.5, 0.9])
+    np.testing.assert_allclose(op("erfinv", op("erf", x)), x, rtol=1e-4)
+    np.testing.assert_allclose(op("lgamma", np.asarray([5.0])),
+                               [np.log(24.0)], rtol=1e-6)
+    np.testing.assert_allclose(
+        op("xlogy", np.asarray([0.0, 2.0]), np.asarray([5.0, 3.0])),
+        [0.0, 2.0 * np.log(3.0)], rtol=1e-6)
+    np.testing.assert_allclose(op("frac", np.asarray([1.75, -1.75])),
+                               [0.75, -0.75], rtol=1e-6)
+
+
+def test_reductions_and_index():
+    x = np.asarray([[1.0, -5.0, 3.0], [2.0, 0.5, -0.1]])
+    np.testing.assert_allclose(op("logsumexp", x, axis=1),
+                               np.log(np.exp(x).sum(axis=1)), rtol=1e-6)
+    assert op("iamax", x, axis=1).tolist() == [1, 0]
+    np.testing.assert_allclose(op("amean", x, axis=1),
+                               np.abs(x).mean(axis=1))
+    np.testing.assert_allclose(op("reduce_median", x, axis=1),
+                               np.median(x, axis=1))
+    m, v = get_sd_op("moments")(jnp.asarray(x), axis=1)
+    np.testing.assert_allclose(np.asarray(m), x.mean(axis=1), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(v), x.var(axis=1), rtol=1e-6)
+
+
+def test_confusion_matrix_op():
+    got = op("confusion_matrix", np.asarray([0, 1, 2, 1]),
+             np.asarray([0, 2, 2, 1]), num_classes=3)
+    expect = np.zeros((3, 3))
+    for t, p in [(0, 0), (1, 2), (2, 2), (1, 1)]:
+        expect[t, p] += 1
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_segment_ops():
+    data = np.asarray([1.0, 2.0, 3.0, 4.0, 5.0])
+    ids = np.asarray([0, 0, 1, 2, 2])
+    np.testing.assert_allclose(
+        op("segment_sum", data, ids, num_segments=3), [3.0, 3.0, 9.0])
+    np.testing.assert_allclose(
+        op("segment_mean", data, ids, num_segments=3), [1.5, 3.0, 4.5])
+    np.testing.assert_allclose(
+        op("segment_max", data, ids, num_segments=3), [2.0, 3.0, 5.0])
+
+
+def test_sort_topk():
+    x = np.asarray([[3.0, 1.0, 4.0, 1.5]])
+    np.testing.assert_allclose(op("sort", x, descending=True),
+                               [[4.0, 3.0, 1.5, 1.0]])
+    vals, idx = get_sd_op("top_k")(jnp.asarray(x), k=2)
+    np.testing.assert_allclose(np.asarray(vals), [[4.0, 3.0]])
+    assert np.asarray(idx).tolist() == [[2, 0]]
+    hit = op("in_top_k", x, np.asarray([2]), k=1)
+    assert hit.tolist() == [True]
+
+
+def test_space_depth_batch_roundtrips():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 4, 6, 3).astype(np.float32)  # NHWC
+    sd = op("space_to_depth", x, block_size=2, data_format="NHWC")
+    assert sd.shape == (2, 2, 3, 12)
+    back = op("depth_to_space", sd, block_size=2, data_format="NHWC")
+    np.testing.assert_allclose(back, x)
+
+    import tensorflow as tf
+    expect = tf.nn.space_to_depth(x, 2).numpy()
+    np.testing.assert_allclose(sd, expect)
+
+    s2b = op("space_to_batch", x, block_shape=[2, 2], paddings=[(0, 0), (0, 0)])
+    expect2 = tf.space_to_batch(x, [2, 2], [[0, 0], [0, 0]]).numpy()
+    np.testing.assert_allclose(s2b, expect2)
+    b2s = op("batch_to_space", s2b, block_shape=[2, 2], crops=[(0, 0), (0, 0)])
+    np.testing.assert_allclose(b2s, x)
+
+
+def test_conv_variants_vs_torch():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    rng = np.random.RandomState(1)
+    # conv1d NWC vs torch NCW
+    x = rng.randn(2, 10, 3).astype(np.float32)
+    w = rng.randn(3, 3, 5).astype(np.float32)  # [kW, in, out]
+    got = op("conv1d", x, w, stride=1, padding="VALID")
+    expect = F.conv1d(torch.from_numpy(x.transpose(0, 2, 1)),
+                      torch.from_numpy(w.transpose(2, 1, 0))).numpy()
+    np.testing.assert_allclose(got, expect.transpose(0, 2, 1), rtol=1e-4,
+                               atol=1e-5)
+
+    # deconv2d NHWC vs torch conv_transpose2d NCHW; ours takes the
+    # forward-conv kernel [kH, kW, out, in], torch takes [in, out, kH, kW]
+    x2 = rng.randn(1, 5, 5, 4).astype(np.float32)
+    w2 = rng.randn(3, 3, 6, 4).astype(np.float32)
+    got2 = op("deconv2d", x2, w2, strides=(2, 2), padding="VALID")
+    expect2 = F.conv_transpose2d(
+        torch.from_numpy(x2.transpose(0, 3, 1, 2)),
+        torch.from_numpy(w2.transpose(3, 2, 0, 1)), stride=2).numpy()
+    np.testing.assert_allclose(got2, expect2.transpose(0, 2, 3, 1), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_pool_variants():
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 8, 3).astype(np.float32)
+    got = op("max_pool1d", x, kernel=2, strides=2)
+    np.testing.assert_allclose(got, x.reshape(1, 4, 2, 3).max(axis=2))
+    got_a = op("avg_pool1d", x, kernel=2, strides=2)
+    np.testing.assert_allclose(got_a, x.reshape(1, 4, 2, 3).mean(axis=2),
+                               rtol=1e-6)
+    x3 = rng.randn(1, 4, 4, 4, 2).astype(np.float32)
+    got3 = op("max_pool3d", x3, kernel=(2, 2, 2), strides=(2, 2, 2))
+    assert got3.shape == (1, 2, 2, 2, 2)
+
+
+def test_lstm_gru_cells_vs_torch():
+    torch = pytest.importorskip("torch")
+
+    rng = np.random.RandomState(3)
+    B, I, U = 2, 4, 3
+    x = rng.randn(B, I).astype(np.float32)
+    h = rng.randn(B, U).astype(np.float32)
+    c = rng.randn(B, U).astype(np.float32)
+    # ours: [i, f, o, g]; torch LSTMCell: [i, f, g, o]
+    Wi = rng.randn(I, 4 * U).astype(np.float32)
+    Wh = rng.randn(U, 4 * U).astype(np.float32)
+    b = rng.randn(4 * U).astype(np.float32)
+
+    h2, c2 = get_sd_op("lstm_cell")(jnp.asarray(x), jnp.asarray(h),
+                                    jnp.asarray(c), jnp.asarray(Wi),
+                                    jnp.asarray(Wh), jnp.asarray(b))
+    cell = torch.nn.LSTMCell(I, U)
+    perm = np.concatenate([np.arange(U), np.arange(U, 2 * U),
+                           np.arange(3 * U, 4 * U), np.arange(2 * U, 3 * U)])
+    with torch.no_grad():
+        cell.weight_ih.copy_(torch.from_numpy(Wi.T[perm]))
+        cell.weight_hh.copy_(torch.from_numpy(Wh.T[perm]))
+        cell.bias_ih.copy_(torch.from_numpy(b[perm]))
+        cell.bias_hh.zero_()
+        th, tc = cell(torch.from_numpy(x),
+                      (torch.from_numpy(h), torch.from_numpy(c)))
+    np.testing.assert_allclose(np.asarray(h2), th.numpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(c2), tc.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_color_space_roundtrip():
+    rng = np.random.RandomState(4)
+    x = rng.rand(5, 5, 3).astype(np.float32)
+    hsv = op("rgb_to_hsv", x)
+    back = op("hsv_to_rgb", hsv)
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-5)
+
+    import tensorflow as tf
+    expect = tf.image.rgb_to_hsv(x).numpy()
+    np.testing.assert_allclose(hsv, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_loss_family():
+    labels = np.asarray([1.0, 0.0, 1.0])
+    logits = np.asarray([2.0, -1.0, 0.5])
+    got = op("hinge_loss", labels, logits)
+    expect = np.mean(np.maximum(0, 1 - (2 * labels - 1) * logits))
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+    p = np.asarray([[0.7, 0.3], [0.2, 0.8]])
+    q = np.asarray([[0.6, 0.4], [0.3, 0.7]])
+    np.testing.assert_allclose(op("kl_divergence", p, q),
+                               (p * np.log(p / q)).sum(axis=-1), rtol=1e-6)
+
+
+def test_ctc_loss_finite_and_positive():
+    rng = np.random.RandomState(5)
+    B, T, C, L = 2, 10, 5, 4
+    logp = jax.nn.log_softmax(jnp.asarray(rng.randn(B, T, C), jnp.float32))
+    labels = jnp.asarray(rng.randint(1, C, (B, L)), jnp.int32)
+    loss = op("ctc_loss", logp, labels,
+              np.asarray([10, 8]), np.asarray([4, 3]))
+    assert loss.shape == (2,)
+    assert np.isfinite(loss).all() and (loss > 0).all()
+
+
+def test_clip_family():
+    x = np.asarray([3.0, 4.0])  # norm 5
+    np.testing.assert_allclose(op("clip_by_norm", x, clip_norm=1.0),
+                               x / 5.0, rtol=1e-6)
+    a, b = get_sd_op("clip_by_global_norm")(
+        jnp.asarray([3.0]), jnp.asarray([4.0]), clip_norm=1.0)
+    g = np.sqrt(9 + 16)
+    np.testing.assert_allclose(np.asarray(a), [3.0 / g], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(b), [4.0 / g], rtol=1e-6)
+
+
+def test_cells_and_misc_shapes():
+    rng = np.random.RandomState(6)
+    h = get_sd_op("gru_cell")(
+        jnp.asarray(rng.randn(2, 3), jnp.float32),
+        jnp.asarray(rng.randn(2, 4), jnp.float32),
+        jnp.asarray(rng.randn(3, 12), jnp.float32),
+        jnp.asarray(rng.randn(4, 12), jnp.float32))
+    assert np.asarray(h).shape == (2, 4)
+    np.testing.assert_allclose(op("l2_normalize", np.asarray([[3.0, 4.0]])),
+                               [[0.6, 0.8]], rtol=1e-6)
+    lrn = op("local_response_normalization", rng.rand(1, 2, 2, 8).astype(np.float32))
+    assert lrn.shape == (1, 2, 2, 8)
+    up = op("upsampling2d", rng.rand(1, 2, 3, 3).astype(np.float32), scale=2)
+    assert up.shape == (1, 2, 6, 6)
