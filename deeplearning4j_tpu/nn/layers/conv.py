@@ -126,13 +126,14 @@ class ConvolutionLayer(Layer):
         return ("NHWC", "OIHW", "NHWC")
 
     def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        from ...ops import helpers
+
         x = apply_input_dropout(self, x, ctx)
         pad = _lax_padding(self.convolution_mode, self.padding, self.kernel_size, self.dilation)
-        y = lax.conv_general_dilated(
-            x, params["W"], window_strides=self.stride, padding=pad,
-            rhs_dilation=self.dilation,
-            dimension_numbers=lax.conv_dimension_numbers(x.shape, params["W"].shape, self._dn()),
-        )
+        # helper seam (reference: cuDNN ConvolutionHelper consulted before
+        # builtin): "xla" conv emitter by default, "im2col" explicit-GEMM
+        y = helpers.conv2d(x, params["W"], self.stride, pad, self.dilation,
+                           self._dn())
         if self.has_bias:
             b = params["b"]
             y = y + (b[None, :, None, None] if self.data_format == "NCHW" else b)

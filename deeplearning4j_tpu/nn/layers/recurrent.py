@@ -85,7 +85,11 @@ def _lstm_scan(
         inputs = (xs, mask.T.astype(x_proj.dtype))
     else:
         inputs = xs
-    (h_f, c_f), hs = lax.scan(step, (h0, c0), inputs)
+    # helper seam (reference: cuDNN LSTMHelper): "scan" (one compiled
+    # loop) by default, "unrolled" for short static sequences
+    from ...ops import helpers
+
+    (h_f, c_f), hs = helpers.rnn_sequence(inputs, step, (h0, c0))
     return hs.transpose(1, 2, 0), h_f, c_f  # [b, n, t]
 
 

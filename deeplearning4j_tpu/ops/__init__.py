@@ -8,6 +8,14 @@ as the reference's ``LayerHelper`` SPI, so ValidateCuDNN-style parity tests
 (helper vs builtin) carry over (SURVEY.md §4).
 """
 
+from . import helpers
+from .helpers import (
+    available_helpers,
+    get_helper,
+    helper_name,
+    register_helper,
+    set_helper,
+)
 from .flash_attention import (
     attention_impl,
     flash_attention,
@@ -18,6 +26,12 @@ from .flash_attention import (
 
 __all__ = [
     "attention_impl",
+    "available_helpers",
+    "get_helper",
+    "helper_name",
+    "helpers",
+    "register_helper",
+    "set_helper",
     "flash_attention",
     "mha_attention",
     "mha_attention_reference",

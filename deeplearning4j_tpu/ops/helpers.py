@@ -1,0 +1,153 @@
+"""Layer-helper SPI: per-op pluggable implementations.
+
+Reference: org.deeplearning4j.nn.layers.LayerHelper and the cuDNN/oneDNN
+helper classes consulted before builtin math (SURVEY.md §2.1 "platform
+helpers", §2.2 "Helper SPI"). The attention seam (flash_attention.py) was
+the first instance; this generalizes it: any hot op can register named
+implementations and be switched globally — the hook where Pallas kernels,
+experimental lowerings, or debug paths plug in without touching layers.
+
+Built-in registrations:
+  conv2d: "xla" (conv_general_dilated — the fast path; XLA's conv emitter
+          tiles the MXU directly) and "im2col" (patch-extraction + one big
+          matmul — the reference's builtin strategy, kept as a genuinely
+          different lowering for A/B parity checks and odd shapes where
+          explicit GEMM wins).
+  lstm:   "scan" (lax.scan — one compiled loop, the sequence-length-
+          agnostic default) and "unrolled" (python-unrolled steps — larger
+          program, no loop overhead; can win for short static sequences).
+
+Switching clears jit caches (choices are read at trace time), same
+contract as set_attention_impl.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_IMPLS: Dict[str, Dict[str, Callable]] = {}
+_ACTIVE: Dict[str, str] = {}
+
+
+def register_helper(op: str, name: str, fn: Callable,
+                    default: bool = False) -> None:
+    """Register an implementation; the first registration for ``op`` (or a
+    later one passing ``default=True``) becomes the active choice."""
+    _IMPLS.setdefault(op, {})[name] = fn
+    if default or op not in _ACTIVE:
+        _ACTIVE[op] = name
+
+
+def set_helper(op: str, name: str) -> None:
+    """Select the implementation for ``op`` ("xla"/"im2col"/...). Clears
+    jit caches so already-compiled programs re-trace with the new choice."""
+    if op not in _IMPLS:
+        raise ValueError(f"no helpers registered for op {op!r}")
+    if name not in _IMPLS[op]:
+        raise ValueError(
+            f"unknown helper {name!r} for {op!r}; have {sorted(_IMPLS[op])}")
+    if _ACTIVE.get(op) != name:
+        _ACTIVE[op] = name
+        jax.clear_caches()
+
+
+def get_helper(op: str) -> Callable:
+    return _IMPLS[op][_ACTIVE[op]]
+
+
+def helper_name(op: str) -> str:
+    return _ACTIVE[op]
+
+
+def available_helpers(op: str):
+    return sorted(_IMPLS.get(op, {}))
+
+
+# ---------------------------------------------------------------------------
+# conv2d helpers — signature: (x, w, strides, padding, dilation, dn) -> y
+# where w layout + dimension numbers come from the calling layer
+# ---------------------------------------------------------------------------
+
+def _conv2d_xla(x, w, strides, padding, dilation, dn):
+    return lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding, rhs_dilation=dilation,
+        dimension_numbers=lax.conv_dimension_numbers(x.shape, w.shape, dn))
+
+
+def _conv2d_im2col(x, w, strides, padding, dilation, dn):
+    """Patch extraction + one [b*oh*ow, k*k*ci] @ [k*k*ci, co] matmul —
+    the explicit-GEMM lowering (reference: the builtin im2col path)."""
+    in_spec, w_spec, out_spec = dn
+    if in_spec != "NCHW" or w_spec != "OIHW":
+        # normalize to NCHW/OIHW, recurse, convert back
+        x_n = jnp.transpose(x, [in_spec.index(c) for c in "NCHW"])
+        w_n = jnp.transpose(w, [w_spec.index(c) for c in "OIHW"])
+        y = _conv2d_im2col(x_n, w_n, strides, padding, dilation,
+                           ("NCHW", "OIHW", "NCHW"))
+        return jnp.transpose(y, ["NCHW".index(c) for c in out_spec])
+    n, ci, h, wdt = x.shape
+    co, _, kh, kw = w.shape
+    if isinstance(padding, str):
+        # resolve SAME/VALID to explicit pads the same way lax does
+        eff_kh = (kh - 1) * dilation[0] + 1
+        eff_kw = (kw - 1) * dilation[1] + 1
+        if padding.upper() == "SAME":
+            oh = -(-h // strides[0])
+            ow = -(-wdt // strides[1])
+            ph = max(0, (oh - 1) * strides[0] + eff_kh - h)
+            pw = max(0, (ow - 1) * strides[1] + eff_kw - wdt)
+            pads = [(ph // 2, ph - ph // 2), (pw // 2, pw - pw // 2)]
+        else:
+            pads = [(0, 0), (0, 0)]
+    else:
+        pads = [tuple(p) for p in padding]
+    x = jnp.pad(x, [(0, 0), (0, 0), pads[0], pads[1]])
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), strides, [(0, 0), (0, 0)], rhs_dilation=dilation,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))  # [n, ci*kh*kw, oh, ow]
+    _, f, oh, ow = patches.shape
+    cols = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, f)
+    y = cols @ w.reshape(co, f).T  # one MXU-shaped GEMM
+    return y.reshape(n, oh, ow, co).transpose(0, 3, 1, 2)
+
+
+register_helper("conv2d", "xla", _conv2d_xla, default=True)
+register_helper("conv2d", "im2col", _conv2d_im2col)
+
+
+def conv2d(x, w, strides, padding, dilation, dn):
+    """Layer entry point: dispatch through the active conv2d helper."""
+    return get_helper("conv2d")(x, w, strides, padding, dilation, dn)
+
+
+# ---------------------------------------------------------------------------
+# recurrent sequence helpers — signature: (inputs, step_fn, carry) ->
+# (carry_final, stacked_outputs). ``inputs`` is a time-major pytree; the
+# cell math (gates, masking, peepholes) stays with the layer's step_fn.
+# ---------------------------------------------------------------------------
+
+def _rnn_scan(inputs, step_fn, carry):
+    return lax.scan(step_fn, carry, inputs)
+
+
+def _rnn_unrolled(inputs, step_fn, carry):
+    n_steps = jax.tree_util.tree_leaves(inputs)[0].shape[0]
+    outs = []
+    for t in range(n_steps):
+        inp_t = jax.tree_util.tree_map(lambda a: a[t], inputs)
+        carry, out = step_fn(carry, inp_t)
+        outs.append(out)
+    return carry, jnp.stack(outs, axis=0)
+
+
+register_helper("lstm", "scan", _rnn_scan, default=True)
+register_helper("lstm", "unrolled", _rnn_unrolled)
+
+
+def rnn_sequence(inputs, step_fn, carry):
+    """Layer entry point: dispatch through the active lstm helper."""
+    return get_helper("lstm")(inputs, step_fn, carry)

@@ -1,0 +1,89 @@
+"""Layer-helper SPI (reference: LayerHelper/cuDNN seam, SURVEY.md §2.2
+"Helper SPI"): pluggable conv2d and LSTM implementations must agree with
+the builtin path — the ValidateCuDNN parity pattern — and be switchable."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops import (
+    available_helpers,
+    helper_name,
+    set_helper,
+)
+
+
+@pytest.fixture(autouse=True)
+def _restore_helpers():
+    yield
+    set_helper("conv2d", "xla")
+    set_helper("lstm", "scan")
+
+
+def _conv_net():
+    from deeplearning4j_tpu.nn import (
+        Activation, InputType, LossFunction, NeuralNetConfiguration, WeightInit,
+    )
+    from deeplearning4j_tpu.nn.layers import (
+        ConvolutionLayer, ConvolutionMode, OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.sequential import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(11)
+            .weight_init(WeightInit.XAVIER).list()
+            .layer(ConvolutionLayer(n_out=6, kernel_size=(3, 3), stride=(2, 2),
+                                    convolution_mode=ConvolutionMode.SAME,
+                                    activation=Activation.RELU))
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    dilation=(2, 2),
+                                    activation=Activation.IDENTITY))
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.convolutional(12, 12, 2)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_conv_helpers_registered():
+    assert set(available_helpers("conv2d")) >= {"xla", "im2col"}
+    assert set(available_helpers("lstm")) >= {"scan", "unrolled"}
+    assert helper_name("conv2d") == "xla"
+
+
+def test_conv2d_im2col_matches_xla():
+    net = _conv_net()
+    x = np.random.RandomState(0).rand(3, 2, 12, 12).astype(np.float32)
+    set_helper("conv2d", "xla")
+    y_xla = np.asarray(net.output(x))
+    set_helper("conv2d", "im2col")
+    y_gemm = np.asarray(net.output(x))
+    np.testing.assert_allclose(y_gemm, y_xla, rtol=1e-5, atol=1e-6)
+
+
+def test_lstm_unrolled_matches_scan():
+    from deeplearning4j_tpu.nn import (
+        Activation, InputType, LossFunction, NeuralNetConfiguration, WeightInit,
+    )
+    from deeplearning4j_tpu.nn.layers import LSTMLayer, RnnOutputLayer
+    from deeplearning4j_tpu.nn.sequential import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(12)
+            .weight_init(WeightInit.XAVIER).list()
+            .layer(LSTMLayer(n_out=5))
+            .layer(RnnOutputLayer(n_out=2, loss=LossFunction.MCXENT,
+                                  activation=Activation.SOFTMAX))
+            .set_input_type(InputType.recurrent(3, 6)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.RandomState(1).rand(2, 3, 6).astype(np.float32)
+    mask = np.asarray([[1, 1, 1, 1, 0, 0], [1, 1, 1, 1, 1, 1]], np.float32)
+
+    set_helper("lstm", "scan")
+    y_scan = np.asarray(net.output(x, mask=mask))
+    set_helper("lstm", "unrolled")
+    y_unrolled = np.asarray(net.output(x, mask=mask))
+    np.testing.assert_allclose(y_unrolled, y_scan, rtol=1e-5, atol=1e-6)
+
+
+def test_unknown_helper_rejected():
+    with pytest.raises(ValueError, match="unknown helper"):
+        set_helper("conv2d", "nope")
+    with pytest.raises(ValueError, match="no helpers registered"):
+        set_helper("nothere", "x")
