@@ -40,6 +40,8 @@ class Word2Vec:
         epochs: int = 1,
         batch_size: int = 1024,
         seed: int = 12345,
+        mesh=None,
+        shard_axis: str = "model",
     ) -> None:
         self.vector_size = int(vector_size)
         self.window = int(window)
@@ -51,6 +53,13 @@ class Word2Vec:
         self.epochs = int(epochs)
         self.batch_size = int(batch_size)
         self.seed = int(seed)
+        # sharded-PS mode (SURVEY §2.3 "Param-server sharding"): with a
+        # mesh, syn0/syn1 live row-sharded over shard_axis and the jitted
+        # step's gathers/scatters compile to XLA collectives — the
+        # reference's VoidParameterServer role without the TCP protocol
+        # (see parallel/sharded_embedding.py)
+        self.mesh = mesh
+        self.shard_axis = shard_axis
 
         self.vocab: List[str] = []
         self.vocab_index: Dict[str, int] = {}
@@ -155,8 +164,14 @@ class Word2Vec:
         table = self._negative_table()
         step = self._make_step()
 
-        syn0 = jnp.asarray(self.syn0)
-        syn1 = jnp.asarray(self.syn1)
+        if self.mesh is not None:
+            from ..parallel.sharded_embedding import shard_rows
+
+            syn0 = shard_rows(self.syn0, self.mesh, self.shard_axis)
+            syn1 = shard_rows(self.syn1, self.mesh, self.shard_axis)
+        else:
+            syn0 = jnp.asarray(self.syn0)
+            syn1 = jnp.asarray(self.syn1)
         # pair count estimate for the linear lr decay
         est_pairs = max(1, sum(len(s) for s in sentences) * self.window)
         total_batches = max(1, self.epochs * est_pairs // self.batch_size)
@@ -195,8 +210,8 @@ class Word2Vec:
                         print(f"w2v batch {batch_i}: loss {loss:.4f}")
                     buf_c, buf_x = [], []
             syn0, syn1, batch_i, _ = flush(syn0, syn1, batch_i)
-        self.syn0 = np.asarray(syn0)
-        self.syn1 = np.asarray(syn1)
+        self.syn0 = np.asarray(syn0)[:v]  # drop shard padding, if any
+        self.syn1 = np.asarray(syn1)[:v]
         return self
 
     # ----- query API (reference method names) -------------------------

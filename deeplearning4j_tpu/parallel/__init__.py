@@ -23,6 +23,7 @@ Reference capabilities covered (SURVEY.md §2.3/§2.4, §3.4/§3.5):
   see :func:`initialize_distributed`.
 """
 
+from .sharded_embedding import ShardedEmbeddingTable, shard_rows
 from .mesh import MeshSpec, initialize_distributed, make_mesh
 from .strategies import (
     GradientSyncStrategy,
@@ -35,6 +36,8 @@ from .trainer import DistributedTrainer
 from .inference import InferenceMode, ParallelInference
 
 __all__ = [
+    "ShardedEmbeddingTable",
+    "shard_rows",
     "DistributedTrainer",
     "ring_attention",
     "ulysses_attention",

@@ -1,0 +1,97 @@
+"""Sharded embedding tables — the parameter-server role, TPU-style.
+
+Reference: the reference shards Word2Vec/ParagraphVectors embedding tables
+across parameter-server shards (VoidParameterServer / parameter-server v2,
+SURVEY.md §2.3 "Param-server sharding"), with workers pushing sparse rank-1
+updates over Aeron. On TPU the same role is sharded DEVICE STATE: the
+[V, D] table lives row-sharded over the mesh's model axis
+(PartitionSpec("model", None)); lookups are gathers and updates are
+scatter-adds inside jitted programs, and XLA inserts the all-gather /
+reduce-scatter collectives that replace the PS network protocol
+(SURVEY.md §2.4 — collectives ride ICI, not a TCP parameter server).
+
+``ShardedEmbeddingTable`` is the standalone primitive;
+``Word2Vec(mesh=...)`` (nlp/word2vec.py) places its syn0/syn1 with
+:func:`shard_rows`, the shared pad-and-place helper.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def shard_rows(arr: np.ndarray, mesh: Mesh, axis: str = "model") -> jax.Array:
+    """Pad rows to a shard multiple (even layout — XLA requirement) and
+    place row-sharded on ``mesh``. Padded rows are addressable but unused;
+    callers slice ``[:n]`` on readback."""
+    n_shards = mesh.shape[axis]
+    pad = (-arr.shape[0]) % n_shards
+    if pad:
+        arr = np.pad(np.asarray(arr), ((0, pad),) + ((0, 0),) * (arr.ndim - 1))
+    return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, P(axis, None)))
+
+
+class ShardedEmbeddingTable:
+    """A [vocab, dim] table row-sharded over ``axis`` of ``mesh``.
+
+    API mirrors the PS verbs: ``lookup(ids)`` (reference: vector fetch),
+    ``add_sparse(ids, deltas)`` (reference: push of rank-1 updates) — both
+    jitted with explicit shardings so the gather/scatter compile to
+    collective ops instead of host round-trips.
+    """
+
+    def __init__(self, vocab_size: int, dim: int, mesh: Mesh,
+                 axis: str = "model", seed: int = 0,
+                 init_scale: Optional[float] = None) -> None:
+        if vocab_size % mesh.shape[axis]:
+            # pad rows so every shard is equal-sized (XLA requirement for
+            # even layout); the padded tail is never addressed
+            pad = mesh.shape[axis] - vocab_size % mesh.shape[axis]
+        else:
+            pad = 0
+        self.vocab_size = vocab_size
+        self.padded_size = vocab_size + pad
+        self.dim = dim
+        self.mesh = mesh
+        self.sharding = NamedSharding(mesh, P(axis, None))
+        self.replicated = NamedSharding(mesh, P())
+        scale = (1.0 / dim) if init_scale is None else init_scale
+        rng = np.random.RandomState(seed)
+        host = ((rng.rand(vocab_size, dim) - 0.5) * 2 * scale
+                ).astype(np.float32)
+        self.table = shard_rows(host, mesh, axis)
+
+        @jax.jit
+        def _lookup(table, ids):
+            return jnp.take(table, ids, axis=0)
+
+        @jax.jit
+        def _add_sparse(table, ids, deltas):
+            return table.at[ids].add(deltas)
+
+        self._lookup = _lookup
+        self._add_sparse = _add_sparse
+
+    def lookup(self, ids) -> jax.Array:
+        """Fetch rows (replicated result): the PS "get" verb."""
+        return self._lookup(self.table, jnp.asarray(ids, jnp.int32))
+
+    def add_sparse(self, ids, deltas) -> None:
+        """Scatter-add row deltas: the PS "push" verb. The update stays
+        sharded — XLA routes each row's delta to its owning shard."""
+        self.table = self._add_sparse(
+            self.table, jnp.asarray(ids, jnp.int32), jnp.asarray(deltas))
+
+    def to_numpy(self) -> np.ndarray:
+        return np.asarray(self.table)[: self.vocab_size]
+
+    @property
+    def shard_count(self) -> int:
+        return self.table.sharding.mesh.shape[
+            self.sharding.spec[0]] if self.sharding.spec[0] else 1

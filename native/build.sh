@@ -8,5 +8,13 @@ if command -v cmake >/dev/null 2>&1 && command -v ninja >/dev/null 2>&1; then
   cmake --build build >/dev/null
 else
   g++ -O3 -shared -fPIC -std=c++14 -o build/libdl4jtpu.so dl4jtpu_native.cpp
+  g++ -O3 -std=c++14 -o build/dl4jtpu_test test_native.cpp -L build -ldl4jtpu -Wl,-rpath,'$ORIGIN'
 fi
 echo "built: $(pwd)/build/libdl4jtpu.so"
+if [ "$1" = "test" ]; then
+  if [ -x build/dl4jtpu_test ]; then
+    ./build/dl4jtpu_test
+  else
+    (cd build && ctest --output-on-failure)
+  fi
+fi

@@ -1,0 +1,117 @@
+// C++ unit tests for libdl4jtpu (SURVEY.md §2.1 "C++ tests" — the
+// reference runs libnd4j gtest suites; this is the same-layer check run
+// directly against the C ABI, no Python in the loop).
+//
+// Plain assert-style runner (no gtest in the image): each CHECK prints
+// context on failure and the process exits nonzero, so `ctest` /
+// `build.sh test` integrate it.
+
+#include <cassert>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+int64_t dl4j_threshold_encode(float*, int64_t, float, int32_t*, int64_t);
+void dl4j_threshold_decode(const int32_t*, int64_t, float, float*, int64_t);
+int64_t dl4j_bitmap_encode(float*, int64_t, float, uint8_t*);
+void dl4j_bitmap_decode(const uint8_t*, int64_t, float, float*);
+int32_t dl4j_parse_csv_f32(const char*, int64_t, char, int32_t, float*,
+                           int64_t, int64_t*, int64_t*);
+}
+
+static int failures = 0;
+#define CHECK(cond)                                                     \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,      \
+                   #cond);                                              \
+      ++failures;                                                       \
+    }                                                                   \
+  } while (0)
+
+#define CHECK_NEAR(a, b, eps) CHECK(std::fabs((a) - (b)) <= (eps))
+
+static void test_threshold_roundtrip() {
+  float grad[8] = {0.5f, -0.3f, 0.05f, 0.0f, -0.05f, 1.0f, -1.0f, 0.2f};
+  float orig[8];
+  std::memcpy(orig, grad, sizeof(grad));
+  int32_t enc[8];
+  int64_t n = dl4j_threshold_encode(grad, 8, 0.1f, enc, 8);
+  CHECK(n == 5);  // |g| > 0.1: indices 0,1,5,6,7
+  // residual semantics: encoded entries lost exactly +/-threshold
+  CHECK_NEAR(grad[0], 0.4f, 1e-6f);
+  CHECK_NEAR(grad[1], -0.2f, 1e-6f);
+  CHECK_NEAR(grad[2], 0.05f, 1e-6f);  // untouched below threshold
+  float target[8] = {0};
+  dl4j_threshold_decode(enc, n, 0.1f, target, 8);
+  for (int i = 0; i < 8; ++i) {
+    // decode + residual reconstructs the original exactly
+    CHECK_NEAR(target[i] + grad[i], orig[i], 1e-6f);
+  }
+}
+
+static void test_threshold_overflow_leaves_gradient() {
+  float grad[4] = {1.0f, 1.0f, 1.0f, 1.0f};
+  int32_t enc[2];
+  int64_t n = dl4j_threshold_encode(grad, 4, 0.1f, enc, 2);  // cap too small
+  CHECK(n == -1);
+  for (int i = 0; i < 4; ++i) CHECK_NEAR(grad[i], 1.0f, 0.0f);
+}
+
+static void test_threshold_decode_corrupt_entries() {
+  float target[4] = {0};
+  int32_t enc[3] = {1, 99, -4};  // 99 out of range: skipped, no overrun
+  dl4j_threshold_decode(enc, 3, 0.5f, target, 4);
+  CHECK_NEAR(target[0], 0.5f, 1e-6f);
+  CHECK_NEAR(target[3], -0.5f, 1e-6f);
+}
+
+static void test_bitmap_roundtrip() {
+  float grad[9] = {0.5f, -0.5f, 0.01f, 0.2f, -0.2f, 0.0f, 0.3f, -0.01f, 0.15f};
+  float orig[9];
+  std::memcpy(orig, grad, sizeof(grad));
+  uint8_t bitmap[3] = {0, 0, 0};  // ceil(9/4)
+  int64_t n = dl4j_bitmap_encode(grad, 9, 0.1f, bitmap);
+  CHECK(n == 6);
+  float target[9] = {0};
+  dl4j_bitmap_decode(bitmap, 9, 0.1f, target);
+  for (int i = 0; i < 9; ++i) CHECK_NEAR(target[i] + grad[i], orig[i], 1e-6f);
+}
+
+static void test_csv_parse() {
+  const char* text = "h1,h2,h3\n1.5,2,3\n-4,5.25,6e1\n";
+  int64_t rows = 0, cols = 0;
+  int32_t rc = dl4j_parse_csv_f32(text, (int64_t)std::strlen(text), ',', 1,
+                                  nullptr, 0, &rows, &cols);
+  CHECK(rc == 0);
+  CHECK(rows == 2 && cols == 3);
+  std::vector<float> out((size_t)(rows * cols));
+  rc = dl4j_parse_csv_f32(text, (int64_t)std::strlen(text), ',', 1,
+                          out.data(), rows * cols, &rows, &cols);
+  CHECK(rc == 0);
+  CHECK_NEAR(out[0], 1.5f, 1e-6f);
+  CHECK_NEAR(out[3], -4.0f, 1e-6f);
+  CHECK_NEAR(out[5], 60.0f, 1e-4f);
+
+  const char* ragged = "1,2\n3\n";
+  rc = dl4j_parse_csv_f32(ragged, (int64_t)std::strlen(ragged), ',', 0,
+                          nullptr, 0, &rows, &cols);
+  CHECK(rc == -1);
+}
+
+int main() {
+  test_threshold_roundtrip();
+  test_threshold_overflow_leaves_gradient();
+  test_threshold_decode_corrupt_entries();
+  test_bitmap_roundtrip();
+  test_csv_parse();
+  if (failures) {
+    std::fprintf(stderr, "%d check(s) FAILED\n", failures);
+    return 1;
+  }
+  std::printf("all native checks passed\n");
+  return 0;
+}

@@ -1,0 +1,76 @@
+"""Sharded embedding tables over the 8-device mesh (SURVEY.md §2.3
+"Param-server sharding (W2V)"): the PS get/push verbs as sharded state +
+XLA collectives, and Word2Vec training with row-sharded tables."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.parallel import ShardedEmbeddingTable, make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(model=8)
+
+
+def test_table_is_actually_sharded(mesh):
+    t = ShardedEmbeddingTable(64, 16, mesh, seed=0)
+    assert t.table.shape == (64, 16)
+    # 8 shards of 8 rows each
+    shard_shapes = {s.data.shape for s in t.table.addressable_shards}
+    assert shard_shapes == {(8, 16)}
+
+
+def test_lookup_and_sparse_update_parity(mesh):
+    t = ShardedEmbeddingTable(30, 8, mesh, seed=1)  # 30 pads to 32
+    dense = t.to_numpy().copy()
+    ids = np.asarray([0, 7, 29, 7], np.int32)
+    got = np.asarray(t.lookup(ids))
+    np.testing.assert_allclose(got, dense[ids], rtol=1e-6)
+
+    deltas = np.random.RandomState(2).randn(4, 8).astype(np.float32)
+    t.add_sparse(ids, deltas)
+    expect = dense.copy()
+    np.add.at(expect, ids, deltas)  # duplicate id 7 accumulates
+    np.testing.assert_allclose(t.to_numpy(), expect, rtol=1e-5, atol=1e-6)
+
+
+def test_word2vec_with_sharded_tables(mesh):
+    from deeplearning4j_tpu.nlp import Word2Vec
+
+    rng = np.random.RandomState(0)
+    animals = ["cat", "dog", "horse", "sheep", "goat"]
+    tech = ["cpu", "gpu", "tpu", "ram", "disk"]
+    sents = []
+    for _ in range(200):
+        pool = animals if rng.rand() < 0.5 else tech
+        sents.append([pool[rng.randint(5)] for _ in range(rng.randint(4, 9))])
+
+    w2v = Word2Vec(vector_size=16, window=3, min_count=1, epochs=3,
+                   batch_size=256, seed=3, mesh=mesh)
+    w2v.fit(sents)
+    # trained vectors come back whole and topic-clustered
+    assert w2v.get_word_vector("cat").shape == (16,)
+    within = np.mean([w2v.similarity("cat", w) for w in animals if w != "cat"])
+    across = np.mean([w2v.similarity("cat", w) for w in tech])
+    assert within > across, f"within={within:.3f} across={across:.3f}"
+
+
+def test_sharded_matches_unsharded_w2v(mesh):
+    """Same seed, same data: sharded placement must not change the math
+    (GSPMD is a layout, not an algorithm change)."""
+    from deeplearning4j_tpu.nlp import Word2Vec
+
+    rng = np.random.RandomState(1)
+    words = [f"w{i}" for i in range(12)]
+    sents = [[words[rng.randint(12)] for _ in range(6)] for _ in range(60)]
+
+    a = Word2Vec(vector_size=8, min_count=1, epochs=2, batch_size=64, seed=5)
+    a.fit([list(s) for s in sents])
+    b = Word2Vec(vector_size=8, min_count=1, epochs=2, batch_size=64, seed=5,
+                 mesh=mesh)
+    b.fit([list(s) for s in sents])
+    np.testing.assert_allclose(a.syn0, b.syn0, rtol=1e-4, atol=1e-5)
