@@ -11,10 +11,11 @@ This is the accelerated "helper" implementation for the attention layers
 the cuDNN attention/mha helper consulted before the builtin math
 (SURVEY.md §2.1 "platform helpers", §2.2 "Helper SPI").
 
-The backward pass recomputes attention with the reference XLA einsum path
-(flash forward + rematerialised backward): forward memory is what flash
-buys; XLA fuses the backward fine at the sequence lengths the layer zoo
-uses. Inputs [batch, heads, time, head_dim].
+The backward pass is blockwise too (_mea_bwd_single — Dao et al. alg. 4 as
+nested lax.scan): score blocks are recomputed per (q-chunk, k-chunk) with
+the row logsumexp rebuilt on the fly, so TRAINING memory is O(t·d) like
+the forward — long-context backprop never materialises the t² matrix.
+Inputs [batch, heads, time, head_dim].
 """
 
 from __future__ import annotations
@@ -25,6 +26,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 
 try:  # TPU memory spaces — absent on some CPU-only builds
@@ -103,8 +105,9 @@ def mha_attention_reference(
 # ---------------------------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, m_scr, l_scr,
-                  acc_scr, *, scale, block_q, block_k, causal, tk_offset):
+def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, m_scr,
+                  l_scr, acc_scr, *, scale, block_q, block_k, causal,
+                  tk_offset):
     """One (batch·head, q-block, k-block) grid step.
 
     The k dimension is the innermost grid axis; TPU grids execute
@@ -155,6 +158,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, m_scr, l_scr,
     def _():
         out = acc_new / jnp.maximum(l_new, 1e-30)  # fully-masked rows → 0
         o_ref[0] = out.astype(o_ref.dtype)
+        # row logsumexp for the backward (saves its recompute pass there);
+        # fully-masked rows get +big so exp(s - lse) -> 0 downstream
+        lse_ref[0] = jnp.where(
+            l_new > 0, m_new + jnp.log(jnp.maximum(l_new, 1e-30)), -_NEG)
 
 
 def _pad_to(x: jax.Array, axis: int, multiple: int, value=0.0) -> jax.Array:
@@ -167,10 +174,12 @@ def _pad_to(x: jax.Array, axis: int, multiple: int, value=0.0) -> jax.Array:
     return jnp.pad(x, widths, constant_values=value)
 
 
-def _flash_forward(q, k, v, mask, causal, scale, block_q, block_k, interpret):
+def _flash_forward(q, k, v, mask, causal, scale, block_q, block_k, interpret,
+                   with_lse: bool = False):
     if _VMEM is None:  # jaxlib without pallas TPU support: same math via XLA
-        return mha_attention_reference(q, k, v, mask=mask, causal=causal,
-                                       scale=scale)
+        out = mha_attention_reference(q, k, v, mask=mask, causal=causal,
+                                      scale=scale)
+        return (out, None) if with_lse else out
     b, h, tq, d = q.shape
     tk, dv = k.shape[2], v.shape[3]
     block_q = min(block_q, max(tq, 1))
@@ -200,7 +209,7 @@ def _flash_forward(q, k, v, mask, causal, scale, block_q, block_k, interpret):
         pltpu.VMEM((block_q, 1), jnp.float32),
         pltpu.VMEM((block_q, dv), jnp.float32),
     ]
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=[
@@ -213,13 +222,23 @@ def _flash_forward(q, k, v, mask, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, block_k), lambda bh, qi, ki: (bh // h, 0, ki),
                          **kwargs),
         ],
-        out_specs=pl.BlockSpec((1, block_q, dv),
-                               lambda bh, qi, ki: (bh, qi, 0), **kwargs),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, dv), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, dv),
+                         lambda bh, qi, ki: (bh, qi, 0), **kwargs),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bh, qi, ki: (bh, qi, 0), **kwargs),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq_p, dv), q.dtype),
+            jax.ShapeDtypeStruct((b * h, tq_p, 1), jnp.float32),
+        ],
         scratch_shapes=scratch,
         interpret=interpret,
     )(qp, kp, vp, mask)
-    return out.reshape(b, h, tq_p, dv)[:, :, :tq, :]
+    out = out.reshape(b, h, tq_p, dv)[:, :, :tq, :]
+    if not with_lse:
+        return out
+    return out, lse.reshape(b, h, tq_p)[:, :, :tq]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
@@ -229,17 +248,121 @@ def _flash(q, k, v, mask, causal, scale, block_q, block_k, interpret):
 
 
 def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, mask, causal, scale, block_q, block_k,
-                         interpret)
-    return out, (q, k, v, mask)
+    out, lse = _flash_forward(q, k, v, mask, causal, scale, block_q, block_k,
+                              interpret, with_lse=True)
+    return out, (q, k, v, mask, out, lse)
+
+
+def _mea_bwd_single(q, k, v, mask_k, g, out, lse_rows, *, causal, scale,
+                    tk_off, bq, bk, have_lse):
+    """Memory-efficient attention backward for ONE head (Dao et al. alg. 4,
+    the XLA spelling): two-level ``lax.scan`` over (q-chunk, k-chunk)
+    recomputes score blocks instead of materializing the [tq, tk] matrix —
+    backward memory is O(t·d) like the flash forward, so long-context
+    TRAINING fits, not just inference. Inputs are f32, pre-padded to chunk
+    multiples. Returns (dq, dk, dv)."""
+    tq, d = q.shape
+    tk, dv = v.shape
+    nq, nk = tq // bq, tk // bk
+    qc = q.reshape(nq, bq, d)
+    gc = g.reshape(nq, bq, dv)
+    oc = out.reshape(nq, bq, dv)
+    lc = lse_rows.reshape(nq, bq, 1)
+    kc = k.reshape(nk, bk, d)
+    vc = v.reshape(nk, bk, dv)
+    mc = mask_k.reshape(nk, bk)
+    neg = jnp.float32(_NEG)
+
+    def scores(qch, kch, mch, qi, ki):
+        s = (qch @ kch.T) * scale  # [bq, bk]
+        s = jnp.where(mch[None, :] > 0, s, neg)
+        if causal:
+            q_ids = (qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+                     + tk_off)
+            k_ids = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_ids >= k_ids, s, neg)
+        return s
+
+    def outer(carry, xs):
+        dk_acc, dv_acc = carry
+        qi, qch, gch, och, lch = xs
+
+        if have_lse:
+            lse = lch  # saved by the forward kernel: no recompute pass
+        else:
+            # XLA-fallback forward saved no lse: rebuild it blockwise
+            def p1(c, ys):
+                m, l = c
+                ki, kch, mch = ys
+                s = scores(qch, kch, mch, qi, ki)
+                m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+                p = jnp.where(s > neg * 0.5, jnp.exp(s - m_new), 0.0)
+                l = l * jnp.exp(m - m_new) + jnp.sum(p, axis=-1,
+                                                     keepdims=True)
+                return (m_new, l), None
+
+            (m, l), _ = lax.scan(
+                p1, (jnp.full((bq, 1), neg), jnp.zeros((bq, 1), jnp.float32)),
+                (jnp.arange(nk), kc, mc))
+            # fully-masked rows: force P = 0 downstream, not exp(s+inf)
+            lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)),
+                            jnp.float32(-_NEG))
+        delta = jnp.sum(gch * och, axis=-1, keepdims=True)  # D_i
+
+        # pass 2: dq for this q-chunk; per-k-chunk dk/dv contributions
+        def p2(dq, ys):
+            ki, kch, vch, mch = ys
+            s = scores(qch, kch, mch, qi, ki)
+            p = jnp.where(s > neg * 0.5, jnp.exp(s - lse), 0.0)  # [bq, bk]
+            dp = gch @ vch.T                                     # [bq, bk]
+            ds = p * (dp - delta)
+            dq = dq + (ds @ kch) * scale
+            return dq, ((ds.T @ qch) * scale, p.T @ gch)
+
+        dq, (dks, dvs) = lax.scan(
+            p2, jnp.zeros((bq, d), jnp.float32),
+            (jnp.arange(nk), kc, vc, mc))
+        return (dk_acc + dks, dv_acc + dvs), dq
+
+    (dk_out, dv_out), dqs = lax.scan(
+        outer,
+        (jnp.zeros((nk, bk, d), jnp.float32),
+         jnp.zeros((nk, bk, dv), jnp.float32)),
+        (jnp.arange(nq), qc, gc, oc, lc))
+    return dqs.reshape(tq, d), dk_out.reshape(tk, d), dv_out.reshape(tk, dv)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, mask = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: mha_attention_reference(
-            q_, k_, v_, mask=mask, causal=causal, scale=scale), q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, mask, out, lse = res
+    b, h, tq, d = q.shape
+    tk, dv = k.shape[2], v.shape[3]
+    bq = min(block_q, max(tq, 1))
+    bk = min(block_k, max(tk, 1))
+
+    mask_k = jnp.ones((b, tk), jnp.float32) if mask is None \
+        else mask.astype(jnp.float32)
+    qp = _pad_to(q.astype(jnp.float32), 2, bq)
+    gp = _pad_to(g.astype(jnp.float32), 2, bq)
+    op = _pad_to(out.astype(jnp.float32), 2, bq)
+    kp = _pad_to(k.astype(jnp.float32), 2, bk)
+    vp = _pad_to(v.astype(jnp.float32), 2, bk)
+    mp = _pad_to(mask_k, 1, bk, 0.0)
+    have_lse = lse is not None
+    if have_lse:
+        lp = _pad_to(lse.astype(jnp.float32)[..., None], 2, bq, -_NEG)
+    else:  # placeholder so the vmap structure stays uniform
+        lp = jnp.zeros((b, h, qp.shape[2], 1), jnp.float32)
+
+    single = functools.partial(
+        _mea_bwd_single, causal=causal, scale=scale, tk_off=tk - tq,
+        bq=bq, bk=bk, have_lse=have_lse)
+    # vmap heads (mask is per-batch), then batch
+    per_batch = jax.vmap(single, in_axes=(0, 0, 0, None, 0, 0, 0))
+    dq, dk, dv = jax.vmap(per_batch)(qp, kp, vp, mp, gp, op, lp)
+
+    dq = dq[:, :, :tq].astype(q.dtype)
+    dk = dk[:, :, :tk].astype(k.dtype)
+    dv = dv[:, :, :tk].astype(v.dtype)
     dmask = None if mask is None else jnp.zeros_like(mask)
     return dq, dk, dv, dmask
 

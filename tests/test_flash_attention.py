@@ -112,3 +112,53 @@ def test_fully_masked_rows_zero_on_both_impls():
     out = flash_attention(q, k, v, mask=mask, block_q=8, block_k=4)
     np.testing.assert_allclose(np.asarray(ref), 0.0, atol=1e-7)
     np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# memory-efficient backward (round 4): blockwise recompute, gradient parity
+# ---------------------------------------------------------------------------
+
+def _grads(fn, *args):
+    loss = lambda *a: jnp.sum(jnp.square(fn(*a)))
+    return jax.grad(loss, argnums=tuple(range(len(args))))(*args)
+
+
+@pytest.mark.parametrize("tq,tk", [(64, 64), (96, 128), (40, 72)])
+def test_flash_backward_matches_reference(tq, tk):
+    q = _rand(10, 2, 2, tq, 16)
+    k = _rand(11, 2, 2, tk, 16)
+    v = _rand(12, 2, 2, tk, 16)
+    ref = _grads(lambda a, b, c: mha_attention_reference(a, b, c), q, k, v)
+    got = _grads(lambda a, b, c: flash_attention(a, b, c, block_q=32,
+                                                 block_k=32), q, k, v)
+    for g, r, name in zip(got, ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=5e-4,
+                                   rtol=1e-4, err_msg=f"d{name}")
+
+
+def test_flash_backward_causal_and_masked():
+    q = _rand(13, 1, 2, 64, 16)
+    k = _rand(14, 1, 2, 64, 16)
+    v = _rand(15, 1, 2, 64, 16)
+    mask = jnp.asarray(np.random.RandomState(9).rand(1, 64) > 0.3, jnp.float32)
+
+    ref = _grads(lambda a, b, c: mha_attention_reference(
+        a, b, c, mask=mask, causal=True), q, k, v)
+    got = _grads(lambda a, b, c: flash_attention(
+        a, b, c, mask=mask, causal=True, block_q=32, block_k=32), q, k, v)
+    for g, r, name in zip(got, ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=5e-4,
+                                   rtol=1e-4, err_msg=f"d{name}")
+
+
+def test_flash_backward_ragged_blocks():
+    """Sequence lengths that do NOT divide the block size (padding path)."""
+    q = _rand(16, 1, 1, 50, 8)
+    k = _rand(17, 1, 1, 70, 8)
+    v = _rand(18, 1, 1, 70, 8)
+    ref = _grads(lambda a, b, c: mha_attention_reference(a, b, c), q, k, v)
+    got = _grads(lambda a, b, c: flash_attention(a, b, c, block_q=32,
+                                                 block_k=32), q, k, v)
+    for g, r, name in zip(got, ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=5e-4,
+                                   rtol=1e-4, err_msg=f"d{name}")
