@@ -182,6 +182,43 @@ def measure_bert(batch: int = 16, seq: int = 128, warmup_iters: int = 3,
     }
 
 
+def measure_lstm(batch: int = 32, seq: int = 200, vocab: int = 77,
+                 hidden: int = 200, warmup_iters: int = 2,
+                 bench_iters: int = 10) -> dict:
+    """GravesLSTM char-RNN train chars/sec (BASELINE.json:9: 'GravesLSTM
+    char-RNN, recurrent cuDNN helper -> XLA while_loop'). One-hot chars
+    [b, vocab, t], TBPTT-configured TextGenerationLSTM, host-fence timed."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.model.zoo import TextGenerationLSTM
+    from deeplearning4j_tpu.train.solver import Solver
+
+    model = TextGenerationLSTM(vocab_size=vocab, hidden=hidden, seed=42,
+                               tbptt_length=50).init()
+    solver = Solver(model)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (batch, seq + 1))
+    eye = np.eye(vocab, dtype=np.float32)
+    x = jnp.asarray(eye[ids[:, :-1]].transpose(0, 2, 1))  # [b, vocab, t]
+    y = jnp.asarray(eye[ids[:, 1:]].transpose(0, 2, 1))
+
+    for _ in range(warmup_iters):
+        solver.fit_batch(x, y)
+    _host_fence(model.params)
+    start = time.perf_counter()
+    for _ in range(bench_iters):
+        solver.fit_batch(x, y)
+    _host_fence(model.params)
+    sec_per_step = (time.perf_counter() - start) / bench_iters
+    return {
+        "chars_per_sec": batch * seq / sec_per_step,
+        "batch": batch, "seq": seq, "vocab": vocab, "hidden": hidden,
+        "step_ms": sec_per_step * 1e3,
+        "model": "TextGenerationLSTM (GravesLSTM x2, peepholes, TBPTT 50)",
+    }
+
+
 def measure_bert_import(batch: int = 16, seq: int = 128, warmup_iters: int = 2,
                         bench_iters: int = 10, hidden: int = 768, layers: int = 12,
                         heads: int = 12, vocab: int = 30522) -> dict:
@@ -351,11 +388,19 @@ def measure_calibration(n: int = 4096, chain: int = 20, iters: int = 10) -> dict
     }
 
 
+def measure_resnet50_b128() -> dict:
+    """Batch-scaling probe: larger per-chip batch usually lifts conv MFU
+    on v5e (batch 64 measured 0.112 in round 4)."""
+    return measure_resnet50(batch=128, warmup_iters=3, bench_iters=15)
+
+
 _MEASUREMENTS = {
     "lenet": measure_lenet,
     "resnet50": measure_resnet50,
+    "resnet50_b128": measure_resnet50_b128,
     "bert": measure_bert,
     "bert_import": measure_bert_import,
+    "lstm": measure_lstm,
     "calibration": measure_calibration,
     "input_pipeline": measure_input_pipeline,
 }
@@ -432,7 +477,9 @@ def _child_measure(name: str, platform: str) -> None:
                             "heads": 2, "vocab": 2000},
             "calibration": {"n": 1024, "chain": 4, "iters": 2},
             "input_pipeline": {"n_images": 64},
-        }[name]
+            "lstm": {"batch": 4, "seq": 50, "warmup_iters": 1,
+                     "bench_iters": 2},
+        }.get(name, {})
     result = _MEASUREMENTS[name](**kwargs)
     print(json.dumps(result))
 
@@ -472,10 +519,13 @@ def main() -> None:
     extras = {
         "bert": _run_measurement("bert", platform),
         "bert_tf_import": _run_measurement("bert_import", platform),
+        "lstm_char_rnn": _run_measurement("lstm", platform),
         "lenet_smoke": _run_measurement("lenet", platform),
         "calibration": calibration,
         "input_pipeline": _run_measurement("input_pipeline", platform),
     }
+    if not fallback:  # batch-scaling probe only makes sense on the chip
+        extras["resnet50_b128"] = _run_measurement("resnet50_b128", platform)
 
     # input-bound vs compute-bound: one host input pipeline vs the device
     # step rate (SURVEY.md:124). > 1 means the single-threaded host path
@@ -486,7 +536,7 @@ def main() -> None:
             ipl["images_per_sec"] / device["samples_per_sec"], 4)
 
     measured_peak = calibration.get("measured_peak_tflops")
-    for row in (device, extras["bert"]):
+    for row in (device, extras["bert"], extras.get("resnet50_b128", {})):
         if row.get("model_tflops_per_sec") and measured_peak:
             row["mfu_vs_measured_peak"] = round(
                 row["model_tflops_per_sec"] / measured_peak, 4)
@@ -495,7 +545,8 @@ def main() -> None:
     # impossible; >0.9 or a block-vs-fence disagreement >2x on the
     # calibration matmul means the timing cannot be trusted
     suspect = []
-    for label, row in (("resnet50", device), ("bert", extras["bert"])):
+    for label, row in (("resnet50", device), ("bert", extras["bert"]),
+                       ("resnet50_b128", extras.get("resnet50_b128", {}))):
         if row.get("mfu") and row["mfu"] > 0.9:
             suspect.append(f"{label} mfu={row['mfu']:.3f} > 0.9")
     if calibration.get("timer_disagreement") and calibration["timer_disagreement"] > 2.0:

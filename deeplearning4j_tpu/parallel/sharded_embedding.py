@@ -49,16 +49,10 @@ class ShardedEmbeddingTable:
     def __init__(self, vocab_size: int, dim: int, mesh: Mesh,
                  axis: str = "model", seed: int = 0,
                  init_scale: Optional[float] = None) -> None:
-        if vocab_size % mesh.shape[axis]:
-            # pad rows so every shard is equal-sized (XLA requirement for
-            # even layout); the padded tail is never addressed
-            pad = mesh.shape[axis] - vocab_size % mesh.shape[axis]
-        else:
-            pad = 0
         self.vocab_size = vocab_size
-        self.padded_size = vocab_size + pad
         self.dim = dim
         self.mesh = mesh
+        self.axis = axis
         self.sharding = NamedSharding(mesh, P(axis, None))
         self.replicated = NamedSharding(mesh, P())
         scale = (1.0 / dim) if init_scale is None else init_scale
@@ -66,26 +60,16 @@ class ShardedEmbeddingTable:
         host = ((rng.rand(vocab_size, dim) - 0.5) * 2 * scale
                 ).astype(np.float32)
         self.table = shard_rows(host, mesh, axis)
-
-        @jax.jit
-        def _lookup(table, ids):
-            return jnp.take(table, ids, axis=0)
-
-        @jax.jit
-        def _add_sparse(table, ids, deltas):
-            return table.at[ids].add(deltas)
-
-        self._lookup = _lookup
-        self._add_sparse = _add_sparse
+        self.padded_size = self.table.shape[0]
 
     def lookup(self, ids) -> jax.Array:
         """Fetch rows (replicated result): the PS "get" verb."""
-        return self._lookup(self.table, jnp.asarray(ids, jnp.int32))
+        return _table_lookup(self.table, jnp.asarray(ids, jnp.int32))
 
     def add_sparse(self, ids, deltas) -> None:
         """Scatter-add row deltas: the PS "push" verb. The update stays
         sharded — XLA routes each row's delta to its owning shard."""
-        self.table = self._add_sparse(
+        self.table = _table_add_sparse(
             self.table, jnp.asarray(ids, jnp.int32), jnp.asarray(deltas))
 
     def to_numpy(self) -> np.ndarray:
@@ -93,5 +77,15 @@ class ShardedEmbeddingTable:
 
     @property
     def shard_count(self) -> int:
-        return self.table.sharding.mesh.shape[
-            self.sharding.spec[0]] if self.sharding.spec[0] else 1
+        return self.mesh.shape[self.axis]
+
+
+# module-level so every table shares ONE trace/compile per shape
+@jax.jit
+def _table_lookup(table, ids):
+    return jnp.take(table, ids, axis=0)
+
+
+@jax.jit
+def _table_add_sparse(table, ids, deltas):
+    return table.at[ids].add(deltas)
