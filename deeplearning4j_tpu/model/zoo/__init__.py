@@ -8,6 +8,7 @@ from .bert import BertEncoder
 from .darknet import Darknet19, TinyYOLO
 from .inception_resnet import InceptionResNetV1
 from .lenet import LeNet
+from .misc import FaceNetNN4Small2, SimpleCNN, YOLO2
 from .resnet50 import ResNet50
 from .squeezenet import SqueezeNet
 from .textgen_lstm import TextGenerationLSTM
@@ -19,14 +20,17 @@ __all__ = [
     "AlexNet",
     "BertEncoder",
     "Darknet19",
+    "FaceNetNN4Small2",
     "InceptionResNetV1",
     "LeNet",
     "ResNet50",
+    "SimpleCNN",
     "SqueezeNet",
     "TextGenerationLSTM",
     "TinyYOLO",
     "UNet",
     "VGG16",
     "VGG19",
+    "YOLO2",
     "Xception",
 ]

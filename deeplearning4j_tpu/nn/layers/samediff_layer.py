@@ -40,8 +40,13 @@ class SameDiffLambdaLayer(Layer):
     fn: Optional[Callable] = None
     # output shape relative to input; None = unchanged
     output_size: Optional[int] = None
+    # full shape-inference override: InputType -> InputType (for ops that
+    # change spatial structure, e.g. a space-to-depth reorg)
+    output_type_fn: Optional[Callable] = None
 
     def output_type(self, input_type: InputType) -> InputType:
+        if self.output_type_fn is not None:
+            return self.output_type_fn(input_type)
         if self.output_size is not None:
             return FeedForwardType(size=self.output_size)
         return input_type

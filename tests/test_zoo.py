@@ -105,3 +105,40 @@ def test_textgen_lstm_trains():
     s0 = m.score(x, y)
     m.fit(x, y, epochs=5)
     assert m.score(x, y) < s0
+
+
+def test_simple_cnn_trains():
+    from deeplearning4j_tpu.model.zoo import SimpleCNN
+
+    m = SimpleCNN(num_classes=4, height=16, width=16, seed=5).init()
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 3, 16, 16).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 8)]
+    losses = []
+    for _ in range(8):
+        m.fit(x, y, epochs=1)
+        losses.append(m.score_value)
+    assert np.isfinite(losses[-1]) and losses[-1] < losses[0]
+
+
+def test_yolo2_grid_shape_and_passthrough():
+    from deeplearning4j_tpu.model.zoo import YOLO2
+
+    y = YOLO2(num_classes=3, n_boxes=5, height=64, width=64, seed=6).init()
+    out = np.asarray(y.output(
+        np.random.RandomState(1).rand(2, 3, 64, 64).astype(np.float32)))
+    # 64 / 32 = 2x2 grid; B*(5+C) = 5*8 = 40 channels
+    assert out.shape == (2, 40, 2, 2)
+    # the reorg passthrough really feeds the head: concat vertex exists
+    names = [s.name for s in y.conf.vertices]
+    assert "reorg" in names and "concat" in names
+
+
+def test_facenet_unit_norm_embeddings():
+    from deeplearning4j_tpu.model.zoo import FaceNetNN4Small2
+
+    f = FaceNetNN4Small2(embedding_size=64, seed=7, height=96, width=96).init()
+    emb = np.asarray(f.output(
+        np.random.RandomState(2).rand(3, 3, 96, 96).astype(np.float32)))
+    assert emb.shape == (3, 64)
+    np.testing.assert_allclose(np.linalg.norm(emb, axis=-1), 1.0, rtol=1e-5)
