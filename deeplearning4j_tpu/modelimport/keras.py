@@ -352,6 +352,29 @@ class _SequentialImporter:
                 "epsilon", 1e-3)), decay=float(conf.get("momentum", 0.99))),
             params, state)
 
+    def _import_Embedding(self, conf):
+        s = self.shape
+        if s.kind != "ff":
+            raise KerasImportError(
+                "Embedding expects [batch, time] integer input")
+        if conf.get("mask_zero", False):
+            # keras skips masked timesteps downstream; importing without
+            # the mask would silently change the numerics
+            raise KerasImportError(
+                "Embedding mask_zero=True unsupported (pass an explicit "
+                "mask to output()/fit() instead)")
+        from ..nn.layers import EmbeddingSequenceLayer
+
+        w = self._weights(conf)
+        self._add(EmbeddingSequenceLayer(
+            name=conf["name"], n_in=int(conf["input_dim"]),
+            n_out=int(conf["output_dim"])), {"W": w["embeddings"]})
+        # [batch, t] ids -> recurrent [batch, output_dim, t]
+        timesteps = s.n
+        s.kind = "rnn"
+        s.t = timesteps
+        s.f = int(conf["output_dim"])
+
     def _import_SeparableConv2D(self, conf):
         s = self.shape
         if s.kind != "conv":

@@ -10,6 +10,7 @@ from .tokenization import BasicTokenizer, BertWordPieceTokenizer, Vocabulary
 from .bert_iterator import BertIterator, BertTask
 from .glove import Glove
 from .paragraph_vectors import LabelledDocument, ParagraphVectors
+from .serializer import WordVectors, WordVectorSerializer
 from .word2vec import Word2Vec
 
 __all__ = [
@@ -21,5 +22,7 @@ __all__ = [
     "LabelledDocument",
     "ParagraphVectors",
     "Vocabulary",
+    "WordVectorSerializer",
+    "WordVectors",
     "Word2Vec",
 ]

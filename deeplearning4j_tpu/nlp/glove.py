@@ -24,8 +24,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .lookup import WordVectorLookup
 
-class Glove:
+
+class Glove(WordVectorLookup):
     def __init__(
         self,
         *,
@@ -161,22 +163,4 @@ class Glove:
         self.syn0 = np.asarray(w) + np.asarray(wc)
         return self
 
-    # ----- query API --------------------------------------------------
-
-    def has_word(self, word: str) -> bool:
-        return word in self.vocab_index
-
-    def get_word_vector(self, word: str) -> np.ndarray:
-        return self.syn0[self.vocab_index[word]]
-
-    def similarity(self, a: str, b: str) -> float:
-        va, vb = self.get_word_vector(a), self.get_word_vector(b)
-        denom = (np.linalg.norm(va) * np.linalg.norm(vb)) or 1e-10
-        return float(va @ vb / denom)
-
-    def words_nearest(self, word: str, n: int = 10) -> List[str]:
-        v = self.get_word_vector(word)
-        norms = np.linalg.norm(self.syn0, axis=1) * (np.linalg.norm(v) + 1e-10)
-        sims = self.syn0 @ v / np.maximum(norms, 1e-10)
-        order = np.argsort(-sims)
-        return [self.vocab[i] for i in order if self.vocab[i] != word][:n]
+    # query API comes from WordVectorLookup (nlp/lookup.py)

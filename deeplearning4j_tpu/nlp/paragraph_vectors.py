@@ -24,6 +24,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .lookup import cosine_similarity, nearest_rows
 from .word2vec import Word2Vec
 
 
@@ -197,22 +198,17 @@ class ParagraphVectors:
     lookup_vector = get_doc_vector
 
     def similarity(self, a: str, b: str) -> float:
-        va, vb = self.get_doc_vector(a), self.get_doc_vector(b)
-        denom = (np.linalg.norm(va) * np.linalg.norm(vb)) or 1e-10
-        return float(va @ vb / denom)
+        return cosine_similarity(self.get_doc_vector(a),
+                                 self.get_doc_vector(b))
 
     def nearest_labels(self, tokens_or_label, n: int = 5) -> List[str]:
         """Labels closest to a document (by label, or by raw tokens via
         infer_vector) — reference: nearestLabels."""
         if isinstance(tokens_or_label, str):
             v = self.get_doc_vector(tokens_or_label)
-            exclude = tokens_or_label
+            exclude = self.label_index[tokens_or_label]
         else:
             v = self.infer_vector(tokens_or_label)
             exclude = None
-        norms = (np.linalg.norm(self.doc_vectors, axis=1)
-                 * (np.linalg.norm(v) + 1e-10))
-        sims = self.doc_vectors @ v / np.maximum(norms, 1e-10)
-        order = np.argsort(-sims)
-        return [self.labels[i] for i in order
-                if self.labels[i] != exclude][:n]
+        rows = nearest_rows(self.doc_vectors, v, n, exclude=exclude)
+        return [self.labels[i] for i in rows]

@@ -225,3 +225,21 @@ def test_a3c_cartpole_improves():
         f"best episodes never took off: {np.sort(rewards)[-10:].mean():.1f}")
     policy = a3c.get_policy()
     assert policy.next_action(CartPole(seed=1).reset()) in (0, 1)
+
+
+def test_word_vector_serializer_roundtrip(tmp_path):
+    from deeplearning4j_tpu.nlp import Glove, WordVectorSerializer
+
+    sents, animals, tech = _corpus(100)
+    g = Glove(vector_size=8, window=3, min_count=1, epochs=3, seed=4)
+    g.fit(sents)
+    path = str(tmp_path / "vectors.txt")
+    WordVectorSerializer.write_word_vectors(g, path)
+    wv = WordVectorSerializer.read_word_vectors(path)
+    assert wv.vocab == g.vocab
+    np.testing.assert_allclose(wv.get_word_vector("cat"),
+                               g.get_word_vector("cat"), rtol=1e-4, atol=1e-5)
+    # query API carried over
+    assert wv.similarity("cat", "dog") == pytest.approx(
+        g.similarity("cat", "dog"), abs=1e-4)
+    assert len(wv.words_nearest("cat", 3)) == 3

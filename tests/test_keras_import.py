@@ -260,3 +260,20 @@ def test_functional_concatenate_height_axis_rejected(tmp_path):
     m.save(path)
     with pytest.raises(KerasImportError, match="Concatenate axis 1"):
         KerasModelImport.import_keras_model_and_weights(path)
+
+
+def test_embedding_lstm_import(tmp_path):
+    """Keras Embedding -> our EmbeddingSequenceLayer: int ids in, parity."""
+    m = keras.Sequential([
+        keras.layers.Input((6,)),
+        keras.layers.Embedding(50, 8),
+        keras.layers.LSTM(5),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    ids = np.random.RandomState(7).randint(0, 50, (4, 6))
+    path = str(tmp_path / "model.h5")
+    m.save(path)
+    expected = np.asarray(m(ids))
+    ours = KerasModelImport.import_keras_model_and_weights(path)
+    got = np.asarray(ours.output(ids.astype(np.int32)))
+    np.testing.assert_allclose(got, expected, atol=1e-4, rtol=1e-3)

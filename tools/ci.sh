@@ -35,7 +35,9 @@ if [ "$1" != "fast" ]; then
   fi
 
   echo "== benchmark artifact smoke (lstm row, cpu config)"
-  JAX_PLATFORMS=cpu python bench.py measure lstm cpu | tail -1
+  # no pipe: POSIX sh has no pipefail, and `| tail` would mask a crash
+  bench_out=$(JAX_PLATFORMS=cpu python bench.py measure lstm cpu)
+  echo "$bench_out" | tail -1
 fi
 
 echo "CI: all green"
