@@ -16,10 +16,18 @@ benching can produce NO output at all. This driver therefore:
      ``diagnostics`` field.
 
 Headline metric: ResNet-50 synthetic-ImageNet train samples/sec/chip
-(ComputationGraph path — BASELINE.md row 1). Extra rows: BERT-style encoder
-tokens/sec, LeNet-MNIST smoke. ``vs_baseline`` divides device throughput by
-the same config's host-CPU throughput measured in this run (the reference's
-designated baseline config is CPU; no published numbers exist — BASELINE.md).
+(ComputationGraph path — BASELINE.md row 1). Extra rows: native BERT
+encoder tokens/sec, TF-imported BERT-base tokens/sec (the BASELINE.json:10
+metric), GravesLSTM char-RNN chars/sec, LeNet-MNIST smoke, a matmul
+calibration row (measured peak + block-vs-fence timer check), the input
+pipeline images/sec vs the device step rate, and a ResNet batch-128
+scaling probe. All timed regions end with a host fetch of a
+result-dependent scalar (``_host_fence``) — block_until_ready does not
+reliably wait under axon. ``vs_baseline`` divides device throughput by
+host-CPU throughput measured in this run (the reference's designated
+baseline config is CPU; no published numbers exist — BASELINE.md), with
+``baseline_config`` recording what was compared and null when no valid
+baseline ran.
 """
 
 import json

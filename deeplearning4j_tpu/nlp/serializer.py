@@ -33,6 +33,13 @@ class WordVectorSerializer:
     @staticmethod
     def write_word_vectors(model, path: str) -> None:
         vocab, syn0 = model.vocab, np.asarray(model.syn0, np.float32)
+        for w in vocab:
+            if any(ch.isspace() for ch in w):
+                # space/newline in a token breaks the space-delimited wire
+                # format at READ time; fail at write, while the model exists
+                raise ValueError(
+                    f"token {w!r} contains whitespace — the word2vec-c text "
+                    "format cannot represent it (join phrases with '_')")
         with open(path, "w", encoding="utf-8") as f:
             f.write(f"{len(vocab)} {syn0.shape[1]}\n")
             for w, row in zip(vocab, syn0):

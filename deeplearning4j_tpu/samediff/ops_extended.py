@@ -603,3 +603,173 @@ def _assert_equals(a, b):
     """Value-level equality checked via checkify-style select: returns a
     which equals b; under jit the check is best-effort (NaN poison)."""
     return jnp.where(jnp.all(a == b), a, jnp.full_like(a, jnp.nan))
+
+
+# ---- tranche 2: image/sequence/norm utilities ------------------------------
+sd_op("polygamma")(lambda n, x: jax.scipy.special.polygamma(n.astype(jnp.int32), x))
+sd_op("zeta")(jax.scipy.special.zeta)
+sd_op("log_matrix_determinant")(lambda x: jnp.linalg.slogdet(x)[1])
+
+
+@sd_op("sequence_mask")
+def _sequence_mask(lengths, maxlen=None, dtype=jnp.float32):
+    """[b] lengths -> [b, maxlen] 1/0 mask (reference: sequence_mask)."""
+    m = int(maxlen) if maxlen is not None else None
+    if m is None:
+        raise ValueError("sequence_mask needs static maxlen (XLA shapes)")
+    return (jnp.arange(m)[None, :] < lengths[:, None]).astype(dtype)
+
+
+@sd_op("extract_image_patches")
+def _extract_image_patches(x, ksizes=(3, 3), strides=(1, 1), rates=(1, 1),
+                           padding="VALID"):
+    """NHWC patch extraction (reference: extract_image_patches). Output
+    [n, oh, ow, kh*kw*c] with TF's channel-fastest patch layout."""
+    n, h, w, c = x.shape
+    kh, kw = int(ksizes[0]), int(ksizes[1])
+    patches = lax.conv_general_dilated_patches(
+        jnp.moveaxis(x, 3, 1), (kh, kw),
+        tuple(int(s) for s in strides), str(padding).upper(),
+        rhs_dilation=tuple(int(r) for r in rates),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))  # [n, c*kh*kw, oh, ow]
+    _, f, oh, ow = patches.shape
+    # conv patches come channel-major [c, kh, kw]; TF wants [kh, kw, c]
+    patches = patches.reshape(n, c, kh * kw, oh, ow).transpose(0, 3, 4, 2, 1)
+    return patches.reshape(n, oh, ow, kh * kw * c)
+
+
+@sd_op("crop_and_resize")
+def _crop_and_resize(image, boxes, box_indices, crop_size=(14, 14),
+                     extrapolation_value=0.0):
+    """NHWC crop-and-resize with normalized boxes [y1, x1, y2, x2]
+    (reference: CropAndResize). TF semantics: a crop dimension of 1
+    samples the box CENTER, and sample points outside the image take
+    ``extrapolation_value``. Static crop_size; bilinear."""
+    ch, cw = int(crop_size[0]), int(crop_size[1])
+    n, h, w, c = image.shape
+
+    def sample_coords(lo, hi, count, extent):
+        if count > 1:
+            return (lo * (extent - 1)
+                    + jnp.arange(count) * (hi - lo) * (extent - 1)
+                    / (count - 1))
+        return jnp.asarray([0.5 * (lo + hi) * (extent - 1)])
+
+    def one(box, idx):
+        y1, x1, y2, x2 = box[0], box[1], box[2], box[3]
+        ys = sample_coords(y1, y2, ch, h)
+        xs = sample_coords(x1, x2, cw, w)
+        in_y = (ys >= 0) & (ys <= h - 1)
+        in_x = (xs >= 0) & (xs <= w - 1)
+        img = image[idx]
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        tl = img[y0][:, x0]
+        tr = img[y0][:, x1i]
+        bl = img[y1i][:, x0]
+        br = img[y1i][:, x1i]
+        top = tl * (1 - wx) + tr * wx
+        bot = bl * (1 - wx) + br * wx
+        out = top * (1 - wy) + bot * wy
+        inside = (in_y[:, None] & in_x[None, :])[..., None]
+        return jnp.where(inside, out, extrapolation_value)
+
+    return jax.vmap(one)(boxes, box_indices.astype(jnp.int32))
+
+
+@sd_op("non_max_suppression_padded")
+def _nms_padded(boxes, scores, max_output_size=10, iou_threshold=0.5):
+    """Greedy NMS with a STATIC output count (XLA-honest form of the
+    reference's non_max_suppression): returns (indices [k], valid [k])."""
+    k = int(max_output_size)
+    n = boxes.shape[0]
+    y1, x1, y2, x2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(y2 - y1, 0) * jnp.maximum(x2 - x1, 0)
+
+    def iou(i, j):
+        yy1 = jnp.maximum(y1[i], y1[j])
+        xx1 = jnp.maximum(x1[i], x1[j])
+        yy2 = jnp.minimum(y2[i], y2[j])
+        xx2 = jnp.minimum(x2[i], x2[j])
+        inter = jnp.maximum(yy2 - yy1, 0) * jnp.maximum(xx2 - xx1, 0)
+        return inter / jnp.maximum(area[i] + area[j] - inter, 1e-9)
+
+    def body(alive, _):
+        masked = jnp.where(alive, scores, -jnp.inf)
+        best = jnp.argmax(masked)
+        valid = masked[best] > -jnp.inf
+        ious = jax.vmap(lambda j: iou(best, j))(jnp.arange(n))
+        alive = alive & (ious <= iou_threshold)
+        alive = alive.at[best].set(False)
+        return alive, (best, valid)
+
+    _, (idx, valid) = lax.scan(body, jnp.ones(n, bool), None, length=k)
+    return idx, valid
+
+
+@sd_op("instance_norm")
+def _instance_norm(x, gamma=None, beta=None, eps=1e-5):
+    """NCHW instance norm (reference: instance_norm custom op)."""
+    mean = jnp.mean(x, axis=(2, 3), keepdims=True)
+    var = jnp.var(x, axis=(2, 3), keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps)
+    if gamma is not None:
+        y = y * gamma[None, :, None, None]
+    if beta is not None:
+        y = y + beta[None, :, None, None]
+    return y
+
+
+@sd_op("group_norm")
+def _group_norm(x, gamma=None, beta=None, groups=2, eps=1e-5):
+    """NCHW group norm."""
+    n, c, h, w = x.shape
+    g = int(groups)
+    xg = x.reshape(n, g, c // g, h, w)
+    mean = jnp.mean(xg, axis=(2, 3, 4), keepdims=True)
+    var = jnp.var(xg, axis=(2, 3, 4), keepdims=True)
+    y = ((xg - mean) / jnp.sqrt(var + eps)).reshape(n, c, h, w)
+    if gamma is not None:
+        y = y * gamma[None, :, None, None]
+    if beta is not None:
+        y = y + beta[None, :, None, None]
+    return y
+
+
+@sd_op("alpha_dropout")
+def _alpha_dropout(x, rate=0.5, rng=None, deterministic=True):
+    """SELU-preserving dropout (reference: AlphaDropout)."""
+    if deterministic or rng is None or rate <= 0.0:
+        return x
+    alpha_p = -1.7580993408473766
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha_p * (1 - keep)
+    return a * jnp.where(mask, x, alpha_p) + b
+
+
+sd_op("embedding_lookup")(
+    lambda params, ids: jnp.take(params, ids.astype(jnp.int32), axis=0))
+sd_op("matrix_diag")(lambda d: jnp.zeros(
+    d.shape + (d.shape[-1],), d.dtype).at[
+        ..., jnp.arange(d.shape[-1]), jnp.arange(d.shape[-1])].set(d))
+sd_op("reverse")(lambda x, axis=None: jnp.flip(
+    x, None if axis is None else tuple(int(a) for a in np.atleast_1d(axis))))
+sd_op("swapaxes")(lambda x, a=0, b=1: jnp.swapaxes(x, int(a), int(b)))
+sd_op("moveaxis")(lambda x, src=0, dst=1: jnp.moveaxis(x, int(src), int(dst)))
+sd_op("atleast_2d")(jnp.atleast_2d)
+sd_op("squeeze_all")(lambda x: jnp.squeeze(x))
+sd_op("full_like")(lambda x, value=0.0: jnp.full_like(x, value))
+sd_op("digitize")(lambda x, bins: jnp.digitize(x, bins))
+sd_op("searchsorted")(lambda a, v, side="left": jnp.searchsorted(a, v, side=side))
+sd_op("interp")(lambda x, xp, fp: jnp.interp(x, xp, fp))
+sd_op("unravel_index")(lambda idx, shape=None: jnp.stack(
+    jnp.unravel_index(idx, tuple(int(s) for s in shape)), axis=-1))
+sd_op("ravel_multi_index")(lambda idx, shape=None: jnp.ravel_multi_index(
+    tuple(idx[..., i] for i in range(idx.shape[-1])),
+    tuple(int(s) for s in shape)))

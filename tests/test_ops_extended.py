@@ -226,3 +226,91 @@ def test_cells_and_misc_shapes():
     assert lrn.shape == (1, 2, 2, 8)
     up = op("upsampling2d", rng.rand(1, 2, 3, 3).astype(np.float32), scale=2)
     assert up.shape == (1, 2, 6, 6)
+
+
+# ---------------------------------------------------------------------------
+# tranche 2
+# ---------------------------------------------------------------------------
+
+def test_sequence_mask():
+    got = op("sequence_mask", np.asarray([1, 3, 0]), maxlen=4)
+    np.testing.assert_array_equal(
+        got, [[1, 0, 0, 0], [1, 1, 1, 0], [0, 0, 0, 0]])
+
+
+def test_extract_image_patches_vs_tf():
+    import tensorflow as tf
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(1, 6, 6, 2).astype(np.float32)
+    got = op("extract_image_patches", x, ksizes=(3, 3), strides=(2, 2))
+    expect = tf.image.extract_patches(
+        x, [1, 3, 3, 1], [1, 2, 2, 1], [1, 1, 1, 1], "VALID").numpy()
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+
+def test_crop_and_resize_vs_tf():
+    import tensorflow as tf
+
+    rng = np.random.RandomState(1)
+    img = rng.rand(2, 10, 10, 3).astype(np.float32)
+    boxes = np.asarray([[0.1, 0.1, 0.8, 0.9], [0.0, 0.0, 1.0, 1.0]], np.float32)
+    idx = np.asarray([0, 1], np.int32)
+    got = op("crop_and_resize", img, boxes, idx, crop_size=(5, 5))
+    expect = tf.image.crop_and_resize(img, boxes, idx, [5, 5]).numpy()
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_nms_padded():
+    boxes = np.asarray([
+        [0, 0, 1, 1], [0, 0, 1.05, 1.05], [2, 2, 3, 3], [0, 0, 0.5, 0.5],
+    ], np.float32)
+    scores = np.asarray([0.9, 0.8, 0.7, 0.6], np.float32)
+    idx, valid = get_sd_op("non_max_suppression_padded")(
+        jnp.asarray(boxes), jnp.asarray(scores), max_output_size=3,
+        iou_threshold=0.5)
+    kept = [int(i) for i, v in zip(np.asarray(idx), np.asarray(valid)) if v]
+    assert kept[0] == 0          # highest score survives
+    assert 1 not in kept         # suppressed by IoU with box 0
+    assert 2 in kept             # disjoint box survives
+
+
+def test_norm_variants():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 4, 5, 5).astype(np.float32)
+    inn = op("instance_norm", x)
+    np.testing.assert_allclose(inn.mean(axis=(2, 3)), 0.0, atol=1e-5)
+    np.testing.assert_allclose(inn.std(axis=(2, 3)), 1.0, atol=1e-3)
+    gn = op("group_norm", x, groups=2)
+    g = gn.reshape(2, 2, 2, 5, 5)
+    np.testing.assert_allclose(g.mean(axis=(2, 3, 4)), 0.0, atol=1e-5)
+
+
+def test_embedding_and_index_utils():
+    table = np.arange(12, dtype=np.float32).reshape(4, 3)
+    got = op("embedding_lookup", table, np.asarray([2, 0]))
+    np.testing.assert_array_equal(got, table[[2, 0]])
+    d = op("matrix_diag", np.asarray([1.0, 2.0, 3.0]))
+    np.testing.assert_array_equal(d, np.diag([1.0, 2.0, 3.0]))
+    got2 = op("interp", np.asarray([0.5]), np.asarray([0.0, 1.0]),
+              np.asarray([10.0, 20.0]))
+    np.testing.assert_allclose(got2, [15.0])
+
+
+def test_crop_and_resize_tf_edge_semantics():
+    """TF parity for the edge cases: out-of-image boxes extrapolate to 0,
+    crop dim 1 samples the box center."""
+    import tensorflow as tf
+
+    rng = np.random.RandomState(3)
+    img = rng.rand(1, 10, 10, 2).astype(np.float32)
+    boxes = np.asarray([[-0.2, -0.2, 1.2, 1.2]], np.float32)
+    idx = np.asarray([0], np.int32)
+    got = op("crop_and_resize", img, boxes, idx, crop_size=(4, 4))
+    expect = tf.image.crop_and_resize(img, boxes, idx, [4, 4]).numpy()
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+    boxes2 = np.asarray([[0.2, 0.2, 0.8, 0.8]], np.float32)
+    got2 = op("crop_and_resize", img, boxes2, idx, crop_size=(1, 1))
+    expect2 = tf.image.crop_and_resize(img, boxes2, idx, [1, 1]).numpy()
+    np.testing.assert_allclose(got2, expect2, rtol=1e-4, atol=1e-5)
