@@ -14,6 +14,8 @@ from .schedules import (
     SigmoidSchedule,
     StepSchedule,
 )
+from .fault_tolerance import (HeartbeatListener, Watchdog,
+                              elastic_fit, read_heartbeat)
 from .solver import Solver
 from .updaters import (
     AMSGrad,

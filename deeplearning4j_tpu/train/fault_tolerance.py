@@ -1,0 +1,166 @@
+"""Failure detection + elastic restart.
+
+Reference: SURVEY.md §5.3 — the reference's story is worker-failure
+handling in SharedTrainingMaster plus checkpoint restart (thin, by its own
+admission). Here the subsystem is first-class because this environment's
+accelerator has a DOCUMENTED failure mode the reference never faces: the
+axon PJRT device can wedge mid-session, hanging device dispatches instead
+of raising (TPU_ATTEMPTS.jsonl records hours of it). A hung dispatch cannot
+be recovered in-process — the PJRT client is poisoned — so recovery means
+process supervision:
+
+* ``HeartbeatListener`` — writes ``heartbeat.json`` (iteration/epoch/score/
+  timestamp) every iteration from inside fit(); the liveness signal.
+* ``Watchdog`` — a daemon thread that watches heartbeat age and calls
+  ``on_stall`` when training stops making progress (default: write a
+  ``stalled`` marker and hard-exit with STALL_EXIT_CODE so a supervisor
+  can restart — a wedged device never returns control to Python).
+* ``elastic_fit`` — the supervisor: runs a training entry point in a child
+  process, restarts it from the latest checkpoint on crash OR stall, up to
+  ``max_restarts`` times. The entry point is a ``"module:function"``
+  reference with signature ``fn(resume_path: Optional[str],
+  checkpoint_dir: str) -> None`` (spawn-safe: the child imports it fresh).
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ..core.listeners import TrainingListener
+
+STALL_EXIT_CODE = 86  # distinct from crash codes: "alive but not progressing"
+HEARTBEAT_FILE = "heartbeat.json"
+
+
+class HeartbeatListener(TrainingListener):
+    """Per-iteration liveness record (SURVEY §5.3 failure detection)."""
+
+    def __init__(self, directory: str) -> None:
+        self.path = os.path.join(directory, HEARTBEAT_FILE)
+        os.makedirs(directory, exist_ok=True)
+
+    def iteration_done(self, model, iteration: int, epoch: int,
+                       score: float) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"iteration": iteration, "epoch": epoch,
+                       "score": float(score), "ts": time.time()}, f)
+        os.replace(tmp, self.path)  # atomic: the watchdog never reads a torn file
+
+
+def read_heartbeat(directory: str) -> Optional[dict]:
+    path = os.path.join(directory, HEARTBEAT_FILE)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+class Watchdog:
+    """Stall detector: fires ``on_stall`` when the heartbeat stops aging
+    forward for ``timeout`` seconds. Default action writes a ``stalled``
+    marker and hard-exits — the only way out of a wedged device dispatch."""
+
+    def __init__(self, directory: str, timeout: float = 300.0,
+                 on_stall: Optional[Callable[[], None]] = None,
+                 poll_interval: float = 5.0) -> None:
+        self.directory = directory
+        self.timeout = float(timeout)
+        self.poll_interval = float(poll_interval)
+        self.on_stall = on_stall or self._default_stall
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._started_at = None
+
+    def _default_stall(self) -> None:
+        with open(os.path.join(self.directory, "stalled"), "w") as f:
+            f.write(f"no heartbeat progress for {self.timeout}s\n")
+        sys.stderr.write("Watchdog: training stalled — exiting for "
+                         "supervisor restart\n")
+        sys.stderr.flush()
+        os._exit(STALL_EXIT_CODE)  # noqa: SLF001 — a hung dispatch blocks clean exit
+
+    def start(self) -> "Watchdog":
+        self._started_at = time.time()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            hb = read_heartbeat(self.directory)
+            # never trust a heartbeat older than our own start: a restarted
+            # child inherits the previous run's stale file and must get the
+            # full grace period to restore + compile before its first beat
+            last = max(hb["ts"], self._started_at) if hb else self._started_at
+            if time.time() - last > self.timeout:
+                self.on_stall()
+                return
+
+
+def _resolve(ref: str) -> Callable:
+    mod, _, fn = ref.partition(":")
+    return getattr(importlib.import_module(mod), fn)
+
+
+def _child_main() -> None:
+    ref, checkpoint_dir = sys.argv[2], sys.argv[3]
+    timeout = float(sys.argv[4])
+    from .checkpoint import CheckpointListener
+
+    resume = CheckpointListener.last_checkpoint(checkpoint_dir)
+    Watchdog(checkpoint_dir, timeout=timeout).start()
+    _resolve(ref)(resume, checkpoint_dir)
+
+
+def elastic_fit(entry_ref: str, checkpoint_dir: str, *,
+                max_restarts: int = 3, stall_timeout: float = 300.0,
+                env: Optional[dict] = None,
+                log_fn: Callable[[str], None] = print) -> dict:
+    """Supervised training: run ``entry_ref`` ("module:function") in a child
+    process; restart from the latest checkpoint on crash or stall.
+
+    Returns {"restarts": n, "events": [...], "ok": bool}. The entry function
+    must attach CheckpointListener(checkpoint_dir, ...) and
+    HeartbeatListener(checkpoint_dir) itself — it owns the model and data.
+    """
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    events: List[dict] = []
+    restarts = 0
+    while True:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "from deeplearning4j_tpu.train.fault_tolerance import "
+             "_child_main; _child_main()",
+             "child", entry_ref, checkpoint_dir, str(stall_timeout)],
+            env={**os.environ, **(env or {})},
+        )
+        if proc.returncode == 0:
+            events.append({"event": "completed", "restarts": restarts})
+            return {"ok": True, "restarts": restarts, "events": events}
+        kind = "stall" if proc.returncode == STALL_EXIT_CODE else "crash"
+        hb = read_heartbeat(checkpoint_dir)
+        events.append({"event": kind, "rc": proc.returncode,
+                       "last_heartbeat": hb})
+        log_fn(f"elastic_fit: child {kind} (rc={proc.returncode}), "
+               f"last iteration "
+               f"{hb['iteration'] if hb else 'none'}")
+        if restarts >= max_restarts:
+            events.append({"event": "gave_up", "restarts": restarts})
+            return {"ok": False, "restarts": restarts, "events": events}
+        restarts += 1
+
+
+if __name__ == "__main__" and len(sys.argv) > 1 and sys.argv[1] == "child":
+    _child_main()

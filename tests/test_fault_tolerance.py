@@ -1,0 +1,147 @@
+"""Failure detection + elastic restart (SURVEY.md §5.3): the supervisor
+must resume training from the latest checkpoint after a crash, and the
+watchdog must detect a stalled (wedged-device-shaped) child."""
+
+import os
+import textwrap
+import time
+
+import pytest
+
+from deeplearning4j_tpu.train.fault_tolerance import (
+    HeartbeatListener,
+    Watchdog,
+    elastic_fit,
+    read_heartbeat,
+)
+
+
+def test_heartbeat_listener_writes_progress(tmp_path):
+    hb = HeartbeatListener(str(tmp_path))
+
+    class FakeModel:
+        pass
+
+    hb.iteration_done(FakeModel(), 7, 1, 0.5)
+    got = read_heartbeat(str(tmp_path))
+    assert got["iteration"] == 7 and got["epoch"] == 1
+    assert got["score"] == pytest.approx(0.5)
+
+
+def test_watchdog_fires_on_stall(tmp_path):
+    fired = []
+    wd = Watchdog(str(tmp_path), timeout=0.3, poll_interval=0.05,
+                  on_stall=lambda: fired.append(True))
+    wd.start()
+    time.sleep(1.0)
+    wd.stop()
+    assert fired  # no heartbeat ever arrived -> stall
+
+
+def test_watchdog_quiet_while_progressing(tmp_path):
+    fired = []
+    wd = Watchdog(str(tmp_path), timeout=0.5, poll_interval=0.05,
+                  on_stall=lambda: fired.append(True))
+    hb = HeartbeatListener(str(tmp_path))
+    wd.start()
+    for i in range(6):
+        hb.iteration_done(None, i, 0, 0.1)
+        time.sleep(0.15)
+    wd.stop()
+    time.sleep(0.2)
+    assert not fired
+
+
+_ENTRY = textwrap.dedent('''
+    """Elastic-fit test target: crashes mid-training on the first run
+    (marker file absent), completes on the resume run."""
+    import os
+
+    import numpy as np
+
+
+    def train(resume_path, checkpoint_dir):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+        from deeplearning4j_tpu.model.serializer import restore_model
+        from deeplearning4j_tpu.nn import (
+            Activation, InputType, LossFunction, NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.sequential import MultiLayerNetwork
+        from deeplearning4j_tpu.train.checkpoint import CheckpointListener
+        from deeplearning4j_tpu.train.fault_tolerance import HeartbeatListener
+        from deeplearning4j_tpu.train.updaters import Sgd
+
+        if resume_path:
+            model = restore_model(resume_path, load_updater=True)
+        else:
+            conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1))
+                    .list()
+                    .layer(DenseLayer(n_out=8, activation=Activation.TANH))
+                    .layer(OutputLayer(n_out=2, loss=LossFunction.MCXENT,
+                                       activation=Activation.SOFTMAX))
+                    .set_input_type(InputType.feed_forward(4)).build())
+            model = MultiLayerNetwork(conf).init()
+        model.add_listeners(
+            CheckpointListener(checkpoint_dir, save_every_n_iterations=5),
+            HeartbeatListener(checkpoint_dir))
+
+        rng = np.random.RandomState(0)
+        x = rng.rand(8, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 8)]
+        crash_marker = os.path.join(checkpoint_dir, "crashed_once")
+        target_iters = 30
+        while model.iteration_count < target_iters:
+            model.fit(x, y, epochs=1)
+            if model.iteration_count >= 12 and not os.path.exists(crash_marker):
+                open(crash_marker, "w").write("boom")
+                os._exit(1)  # simulated worker death mid-training
+''')
+
+
+def test_elastic_fit_resumes_after_crash(tmp_path):
+    target = tmp_path / "elastic_target.py"
+    target.write_text(_ENTRY)
+    ckpt = str(tmp_path / "ckpt")
+    result = elastic_fit(
+        "elastic_target:train", ckpt, max_restarts=2, stall_timeout=120.0,
+        env={"PYTHONPATH": str(tmp_path) + os.pathsep
+             + os.environ.get("PYTHONPATH", ""),
+             "JAX_PLATFORMS": "cpu"},
+        log_fn=lambda m: None)
+    assert result["ok"], result
+    assert result["restarts"] == 1  # crashed once, resumed, completed
+    kinds = [e["event"] for e in result["events"]]
+    assert kinds == ["crash", "completed"]
+    # the resumed run really continued past the crash point
+    hb = read_heartbeat(ckpt)
+    assert hb["iteration"] >= 30
+    # and it resumed FROM the checkpoint (crash at >=12, checkpoints every 5)
+    assert result["events"][0]["last_heartbeat"]["iteration"] >= 10
+
+
+def test_watchdog_ignores_stale_heartbeat_on_restart(tmp_path):
+    """Regression: a restarted child inherits the previous run's old
+    heartbeat file — it must still get the full grace period."""
+    hb = HeartbeatListener(str(tmp_path))
+    hb.iteration_done(None, 5, 0, 0.1)
+    # age the heartbeat far past the timeout
+    path = os.path.join(str(tmp_path), "heartbeat.json")
+    import json as _json
+    with open(path) as f:
+        data = _json.load(f)
+    data["ts"] -= 100.0
+    with open(path, "w") as f:
+        _json.dump(data, f)
+
+    fired = []
+    wd = Watchdog(str(tmp_path), timeout=0.6, poll_interval=0.05,
+                  on_stall=lambda: fired.append(True))
+    wd.start()
+    time.sleep(0.3)
+    assert not fired  # grace period counted from start(), not the stale ts
+    time.sleep(0.6)
+    wd.stop()
+    assert fired  # and it still fires once the REAL grace period lapses
