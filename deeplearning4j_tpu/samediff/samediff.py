@@ -363,6 +363,45 @@ class SameDiff:
     def set_loss_variables(self, *names: str) -> None:
         self._loss_name = names[0] if names else None
 
+    def convert_to_variables(self, names: Optional[Sequence[str]] = None,
+                             min_size: int = 2) -> List[str]:
+        """Make constants trainable (reference: convertToVariable(s) — the
+        import-then-finetune step: frozen-graph weights arrive as constants
+        and must become variables before ``fit`` will update them).
+
+        With ``names`` None, every float constant with at least ``min_size``
+        elements converts (weights), leaving scalars and small shape-like
+        constants frozen. Returns the converted names.
+        """
+        converted: List[str] = []
+        if names is not None:
+            targets = [self._nodes[self._names[n]] for n in names]
+            # validate BEFORE mutating anything: a mid-loop raise would
+            # leave the graph half-converted. Already-variable names are
+            # idempotent no-ops (matching the reference's convertToVariable).
+            for node in targets:
+                if node.kind not in ("constant", "variable"):
+                    raise ValueError(
+                        f"{node.name!r} is {node.kind}, not constant")
+            targets = [n for n in targets if n.kind == "constant"]
+        else:
+            targets = [n for n in self._nodes.values() if n.kind == "constant"]
+        for node in targets:
+            value = self._values.get(node.id)
+            if names is None:
+                if value is None or value.size < min_size or \
+                        not jnp.issubdtype(jnp.asarray(value).dtype, jnp.floating):
+                    continue
+            node.kind = "variable"
+            converted.append(node.name)
+        if converted:
+            # a cached TrainingSession snapshotted var_ids before the
+            # conversion — it would silently keep the new variables frozen
+            self._training = None
+        return converted
+
+    convertToVariables = convert_to_variables
+
     # ------------------------------------------------------------ execution
     def _eval_graph(
         self,

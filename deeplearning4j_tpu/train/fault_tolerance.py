@@ -160,7 +160,3 @@ def elastic_fit(entry_ref: str, checkpoint_dir: str, *,
             events.append({"event": "gave_up", "restarts": restarts})
             return {"ok": False, "restarts": restarts, "events": events}
         restarts += 1
-
-
-if __name__ == "__main__" and len(sys.argv) > 1 and sys.argv[1] == "child":
-    _child_main()
