@@ -155,7 +155,20 @@ def _cumsum(x, axis=0, exclusive=False, reverse=False):
     return out
 
 
-sd_op("cumprod")(lambda x, axis=0: jnp.cumprod(x, axis=int(axis)))
+@sd_op("cumprod")
+def _cumprod(x, axis=0, exclusive=False, reverse=False):
+    axis = int(axis)
+    if reverse:
+        x = jnp.flip(x, axis)
+    if exclusive:  # prod of strict predecessors: shift in a leading 1
+        ones = jnp.ones_like(lax.slice_in_dim(x, 0, 1, axis=axis))
+        x = jnp.concatenate(
+            [ones, lax.slice_in_dim(x, 0, x.shape[axis] - 1, axis=axis)],
+            axis=axis)
+    out = jnp.cumprod(x, axis=axis)
+    if reverse:
+        out = jnp.flip(out, axis)
+    return out
 
 
 # ---- shape ops -------------------------------------------------------------
@@ -586,3 +599,4 @@ def _random_bernoulli(shape=None, p=0.5, rng=None):
 
 # the extended op families register themselves on import
 from . import ops_extended  # noqa: E402,F401  (SURVEY §2.1 op breadth)
+from . import ops_tranche3  # noqa: E402,F401  (SURVEY §2.1 op breadth)

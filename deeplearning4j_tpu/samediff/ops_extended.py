@@ -303,19 +303,34 @@ def _histogram_fixed_width(x, value_range=None, nbins=100):
 
 
 @sd_op("bincount")
-def _bincount(x, minlength=0, maxlength=None, weights=None):
+def _bincount(x, minlength=0, maxlength=None, weights=None,
+              binary_output=False):
     """XLA-honest bincount: output length must be static, so a positive
     ``minlength``/``maxlength`` is REQUIRED (values >= length are dropped,
     jnp semantics). The reference's grow-to-max(x)+1 behavior is a dynamic
-    shape and cannot compile."""
+    shape and cannot compile. ``binary_output`` gives 0/1 presence
+    indicators (TF DenseBincount semantics)."""
     length = int(maxlength if maxlength else minlength)
     if length <= 0:
         raise ValueError(
             "bincount needs minlength or maxlength > 0 (static output "
             "shape); values >= length are dropped")
-    return jnp.bincount(x.astype(jnp.int32).reshape(-1),
-                        weights=None if weights is None else weights.reshape(-1),
-                        length=length)
+    if x.ndim == 2:  # TF DenseBincount per-row semantics: [B, N] -> [B, size]
+        ids = x.astype(jnp.int32)
+        valid = (ids >= 0) & (ids < length)
+        w = jnp.where(valid, jnp.ones_like(ids, jnp.float32)
+                      if weights is None else weights, 0)
+        b = x.shape[0]
+        off = jnp.arange(b, dtype=jnp.int32)[:, None] * length
+        flat_ids = jnp.clip(ids, 0, length - 1) + off
+        counts = jnp.zeros(b * length, w.dtype).at[
+            flat_ids.reshape(-1)].add(w.reshape(-1)).reshape(b, length)
+    else:
+        counts = jnp.bincount(
+            x.astype(jnp.int32).reshape(-1),
+            weights=None if weights is None else weights.reshape(-1),
+            length=length)
+    return jnp.minimum(counts, 1) if binary_output else counts
 
 
 # ---- conv/pool variants ----------------------------------------------------
@@ -329,11 +344,13 @@ def _conv1d(x, w, bias=None, stride=1, padding="SAME"):
 
 
 @sd_op("conv3d")
-def _conv3d(x, w, bias=None, strides=(1, 1, 1), padding="SAME"):
+def _conv3d(x, w, bias=None, strides=(1, 1, 1), padding="SAME",
+            dilations=(1, 1, 1)):
     """x [N, D, H, W, C], w [kD, kH, kW, C, out] (TF conv3d NDHWC)."""
     y = lax.conv_general_dilated(
         x, w, window_strides=tuple(int(s) for s in strides),
         padding=str(padding).upper(),
+        rhs_dilation=tuple(int(d) for d in dilations),
         dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
     return y if bias is None else y + bias
 

@@ -135,14 +135,17 @@ _SIMPLE = {
     "Invert": "bitwise_not",
 }
 
+def _mk(sd_name):
+    """Rule factory for 1:1 maps: every TF input becomes a positional var."""
+    def rule(ctx: _NodeCtx) -> SDVariable:
+        return ctx.importer.sd._op(
+            sd_name, *(ctx.var(i) for i in range(len(ctx.inputs))),
+            name=ctx.name)
+
+    return rule
+
+
 for _tf_name, _sd_name in _SIMPLE.items():
-    def _mk(sd_name):
-        def rule(ctx: _NodeCtx) -> SDVariable:
-            return ctx.importer.sd._op(sd_name, *(ctx.var(i) for i in range(len(ctx.inputs))),
-                                       name=ctx.name)
-
-        return rule
-
     TF_OP_RULES[_tf_name] = _mk(_sd_name)
 
 
@@ -469,6 +472,408 @@ def _fused_bn(ctx):
         "batch_norm", ctx.var(0), ctx.var(3), ctx.var(4),
         ctx.var(1), ctx.var(2), name=ctx.name, eps=eps, axis=axis,
     )
+
+
+# ---- tranche-3 rule widening (SURVEY §2.2 TF import breadth) ---------------
+# Simple 1:1 maps onto ops_extended/ops_tranche3 registrations.
+_SIMPLE_T3 = {
+    "TruncateDiv": "truncatediv", "TruncateMod": "truncatemod",
+    "DivNoNan": "div_no_nan", "MulNoNan": "mul_no_nan",
+    "Xlogy": "xlogy", "Xdivy": "xdivy", "Atan2": "atan2",
+    "Lgamma": "lgamma", "Digamma": "digamma", "Erfinv": "erfinv",
+    "Ndtri": "ndtri", "BesselI0e": "bessel_i0e", "BesselI1e": "bessel_i1e",
+    "Rint": "rint", "Inv": "reciprocal", "IsFinite": "isfinite",
+    "Betainc": "betainc", "Igamma": "igamma", "Igammac": "igammac",
+    "Zeta": "zeta", "Polygamma": "polygamma",
+    "LeftShift": "left_shift", "RightShift": "right_shift",
+    "PopulationCount": "population_count",
+    "InvertPermutation": "invert_permutation",
+    "MatrixDeterminant": "matrix_determinant", "Cholesky": "cholesky",
+    "Diag": "tensor_diag", "DiagPart": "tensor_diag_part", "Cross": "cross",
+    "MatrixDiag": "matrix_diag", "MatrixDiagPart": "matrix_diag_part_v2",
+    "MatrixSetDiag": "matrix_set_diag",
+    "FFT": "fft", "IFFT": "ifft", "FFT2D": "fft2", "IFFT2D": "ifft2",
+    "Real": "real", "Imag": "imag", "Conj": "conj", "Angle": "angle",
+    "ComplexAbs": "abs", "Complex": "complex",
+    "ClipByValue": "clip_by_value",
+    "DepthwiseConv2dNative": None,  # attr rule below
+}
+for _tf_name, _sd_name in _SIMPLE_T3.items():
+    if _sd_name is None or _tf_name in TF_OP_RULES:
+        continue
+    TF_OP_RULES[_tf_name] = _mk(_sd_name)  # same factory as _SIMPLE
+
+
+def _register_multi_output(ctx, tup, arity):
+    """Expose getitems of a tuple-valued op as the node's :0..:n outputs."""
+    for i in range(1, arity):
+        out_i = ctx.importer.sd._op("getitem", tup, item=i)
+        ctx.importer._multi_outputs.setdefault(ctx.name, {})[i] = out_i
+    return ctx.importer.sd._op("getitem", tup, item=0, name=ctx.name)
+
+
+def _reject_adjoint(ctx):
+    if "adjoint" in ctx.attr and bool(ctx.attr["adjoint"].b):
+        raise NotImplementedError(
+            f"{ctx.op} node {ctx.name!r}: adjoint=True is not supported")
+
+
+@tf_rule("MatrixSolve")
+def _matrix_solve_rule(ctx):
+    _reject_adjoint(ctx)
+    return ctx.importer.sd._op("solve", ctx.var(0), ctx.var(1),
+                               name=ctx.name)
+
+
+@tf_rule("MatrixInverse")
+def _matrix_inverse_rule(ctx):
+    _reject_adjoint(ctx)
+    return ctx.importer.sd._op("matrix_inverse", ctx.var(0), name=ctx.name)
+
+
+@tf_rule("Qr")
+def _qr_rule(ctx):
+    tup = ctx.importer.sd._op("qr", ctx.var(0), name=ctx.name + "__tuple")
+    return _register_multi_output(ctx, tup, 2)
+
+
+@tf_rule("SelfAdjointEigV2")
+def _eigh_rule(ctx):
+    tup = ctx.importer.sd._op("self_adjoint_eig", ctx.var(0),
+                              name=ctx.name + "__tuple")
+    return _register_multi_output(ctx, tup, 2)
+
+
+@tf_rule("Svd")
+def _svd_rule(ctx):
+    # TF emits (s, u, v); jnp.linalg.svd returns (u, s, vh) — reorder and
+    # transpose vh so consumers of name:0/:1/:2 see TF's layout.
+    sd = ctx.importer.sd
+    tup = sd._op("svd", ctx.var(0), name=ctx.name + "__tuple")
+    u = sd._op("getitem", tup, item=0)
+    s = sd._op("getitem", tup, item=1, name=ctx.name)
+    vh = sd._op("getitem", tup, item=2)
+    v = sd._op("swapaxes", vh, a=-2, b=-1)
+    ctx.importer._multi_outputs.setdefault(ctx.name, {})[1] = u
+    ctx.importer._multi_outputs.setdefault(ctx.name, {})[2] = v
+    return s
+
+
+@tf_rule("DepthwiseConv2dNative")
+def _depthwise_conv(ctx):
+    strides = list(ctx.attr["strides"].list.i)
+    df = ctx.attr["data_format"].s.decode() if "data_format" in ctx.attr \
+        else "NHWC"
+    s = (strides[1], strides[2]) if df == "NHWC" else (strides[2], strides[3])
+    dil = (1, 1)
+    if "dilations" in ctx.attr:
+        d = list(ctx.attr["dilations"].list.i)
+        dil = (d[1], d[2]) if df == "NHWC" else (d[2], d[3])
+    return ctx.importer.sd._op(
+        "depthwise_conv2d", ctx.var(0), ctx.var(1), name=ctx.name,
+        strides=s, padding=ctx.attr["padding"].s.decode(), data_format=df,
+        dilations=dil)
+
+
+@tf_rule("Conv2DBackpropInput")
+def _conv2d_backprop_input(ctx):
+    # inputs: input_sizes (const), filter [kH, kW, inC, outC], grads.
+    # Mapped onto the exact VJP form so odd spatial sizes under SAME/stride>1
+    # (where plain conv_transpose is ambiguous) reconstruct correctly.
+    strides = list(ctx.attr["strides"].list.i)
+    df = ctx.attr["data_format"].s.decode() if "data_format" in ctx.attr \
+        else "NHWC"
+    s = (strides[1], strides[2]) if df == "NHWC" else (strides[2], strides[3])
+    dil = (1, 1)
+    if "dilations" in ctx.attr:
+        d = list(ctx.attr["dilations"].list.i)
+        if d:
+            dil = (d[1], d[2]) if df == "NHWC" else (d[2], d[3])
+    shape = [int(v) for v in ctx.const_value(0).reshape(-1)]
+    return ctx.importer.sd._op(
+        "conv2d_backprop_input", ctx.var(2), ctx.var(1), name=ctx.name,
+        input_shape=shape, strides=s,
+        padding=ctx.attr["padding"].s.decode(), data_format=df,
+        dilations=dil)
+
+
+def _reject_ncdhw(ctx):
+    if "data_format" in ctx.attr:
+        df = ctx.attr["data_format"].s.decode()
+        if df and df != "NDHWC":
+            raise NotImplementedError(
+                f"{ctx.op} node {ctx.name!r}: data_format={df} not "
+                "supported (NDHWC only)")
+
+
+@tf_rule("Conv3D")
+def _conv3d_rule(ctx):
+    _reject_ncdhw(ctx)
+    strides = list(ctx.attr["strides"].list.i)
+    dil = (1, 1, 1)
+    if "dilations" in ctx.attr:
+        d = list(ctx.attr["dilations"].list.i)
+        if d:
+            dil = tuple(d[1:4])
+    return ctx.importer.sd._op(
+        "conv3d", ctx.var(0), ctx.var(1), name=ctx.name,
+        strides=tuple(strides[1:4]), padding=ctx.attr["padding"].s.decode(),
+        dilations=dil)
+
+
+@tf_rule("MaxPool3D", "AvgPool3D")
+def _pool3d_rule(ctx):
+    _reject_ncdhw(ctx)
+    k = list(ctx.attr["ksize"].list.i)
+    s = list(ctx.attr["strides"].list.i)
+    op = "max_pool3d" if ctx.op == "MaxPool3D" else "avg_pool3d"
+    return ctx.importer.sd._op(
+        op, ctx.var(0), name=ctx.name, kernel=tuple(k[1:4]),
+        strides=tuple(s[1:4]), padding=ctx.attr["padding"].s.decode())
+
+
+@tf_rule("Dilation2D")
+def _dilation2d_rule(ctx):
+    s = list(ctx.attr["strides"].list.i)
+    r = list(ctx.attr["rates"].list.i)
+    return ctx.importer.sd._op(
+        "dilation2d", ctx.var(0), ctx.var(1), name=ctx.name,
+        strides=(s[1], s[2]), rates=(r[1], r[2]),
+        padding=ctx.attr["padding"].s.decode())
+
+
+@tf_rule("ResizeBilinear", "ResizeNearestNeighbor", "ResizeBicubic")
+def _resize_rule(ctx):
+    # Our resize ops implement the half-pixel convention only. The raw-op
+    # DEFAULT is half_pixel_centers=False (corner-origin, TF1): a missing
+    # attr means corner-origin, so require the attr present and True, and
+    # reject align_corners — loud failure beats silently shifted pixels.
+    if "align_corners" in ctx.attr and bool(ctx.attr["align_corners"].b):
+        raise NotImplementedError(
+            f"{ctx.op} node {ctx.name!r}: align_corners=True has no "
+            "half-pixel equivalent here")
+    if "half_pixel_centers" not in ctx.attr or \
+            not bool(ctx.attr["half_pixel_centers"].b):
+        raise NotImplementedError(
+            f"{ctx.op} node {ctx.name!r}: corner-origin sampling "
+            "(half_pixel_centers absent or False, the TF1 default) is not "
+            "supported — re-export with tf.image.resize (TF2 half-pixel)")
+    size = [int(v) for v in ctx.const_value(1).reshape(-1)]
+    op = {"ResizeBilinear": "resize_bilinear",
+          "ResizeNearestNeighbor": "resize_nearest",
+          "ResizeBicubic": "resize_bicubic"}[ctx.op]
+    return ctx.importer.sd._op(op, ctx.var(0), name=ctx.name, size=size)
+
+
+@tf_rule("SpaceToDepth", "DepthToSpace")
+def _space_depth_rule(ctx):
+    op = "space_to_depth" if ctx.op == "SpaceToDepth" else "depth_to_space"
+    df = ctx.attr["data_format"].s.decode() if "data_format" in ctx.attr \
+        else "NHWC"
+    return ctx.importer.sd._op(
+        op, ctx.var(0), name=ctx.name,
+        block_size=int(ctx.attr["block_size"].i), data_format=df)
+
+
+@tf_rule("SpaceToBatchND", "BatchToSpaceND")
+def _space_batch_nd_rule(ctx):
+    block = [int(v) for v in ctx.const_value(1).reshape(-1)]
+    pc = [list(int(x) for x in row) for row in
+          ctx.const_value(2).reshape(len(block), 2)]
+    if ctx.op == "SpaceToBatchND":
+        return ctx.importer.sd._op("space_to_batch", ctx.var(0),
+                                   name=ctx.name, block_shape=block,
+                                   paddings=pc)
+    return ctx.importer.sd._op("batch_to_space", ctx.var(0), name=ctx.name,
+                               block_shape=block, crops=pc)
+
+
+@tf_rule("SegmentSum", "SegmentMean", "SegmentMax", "SegmentMin",
+         "SegmentProd")
+def _segment_rule(ctx):
+    ids = ctx.const_value(1).reshape(-1)  # static import needs const ids
+    op = {"SegmentSum": "segment_sum", "SegmentMean": "segment_mean",
+          "SegmentMax": "segment_max", "SegmentMin": "segment_min",
+          "SegmentProd": "segment_prod"}[ctx.op]
+    return ctx.importer.sd._op(op, ctx.var(0), ctx.var(1), name=ctx.name,
+                               num_segments=int(ids.max()) + 1)
+
+
+@tf_rule("UnsortedSegmentSum", "UnsortedSegmentMean", "UnsortedSegmentMax",
+         "UnsortedSegmentMin", "UnsortedSegmentProd")
+def _unsorted_segment_rule(ctx):
+    n = int(ctx.const_value(2))
+    op = {"UnsortedSegmentSum": "unsorted_segment_sum",
+          "UnsortedSegmentMean": "unsorted_segment_mean",
+          "UnsortedSegmentMax": "unsorted_segment_max",
+          "UnsortedSegmentMin": "unsorted_segment_min",
+          "UnsortedSegmentProd": "unsorted_segment_prod"}[ctx.op]
+    return ctx.importer.sd._op(op, ctx.var(0), ctx.var(1), name=ctx.name,
+                               num_segments=n)
+
+
+@tf_rule("TopKV2")
+def _top_k_rule(ctx):
+    tup = ctx.importer.sd._op("top_k", ctx.var(0),
+                              name=ctx.name + "__tuple",
+                              k=int(ctx.const_value(1)))
+    return _register_multi_output(ctx, tup, 2)
+
+
+@tf_rule("MatrixDiagV2", "MatrixDiagV3")
+def _matrix_diag_v23(ctx):
+    # inputs: diagonal, k, num_rows, num_cols, padding_value. The static
+    # importer supports the main-diagonal square zero-padded case (tf.eye
+    # and friends); anything else is rejected loudly.
+    if int(ctx.const_value(1)) != 0:
+        raise NotImplementedError(f"{ctx.op}: only k=0 supported")
+    for i, what in ((2, "num_rows"), (3, "num_cols")):
+        if len(ctx.inputs) > i and int(ctx.const_value(i)) != -1:
+            raise NotImplementedError(
+                f"{ctx.op}: explicit {what} is not supported")
+    if len(ctx.inputs) > 4 and float(ctx.const_value(4)) != 0.0:
+        raise NotImplementedError(f"{ctx.op}: padding_value != 0")
+    return ctx.importer.sd._op("matrix_diag", ctx.var(0), name=ctx.name)
+
+
+@tf_rule("InTopKV2", "InTopK")
+def _in_top_k_rule(ctx):
+    if ctx.op == "InTopKV2":
+        k = int(ctx.const_value(2))
+    else:
+        k = int(ctx.attr["k"].i)
+    return ctx.importer.sd._op("in_top_k", ctx.var(0), ctx.var(1),
+                               name=ctx.name, k=k)
+
+
+@tf_rule("ScatterNd")
+def _scatter_nd_rule(ctx):
+    shape = [int(v) for v in ctx.const_value(2).reshape(-1)]
+    return ctx.importer.sd._op("scatter_nd", ctx.var(0), ctx.var(1),
+                               name=ctx.name, shape=shape)
+
+
+@tf_rule("TensorScatterAdd", "TensorScatterSub", "TensorScatterUpdate",
+         "TensorScatterMax", "TensorScatterMin")
+def _tensor_scatter_rule(ctx):
+    op = {"TensorScatterAdd": "scatter_nd_add",
+          "TensorScatterSub": "scatter_nd_sub",
+          "TensorScatterUpdate": "scatter_nd_update",
+          "TensorScatterMax": "tensor_scatter_max",
+          "TensorScatterMin": "tensor_scatter_min"}[ctx.op]
+    return ctx.importer.sd._op(op, ctx.var(0), ctx.var(1), ctx.var(2),
+                               name=ctx.name)
+
+
+@tf_rule("MatrixBandPart")
+def _band_part_rule(ctx):
+    return ctx.importer.sd._op(
+        "matrix_band_part", ctx.var(0), name=ctx.name,
+        num_lower=int(ctx.const_value(1)), num_upper=int(ctx.const_value(2)))
+
+
+@tf_rule("MatrixTriangularSolve")
+def _tri_solve_rule(ctx):
+    _reject_adjoint(ctx)
+    lower = bool(ctx.attr["lower"].b) if "lower" in ctx.attr else True
+    return ctx.importer.sd._op("triangular_solve", ctx.var(0), ctx.var(1),
+                               name=ctx.name, lower=lower)
+
+
+@tf_rule("LRN")
+def _lrn_rule(ctx):
+    # TF: out = in / (bias + alpha * sqr_sum)^beta — alpha passes through
+    # unscaled (cuDNN-style alpha/n scaling is the CALLER's convention).
+    return ctx.importer.sd._op(
+        "local_response_normalization", ctx.var(0), name=ctx.name,
+        depth=2 * int(ctx.attr["depth_radius"].i) + 1
+        if "depth_radius" in ctx.attr else 11,  # TF default radius is 5
+        bias=float(ctx.attr["bias"].f) if "bias" in ctx.attr else 1.0,
+        alpha=float(ctx.attr["alpha"].f) if "alpha" in ctx.attr else 1.0,
+        beta=float(ctx.attr["beta"].f) if "beta" in ctx.attr else 0.5)
+
+
+@tf_rule("ReverseV2")
+def _reverse_rule(ctx):
+    axis = [int(v) for v in ctx.const_value(1).reshape(-1)]
+    return ctx.importer.sd._op("reverse", ctx.var(0), name=ctx.name,
+                               axis=axis)
+
+
+@tf_rule("ReverseSequence")
+def _reverse_seq_rule(ctx):
+    return ctx.importer.sd._op(
+        "reverse_sequence", ctx.var(0), ctx.var(1), name=ctx.name,
+        seq_axis=int(ctx.attr["seq_dim"].i),
+        batch_axis=int(ctx.attr["batch_dim"].i)
+        if "batch_dim" in ctx.attr else 0)
+
+
+@tf_rule("Roll")
+def _roll_rule(ctx):
+    shifts = [int(v) for v in np.atleast_1d(ctx.const_value(1))]
+    axes = [int(v) for v in np.atleast_1d(ctx.const_value(2))]
+    out = ctx.var(0)
+    sd = ctx.importer.sd
+    for i, (sh, ax) in enumerate(zip(shifts, axes)):
+        nm = ctx.name if i == len(shifts) - 1 else f"{ctx.name}__roll{i}"
+        out = sd._op("roll", out, name=nm, shift=sh, axis=ax)
+    return out
+
+
+@tf_rule("HistogramFixedWidth")
+def _hist_rule(ctx):
+    vr = [float(v) for v in ctx.const_value(1).reshape(-1)]
+    return ctx.importer.sd._op(
+        "histogram_fixed_width", ctx.var(0), name=ctx.name,
+        value_range=vr, nbins=int(ctx.const_value(2)))
+
+
+@tf_rule("CumulativeLogsumexp")
+def _cumlse_rule(ctx):
+    return ctx.importer.sd._op("cumlogsumexp", ctx.var(0), name=ctx.name,
+                               axis=int(ctx.const_value(1)))
+
+
+@tf_rule("Cumprod")
+def _cumprod_rule(ctx):
+    return ctx.importer.sd._op(
+        "cumprod", ctx.var(0), name=ctx.name, axis=int(ctx.const_value(1)),
+        exclusive=bool(ctx.attr["exclusive"].b)
+        if "exclusive" in ctx.attr else False,
+        reverse=bool(ctx.attr["reverse"].b)
+        if "reverse" in ctx.attr else False)
+
+
+@tf_rule("MatrixDiagPartV2", "MatrixDiagPartV3")
+def _matrix_diag_part_v23(ctx):
+    # inputs: input, k, padding_value — main-diagonal case only.
+    k = int(ctx.const_value(1))
+    if k != 0:
+        raise NotImplementedError(f"{ctx.op}: only k=0 supported")
+    return ctx.importer.sd._op("matrix_diag_part_v2", ctx.var(0),
+                               name=ctx.name)
+
+
+@tf_rule("Bincount", "DenseBincount")
+def _bincount_rule(ctx):
+    # inputs: arr, size (const), weights (an empty const when unweighted)
+    binary = "binary_output" in ctx.attr and bool(ctx.attr["binary_output"].b)
+    has_weights = True
+    try:
+        has_weights = ctx.const_value(2).size > 0
+    except ValueError:
+        pass  # non-const weights tensor: definitely present
+    if has_weights:
+        if binary:  # TF requires empty weights with binary_output
+            raise NotImplementedError(
+                f"{ctx.op} node {ctx.name!r}: binary_output with weights")
+        return ctx.importer.sd._op(
+            "bincount_weighted", ctx.var(0), ctx.var(2), name=ctx.name,
+            minlength=int(ctx.const_value(1)))
+    return ctx.importer.sd._op(
+        "bincount", ctx.var(0), name=ctx.name,
+        minlength=int(ctx.const_value(1)), binary_output=binary)
 
 
 class TFGraphMapper:

@@ -1,0 +1,540 @@
+"""Tranche-3 SameDiff ops vs independent references (numpy/torch/manual
+math) — one representative per family plus the tricky-semantics ops
+(dilation2d, im2col/col2im adjointness, dynamic_stitch, updaters, SSIM,
+CTC greedy decode, cyclic bit shifts)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.samediff.ops import SD_OPS, get_sd_op
+
+
+def op(name, *args, **kw):
+    out = get_sd_op(name)(*[jnp.asarray(a) if isinstance(a, np.ndarray) else a
+                            for a in args], **kw)
+    return np.asarray(out)
+
+
+def test_registry_breadth_tranche3():
+    assert len(SD_OPS) >= 490, f"op registry at {len(SD_OPS)}"
+
+
+def test_pairwise_long_tail():
+    a = np.asarray([3.0, -7.5, 2.0])
+    b = np.asarray([2.0, 2.0, -4.0])
+    np.testing.assert_allclose(op("rsub", a, b), b - a)
+    np.testing.assert_allclose(op("rdiv", a, b), b / a)
+    np.testing.assert_allclose(op("truncatediv", a, b), np.trunc(a / b))
+    np.testing.assert_allclose(op("truncatemod", a, b), np.fmod(a, b))
+    np.testing.assert_allclose(op("floormod", a, b), np.mod(a, b))
+    np.testing.assert_allclose(
+        op("div_no_nan", a, np.asarray([2.0, 0.0, 1.0])), [1.5, 0.0, 2.0])
+    np.testing.assert_allclose(op("axpy", a, b, alpha=2.0), 2 * a + b)
+    np.testing.assert_allclose(
+        op("relative_error", np.asarray([0.0, 1.0]), np.asarray([0.0, 3.0])),
+        [0.0, 0.5])
+
+
+def test_reduce3_distances():
+    x = np.random.RandomState(0).rand(4, 8)
+    y = np.random.RandomState(1).rand(4, 8)
+    np.testing.assert_allclose(
+        op("euclidean_distance", x, y, axis=1),
+        np.linalg.norm(x - y, axis=1), rtol=1e-6)
+    np.testing.assert_allclose(
+        op("manhattan_distance", x, y, axis=1),
+        np.abs(x - y).sum(axis=1), rtol=1e-6)
+    cs = (x * y).sum(1) / (np.linalg.norm(x, axis=1) * np.linalg.norm(y, axis=1))
+    np.testing.assert_allclose(op("cosine_similarity", x, y, axis=1), cs,
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        op("hamming_distance", np.asarray([1, 2, 3]), np.asarray([1, 9, 3])),
+        1.0)
+    jd = 1 - np.minimum(x, y).sum(1) / np.maximum(x, y).sum(1)
+    np.testing.assert_allclose(op("jaccard_distance", x, y, axis=1), jd,
+                               rtol=1e-5)
+
+
+def test_dot_product_attention_vs_manual():
+    rs = np.random.RandomState(2)
+    q, k, v = (rs.rand(2, 5, 4).astype(np.float32) for _ in range(3))
+    got = op("dot_product_attention", q, k, v)
+    logits = q @ k.transpose(0, 2, 1) / np.sqrt(4)
+    w = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, w @ v, rtol=1e-5, atol=1e-6)
+
+
+def test_merge_and_stitch():
+    xs = [np.asarray([1.0, 5.0]), np.asarray([4.0, 2.0]),
+          np.asarray([3.0, 3.0])]
+    np.testing.assert_allclose(op("mergeadd", *xs), [8.0, 10.0])
+    np.testing.assert_allclose(op("mergemax", *xs), [4.0, 5.0])
+    np.testing.assert_allclose(op("mergeavg", *xs), [8 / 3, 10 / 3])
+    np.testing.assert_allclose(op("mergemaxindex", *xs), [1, 0])
+    got = get_sd_op("dynamic_stitch")(
+        [jnp.asarray([0, 2]), jnp.asarray([1, 3])],
+        jnp.asarray([[10.0], [30.0]]), jnp.asarray([[20.0], [40.0]]))
+    np.testing.assert_allclose(np.asarray(got),
+                               [[10.0], [20.0], [30.0], [40.0]])
+
+
+def test_depthwise_and_separable_conv_vs_torch():
+    torch = pytest.importorskip("torch")
+    rs = np.random.RandomState(3)
+    x = rs.rand(2, 8, 8, 3).astype(np.float32)
+    wd = rs.rand(3, 3, 3, 2).astype(np.float32)  # kH kW C mult
+    got = op("depthwise_conv2d", x, wd, strides=(1, 1), padding="SAME")
+    tx = torch.tensor(x.transpose(0, 3, 1, 2))
+    # torch depthwise: weight [C*mult, 1, kH, kW], groups=C
+    tw = torch.tensor(wd.transpose(2, 3, 0, 1).reshape(6, 1, 3, 3))
+    ref = torch.nn.functional.conv2d(tx, tw, padding=1, groups=3)
+    np.testing.assert_allclose(got, ref.numpy().transpose(0, 2, 3, 1),
+                               rtol=1e-4, atol=1e-5)
+
+    wp = rs.rand(1, 1, 6, 4).astype(np.float32)
+    got_sep = op("separable_conv2d", x, wd, wp, padding="SAME")
+    ref_sep = torch.nn.functional.conv2d(
+        ref, torch.tensor(wp[0, 0].T[:, :, None, None]))
+    np.testing.assert_allclose(got_sep, ref_sep.numpy().transpose(0, 2, 3, 1),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_dilation2d_vs_manual():
+    rs = np.random.RandomState(4)
+    x = rs.rand(1, 5, 5, 1).astype(np.float32)
+    w = rs.rand(3, 3, 1).astype(np.float32)
+    got = op("dilation2d", x, w, strides=(1, 1), rates=(1, 1),
+             padding="VALID")
+    ref = np.zeros((1, 3, 3, 1), np.float32)
+    for i in range(3):
+        for j in range(3):
+            ref[0, i, j, 0] = np.max(x[0, i:i + 3, j:j + 3, 0] + w[:, :, 0])
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_im2col_col2im_adjoint():
+    """col2im is the adjoint of im2col: <im2col(x), c> == <x, col2im(c)>."""
+    rs = np.random.RandomState(5)
+    x = rs.rand(1, 2, 6, 6).astype(np.float32)
+    cols_shape = op("im2col", x, kernel=(3, 3), strides=(2, 2),
+                    padding="VALID").shape
+    c = rs.rand(*cols_shape).astype(np.float32)
+    lhs = float((op("im2col", x, kernel=(3, 3), strides=(2, 2),
+                    padding="VALID") * c).sum())
+    back = op("col2im", c, output_size=(6, 6), kernel=(3, 3), strides=(2, 2),
+              padding="VALID")
+    rhs = float((x * back).sum())
+    assert abs(lhs - rhs) < 1e-2 * max(abs(lhs), 1.0)
+
+
+def test_max_pool_with_argmax_and_unpool():
+    x = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+    pooled, arg = get_sd_op("max_pool_with_argmax")(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(pooled),
+                               [[[[5.0], [7.0]], [[13.0], [15.0]]]])
+    restored = op("max_unpooling2d", np.asarray(pooled), np.asarray(arg),
+                  input_shape=(1, 4, 4, 1))
+    assert restored[0, 1, 1, 0] == 5.0 and restored[0, 3, 3, 0] == 15.0
+    assert restored.sum() == 5.0 + 7.0 + 13.0 + 15.0
+
+
+def test_lstm_layer_matches_cell_loop():
+    rs = np.random.RandomState(6)
+    T, B, I, U = 5, 2, 3, 4
+    x = rs.rand(T, B, I).astype(np.float32)
+    W = rs.rand(I, 4 * U).astype(np.float32) * 0.3
+    R = rs.rand(U, 4 * U).astype(np.float32) * 0.3
+    h = np.zeros((B, U), np.float32)
+    c = np.zeros((B, U), np.float32)
+    cell = get_sd_op("lstm_cell")
+    hs_ref = []
+    hj, cj = jnp.asarray(h), jnp.asarray(c)
+    for t in range(T):
+        hj, cj = cell(jnp.asarray(x[t]), hj, cj, jnp.asarray(W),
+                      jnp.asarray(R))
+        hs_ref.append(np.asarray(hj))
+    hs, hT, cT = get_sd_op("lstm_layer")(
+        jnp.asarray(x), jnp.asarray(h), jnp.asarray(c), jnp.asarray(W),
+        jnp.asarray(R))
+    np.testing.assert_allclose(np.asarray(hs), np.stack(hs_ref), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(hT), hs_ref[-1], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_sru_and_gru_and_bidirectional_shapes():
+    rs = np.random.RandomState(7)
+    T, B, D = 6, 2, 4
+    x = rs.rand(T, B, D).astype(np.float32)
+    hs, cT = get_sd_op("sru")(
+        jnp.asarray(x), jnp.zeros((B, D)), jnp.asarray(rs.rand(D, 3 * D),),
+        jnp.asarray(rs.rand(2 * D)))
+    assert np.asarray(hs).shape == (T, B, D)
+    assert np.all(np.isfinite(np.asarray(hs)))
+    W = rs.rand(D, 3 * D).astype(np.float32)
+    R = rs.rand(D, 3 * D).astype(np.float32)
+    hs2, hT2 = get_sd_op("gru")(jnp.asarray(x), jnp.zeros((B, D)),
+                                jnp.asarray(W), jnp.asarray(R))
+    np.testing.assert_allclose(
+        np.asarray(hT2),
+        np.asarray(get_sd_op("gru_cell")(
+            jnp.asarray(x[-1]), jnp.asarray(np.asarray(hs2)[-2]),
+            jnp.asarray(W), jnp.asarray(R))), rtol=1e-5, atol=1e-6)
+    Wl = rs.rand(D, 4 * D).astype(np.float32)
+    Rl = rs.rand(D, 4 * D).astype(np.float32)
+    bi = get_sd_op("bidirectional_lstm")(
+        jnp.asarray(x), jnp.zeros((B, D)), jnp.zeros((B, D)),
+        jnp.zeros((B, D)), jnp.zeros((B, D)), jnp.asarray(Wl),
+        jnp.asarray(Rl), jnp.asarray(Wl), jnp.asarray(Rl))
+    assert np.asarray(bi).shape == (T, B, 2 * D)
+
+
+def test_fft_family():
+    rs = np.random.RandomState(8)
+    x = rs.rand(8).astype(np.float32)
+    np.testing.assert_allclose(op("fft", x), np.fft.fft(x), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.real(op("ifft", op("fft", x))), x,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(op("rfft", x), np.fft.rfft(x), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(op("irfft", np.fft.rfft(x)), x, rtol=1e-4,
+                               atol=1e-5)
+    c = np.fft.fft(x)
+    np.testing.assert_allclose(op("real", c), c.real, rtol=1e-6)
+    np.testing.assert_allclose(op("imag", c), c.imag, rtol=1e-6)
+    np.testing.assert_allclose(op("angle", c), np.angle(c), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(op("fftshift", x), np.fft.fftshift(x))
+
+
+def test_windows_and_stft():
+    for name, ref in [("hann_window", np.hanning),
+                      ("hamming_window", np.hamming),
+                      ("blackman_window", np.blackman),
+                      ("bartlett_window", np.bartlett)]:
+        np.testing.assert_allclose(op(name, 16), ref(16), atol=1e-5,
+                                   err_msg=name)
+    rs = np.random.RandomState(9)
+    sig = rs.rand(512).astype(np.float32)
+    s = op("stft", sig, frame_length=64, frame_step=32)
+    assert s.shape == (15, 33)
+    manual = np.fft.rfft(sig[:64] * np.hanning(64))
+    np.testing.assert_allclose(s[0], manual, rtol=1e-3, atol=1e-3)
+
+
+def test_bessel_and_special():
+    x = np.asarray([0.0, 0.5, 1.0, 2.0])
+    np.testing.assert_allclose(op("bessel_i0", x), np.i0(x), rtol=1e-5)
+    assert abs(op("bessel_i1", np.asarray([0.0]))[()]) < 1e-7
+    np.testing.assert_allclose(op("sinc", x), np.sinc(x), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(op("ndtr", np.asarray([0.0])), [0.5])
+    np.testing.assert_allclose(
+        op("ndtri", op("ndtr", np.asarray([0.7]))), [0.7], rtol=1e-4)
+
+
+def test_image_geometry():
+    rs = np.random.RandomState(10)
+    img = rs.rand(1, 6, 8, 3).astype(np.float32)
+    np.testing.assert_allclose(op("flip_left_right", img), img[:, :, ::-1])
+    np.testing.assert_allclose(op("flip_up_down", img), img[:, ::-1])
+    np.testing.assert_allclose(op("rot90", img, k=1),
+                               np.rot90(img, 1, axes=(1, 2)))
+    cc = op("central_crop", img, fraction=0.5)
+    assert cc.shape == (1, 3, 4, 3)
+    crop = op("crop_to_bounding_box", img, 1, 2, 4, 5)
+    np.testing.assert_allclose(crop, img[:, 1:5, 2:7])
+    padded = op("pad_to_bounding_box", img, 1, 1, 8, 10)
+    assert padded.shape == (1, 8, 10, 3)
+    np.testing.assert_allclose(padded[:, 1:7, 1:9], img)
+    mp = op("mirror_pad", img[0, :, :, 0], paddings=[[1, 1], [2, 2]],
+            mode="REFLECT")
+    np.testing.assert_allclose(mp, np.pad(img[0, :, :, 0], ((1, 1), (2, 2)),
+                                          mode="reflect"))
+
+
+def test_image_photometric_and_quality():
+    rs = np.random.RandomState(11)
+    a = rs.rand(1, 16, 16, 1).astype(np.float32)
+    np.testing.assert_allclose(op("adjust_gamma", a, gamma=2.0, gain=3.0),
+                               3.0 * a ** 2, rtol=1e-5)
+    # psnr of identical images is inf; of a known offset it's closed-form
+    b = np.clip(a + 0.1, 0, 2)
+    mse = np.mean((a - b) ** 2)
+    np.testing.assert_allclose(op("psnr", a, b), 10 * np.log10(1 / mse),
+                               rtol=1e-4)
+    s = op("ssim", a, a)
+    np.testing.assert_allclose(s, [1.0], atol=1e-5)
+    assert float(op("ssim", a, b)[0]) < 1.0
+    dy, dx = get_sd_op("image_gradients")(jnp.asarray(a))
+    np.testing.assert_allclose(np.asarray(dy)[0, :-1, :, 0],
+                               a[0, 1:, :, 0] - a[0, :-1, :, 0], atol=1e-6)
+    tv = op("total_variation", a)
+    assert tv.shape == (1,) and tv[0] > 0
+    # yiq/yuv round-trips
+    rgb = rs.rand(4, 3).astype(np.float32)
+    np.testing.assert_allclose(op("yiq_to_rgb", op("rgb_to_yiq", rgb)), rgb,
+                               atol=1e-4)
+    np.testing.assert_allclose(
+        op("yuv_to_rgb", get_sd_op("rgb_to_yuv")(jnp.asarray(rgb))), rgb,
+        atol=1e-4)
+
+
+def test_sobel_on_gradient_image():
+    img = np.tile(np.arange(8, dtype=np.float32)[None, None, :, None],
+                  (1, 8, 1, 1))  # horizontal ramp
+    edges = op("sobel_edges", img)
+    assert edges.shape == (1, 8, 8, 1, 2)
+    interior = edges[0, 2:-2, 2:-2, 0]
+    np.testing.assert_allclose(interior[..., 0], 0.0, atol=1e-5)  # dy
+    np.testing.assert_allclose(interior[..., 1], 8.0, atol=1e-4)  # dx (4*dx2)
+
+
+def test_scatter_nd_family():
+    idx = np.asarray([[0], [2]])
+    upd = np.asarray([[1.0, 2.0], [3.0, 4.0]])
+    got = op("scatter_nd", idx, upd, shape=(4, 2))
+    np.testing.assert_allclose(got, [[1, 2], [0, 0], [3, 4], [0, 0]])
+    ref = np.ones((4, 2), np.float32)
+    np.testing.assert_allclose(op("scatter_nd_add", ref, idx, upd),
+                               [[2, 3], [1, 1], [4, 5], [1, 1]])
+    np.testing.assert_allclose(op("scatter_nd_update", ref, idx, upd),
+                               [[1, 2], [1, 1], [3, 4], [1, 1]])
+
+
+def test_updater_ops_vs_manual():
+    g = np.asarray([0.5, -1.0], np.float32)
+    np.testing.assert_allclose(op("sgd_updater", g, lr=0.1), 0.1 * g)
+    upd, v = get_sd_op("momentum_updater")(jnp.asarray(g),
+                                           jnp.zeros(2), lr=0.1, momentum=0.9)
+    np.testing.assert_allclose(np.asarray(upd), 0.1 * g)
+    # adam step 0 reduces to lr * sign-ish formula
+    upd, m2, v2 = get_sd_op("adam_updater")(
+        jnp.asarray(g), jnp.zeros(2), jnp.zeros(2), 0, lr=1e-3)
+    mhat = (0.1 * g) / (1 - 0.9)
+    vhat = (0.001 * g ** 2) / (1 - 0.999)
+    np.testing.assert_allclose(np.asarray(upd),
+                               1e-3 * mhat / (np.sqrt(vhat) + 1e-8),
+                               rtol=1e-5)
+    # adagrad accumulates squared grads
+    upd, s = get_sd_op("adagrad_updater")(jnp.asarray(g), jnp.ones(2),
+                                          lr=0.1)
+    np.testing.assert_allclose(np.asarray(s), 1 + g ** 2, rtol=1e-6)
+    # rmsprop / adadelta / adamax / amsgrad / nadam: finite + state shapes
+    for name, extra in [("rmsprop_updater", (jnp.zeros(2),)),
+                        ("adadelta_updater", (jnp.zeros(2), jnp.zeros(2))),
+                        ("adamax_updater", (jnp.zeros(2), jnp.zeros(2), 0)),
+                        ("amsgrad_updater",
+                         (jnp.zeros(2), jnp.zeros(2), jnp.zeros(2), 0)),
+                        ("nadam_updater", (jnp.zeros(2), jnp.zeros(2), 0))]:
+        outs = get_sd_op(name)(jnp.asarray(g), *extra)
+        assert np.all(np.isfinite(np.asarray(outs[0]))), name
+
+
+def test_nan_reductions():
+    x = np.asarray([[1.0, np.nan, 3.0], [np.nan, 5.0, 6.0]])
+    np.testing.assert_allclose(op("nansum", x, axis=1), [4.0, 11.0])
+    np.testing.assert_allclose(op("nanmean", x, axis=1), [2.0, 5.5])
+    np.testing.assert_allclose(op("nanmax", x), 6.0)
+    np.testing.assert_allclose(op("nanmin", x, axis=0), [1.0, 5.0, 3.0])
+
+
+def test_statistics():
+    rs = np.random.RandomState(12)
+    x = rs.rand(3, 50)
+    np.testing.assert_allclose(op("cov", x), np.cov(x), rtol=1e-5)
+    np.testing.assert_allclose(op("corrcoef", x), np.corrcoef(x), rtol=1e-5)
+    np.testing.assert_allclose(op("quantile", x[0], 0.25),
+                               np.quantile(x[0], 0.25), rtol=1e-5)
+    np.testing.assert_allclose(op("ptp", x[0]), np.ptp(x[0]), rtol=1e-6)
+    np.testing.assert_allclose(op("diff", x[0]), np.diff(x[0]), rtol=1e-5,
+                               atol=1e-7)
+    np.testing.assert_allclose(op("trapz", x[0]), np.trapezoid(x[0]),
+                               rtol=1e-5)
+    assert bool(op("allclose", x, x.copy()))
+    np.testing.assert_allclose(
+        op("zero_fraction", np.asarray([0.0, 1.0, 0.0, 2.0])), 0.5)
+    m, v = get_sd_op("weighted_moments")(
+        jnp.asarray(x[0]), jnp.ones_like(jnp.asarray(x[0])), axis=0)
+    np.testing.assert_allclose(np.asarray(m), x[0].mean(), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(v), x[0].var(), rtol=1e-4)
+
+
+def test_indexing_family():
+    x = np.asarray([[3.0, 7.0, 7.0, 1.0]])
+    assert op("first_index", x, 7.0).tolist() == [1]
+    assert op("last_index", x, 7.0).tolist() == [2]
+    assert op("first_index", x, 99.0).tolist() == [-1]
+    np.testing.assert_allclose(op("ismax", x, axis=1), [[0, 1, 1, 0]])
+    assert float(op("nth_element", x[0], 1)) == 3.0
+    assert float(op("nth_element", x[0], 0, reverse=True)) == 7.0
+    vals, n = get_sd_op("choose")(jnp.asarray(x[0]), condition="gt",
+                                  value=2.0)
+    assert int(n) == 3 and sorted(np.asarray(vals)[:3].tolist()) == [3, 7, 7]
+    diff, n2 = get_sd_op("setdiff1d_padded")(
+        jnp.asarray([1, 2, 3, 4]), jnp.asarray([2, 4]))
+    assert int(n2) == 2 and np.asarray(diff)[:2].tolist() == [1, 3]
+    p = np.asarray([2, 0, 1])
+    np.testing.assert_allclose(op("invert_permutation", p), [1, 2, 0])
+    np.testing.assert_allclose(
+        op("take_along_axis", x, np.asarray([[3, 0]]), axis=1), [[1.0, 3.0]])
+
+
+def test_bitwise_extras():
+    x = np.asarray([0b1011], np.int32)
+    np.testing.assert_array_equal(op("toggle_bits", x), ~x)
+    got = op("cyclic_shift_bits", np.asarray([1], np.int32), 33)
+    np.testing.assert_array_equal(got, [2])  # 33 % 32 == 1
+    got = op("cyclic_rshift_bits", np.asarray([1], np.int32), 1)
+    np.testing.assert_array_equal(
+        got, np.asarray([np.uint32(1 << 31)]).astype(np.int32))
+    assert int(op("bits_hamming_distance", np.asarray([0b1010], np.int32),
+                  np.asarray([0b0110], np.int32))) == 2
+
+
+def test_loss_extras():
+    lab = np.asarray([[1.0, 0.0], [0.0, 1.0]])
+    pred = np.asarray([[0.8, 0.1], [0.2, 0.7]])
+    np.testing.assert_allclose(op("absolute_difference_loss", lab, pred),
+                               np.abs(pred - lab).mean(), rtol=1e-6)
+    np.testing.assert_allclose(op("l2_loss", pred),
+                               0.5 * (pred ** 2).sum(), rtol=1e-6)
+    lp = op("log_poisson_loss", np.asarray([2.0]), np.asarray([0.5]))
+    np.testing.assert_allclose(lp, np.exp(0.5) - 2 * 0.5, rtol=1e-5)
+    x = np.asarray([[1.0, 2.0]])
+    w = np.asarray([[0.5], [0.25]])
+    b = np.asarray([1.0])
+    np.testing.assert_allclose(op("xw_plus_b", x, w, b), [[2.0]])
+    np.testing.assert_allclose(op("relu_layer", x, -w, b), [[0.0]])
+
+
+def test_activation_long_tail_vs_torch():
+    torch = pytest.importorskip("torch")
+    x = np.linspace(-3, 3, 13).astype(np.float32)
+    tx = torch.tensor(x)
+    f = torch.nn.functional
+    for name, ref in [("celu", f.celu), ("hard_swish", f.hardswish),
+                      ("hardshrink", f.hardshrink),
+                      ("softshrink", f.softshrink),
+                      ("tanhshrink", f.tanhshrink)]:
+        np.testing.assert_allclose(op(name, x), ref(tx).numpy(), atol=1e-5,
+                                   err_msg=name)
+    np.testing.assert_allclose(op("glu", x[:12]),
+                               f.glu(tx[:12]).numpy(), atol=1e-5)
+    np.testing.assert_allclose(op("crelu", x).reshape(-1),
+                               np.concatenate([np.maximum(x, 0),
+                                               np.maximum(-x, 0)]), atol=1e-6)
+    np.testing.assert_allclose(op("gelu_precise", x),
+                               f.gelu(tx).numpy(), atol=1e-5)
+
+
+def test_quantization():
+    x = np.asarray([-10.0, -1.0, 0.0, 0.5, 10.0], np.float32)
+    fq = op("fake_quant_with_min_max_args", x, min=-1.0, max=1.0)
+    # TF nudges min/max so zero is exactly representable; the clamped range
+    # may exceed [min, max] by up to one quantization step (2/255 here).
+    step = 2.0 / 255.0
+    assert fq.min() >= -1.0 - step and fq.max() <= 1.0 + step
+    assert float(fq[2]) == 0.0  # zero exactly representable after nudging
+    # quantize/dequantize round-trip within one step
+    q = op("quantize", np.asarray([0.2, 0.7]), scale=0.1)
+    dq = op("dequantize", q, scale=0.1)
+    np.testing.assert_allclose(dq, [0.2, 0.7], atol=0.05)
+
+
+def test_linalg_extras():
+    rs = np.random.RandomState(13)
+    a = rs.rand(4, 4)
+    s = a @ a.T + 4 * np.eye(4)
+    w, v = get_sd_op("self_adjoint_eig")(jnp.asarray(s))
+    np.testing.assert_allclose(np.asarray(v) @ np.diag(np.asarray(w))
+                               @ np.asarray(v).T, s, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(op("eigvalsh", s), np.linalg.eigvalsh(s),
+                               rtol=1e-5)
+    np.testing.assert_allclose(op("matrix_power", a, 3),
+                               np.linalg.matrix_power(a, 3), rtol=1e-4)
+    chol = np.linalg.cholesky(s)
+    rhs = rs.rand(4, 2)
+    np.testing.assert_allclose(op("cholesky_solve", chol, rhs),
+                               np.linalg.solve(s, rhs), rtol=1e-4, atol=1e-5)
+    b = rs.rand(4, 3)
+    np.testing.assert_allclose(
+        op("mmul_transpose", a, b, transpose_a=True), a.T @ b, rtol=1e-5)
+    np.testing.assert_allclose(
+        op("tensormmul", a, b, axes_a=[1], axes_b=[0]), a @ b, rtol=1e-5)
+    np.testing.assert_allclose(op("tri", 3, k=0), np.tri(3))
+
+
+def test_creation_and_random_extras():
+    assert op("zeros", shape=(2, 3)).shape == (2, 3)
+    assert op("ones", shape=(2,)).tolist() == [1.0, 1.0]
+    np.testing.assert_allclose(op("logspace", 0.0, 2.0, num=3),
+                               [1.0, 10.0, 100.0], rtol=1e-5)
+    np.testing.assert_allclose(op("geomspace", 1.0, 8.0, num=4),
+                               [1, 2, 4, 8], rtol=1e-5)
+    rng = jax.random.PRNGKey(0)
+    bern = get_sd_op("random_binomial")(shape=(2000,), n=10, p=0.3, rng=rng)
+    assert abs(float(jnp.mean(bern)) - 3.0) < 0.2
+    logits = jnp.log(jnp.asarray([[0.05, 0.9, 0.05]] * 4))
+    samp = get_sd_op("random_multinomial")(logits, num_samples=50, rng=rng)
+    assert np.asarray(samp).shape == (4, 50)
+    assert (np.asarray(samp) == 1).mean() > 0.6
+
+
+def test_ctc_greedy_decoder():
+    # logits for sequence [blank, a, a, blank, b] -> decode [a, b]
+    C = 3  # 0=blank
+    seq = [0, 1, 1, 0, 2]
+    logits = np.full((1, 5, C), -5.0, np.float32)
+    for t, s in enumerate(seq):
+        logits[0, t, s] = 5.0
+    dec, lens = get_sd_op("ctc_greedy_decoder")(jnp.asarray(logits))
+    assert int(lens[0]) == 2
+    assert np.asarray(dec)[0, :2].tolist() == [1, 2]
+
+
+def test_cumulative_extras():
+    x = np.asarray([3.0, 1.0, 4.0, 1.0, 5.0])
+    np.testing.assert_allclose(op("cummax", x), np.maximum.accumulate(x))
+    np.testing.assert_allclose(op("cummin", x), np.minimum.accumulate(x))
+    np.testing.assert_allclose(
+        op("cumlogsumexp", x),
+        np.log(np.cumsum(np.exp(x))), rtol=1e-5)
+
+
+def test_fused_batch_norm():
+    rs = np.random.RandomState(14)
+    x = rs.rand(2, 4, 4, 3).astype(np.float32)
+    y, m, v = get_sd_op("fused_batch_norm")(
+        jnp.asarray(x), jnp.ones(3), jnp.zeros(3), epsilon=1e-5)
+    np.testing.assert_allclose(np.asarray(m), x.mean(axis=(0, 1, 2)),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(y).mean(axis=(0, 1, 2)),
+                               np.zeros(3), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y).std(axis=(0, 1, 2)),
+                               np.ones(3), atol=1e-3)
+
+
+def test_bincount_per_row_and_binary():
+    x = np.asarray([[0, 1, 1], [2, 2, 2]], np.int32)
+    got = op("bincount", x, minlength=4)
+    np.testing.assert_allclose(got, [[1, 2, 0, 0], [0, 0, 3, 0]])
+    got_bin = op("bincount", x, minlength=4, binary_output=True)
+    np.testing.assert_allclose(got_bin, [[1, 1, 0, 0], [0, 0, 1, 0]])
+    w = np.asarray([[0.5, 1.0, 2.0], [1.0, 1.0, 1.0]], np.float32)
+    got_w = op("bincount_weighted", x, w, minlength=4)
+    np.testing.assert_allclose(got_w, [[0.5, 3.0, 0, 0], [0, 0, 3.0, 0]])
+
+
+def test_sufficient_statistics_default_axis():
+    x = np.asarray([[1.0, 2.0], [3.0, 4.0]])
+    cnt, s, ss, _ = get_sd_op("sufficient_statistics")(jnp.asarray(x))
+    assert float(cnt) == 4.0 and float(s) == 10.0 and float(ss) == 30.0
+    m, v = get_sd_op("weighted_moments")(jnp.asarray(x),
+                                         jnp.ones_like(jnp.asarray(x)))
+    np.testing.assert_allclose(float(m), 2.5)
+    np.testing.assert_allclose(float(v), 1.25)

@@ -154,3 +154,232 @@ class TestAttentionGraph:
 
         import_and_compare(attn, {"x": rng.normal(size=(2, 6, 16)).astype(np.float32)},
                            rtol=1e-4, atol=1e-5)
+
+
+class TestTranche3Rules:
+    """Golden tests for the tranche-3 rule widening: each new rule family
+    executed via TF then via the imported SameDiff graph."""
+
+    def test_special_math_ops(self):
+        def f(x):
+            return tf.math.lgamma(x) + tf.math.digamma(x) \
+                + tf.math.xlogy(x, x + 1.0) + tf.math.atan2(x, x + 2.0)
+
+        import_and_compare(
+            f, {"x": (rng.random(size=(3, 4)) + 0.5).astype(np.float32)},
+            rtol=1e-4, atol=1e-5)
+
+    def test_depthwise_conv(self):
+        w = tf.constant(rng.normal(size=(3, 3, 2, 2)).astype(np.float32) * 0.2)
+
+        def f(x):
+            return tf.nn.depthwise_conv2d(x, w, strides=[1, 1, 1, 1],
+                                          padding="SAME")
+
+        import_and_compare(
+            f, {"x": rng.normal(size=(1, 6, 6, 2)).astype(np.float32)},
+            rtol=1e-4, atol=1e-5)
+
+    def test_conv2d_transpose(self):
+        w = tf.constant(rng.normal(size=(3, 3, 4, 2)).astype(np.float32) * 0.2)
+
+        def f(x):
+            return tf.nn.conv2d_transpose(
+                x, w, output_shape=[1, 8, 8, 4], strides=[1, 2, 2, 1],
+                padding="SAME")
+
+        import_and_compare(
+            f, {"x": rng.normal(size=(1, 4, 4, 2)).astype(np.float32)},
+            rtol=1e-4, atol=1e-5)
+
+    def test_resize_and_space_depth(self):
+        def f(x):
+            y = tf.image.resize(x, [8, 8], method="nearest")
+            y = tf.nn.space_to_depth(y, 2)
+            return tf.nn.depth_to_space(y, 2)
+
+        import_and_compare(
+            f, {"x": rng.normal(size=(1, 4, 4, 3)).astype(np.float32)},
+            rtol=1e-5, atol=1e-6)
+
+    def test_segment_ops(self):
+        ids = tf.constant(np.asarray([0, 0, 1, 2, 2], np.int32))
+
+        def f(x):
+            return tf.math.segment_sum(x, ids)
+
+        import_and_compare(
+            f, {"x": rng.normal(size=(5, 3)).astype(np.float32)},
+            rtol=1e-5, atol=1e-6)
+
+    def test_unsorted_segment(self):
+        ids = tf.constant(np.asarray([2, 0, 1, 0], np.int32))
+
+        def f(x):
+            return tf.math.unsorted_segment_sum(x, ids, num_segments=3)
+
+        import_and_compare(
+            f, {"x": rng.normal(size=(4, 2)).astype(np.float32)},
+            rtol=1e-5, atol=1e-6)
+
+    def test_top_k_values(self):
+        def f(x):
+            vals, _ = tf.math.top_k(x, k=3)
+            return vals
+
+        import_and_compare(
+            f, {"x": rng.normal(size=(4, 10)).astype(np.float32)})
+
+    def test_scatter_nd(self):
+        idx = tf.constant(np.asarray([[0], [2]], np.int32))
+
+        def f(u):
+            return tf.scatter_nd(idx, u, [4, 3])
+
+        import_and_compare(
+            f, {"u": rng.normal(size=(2, 3)).astype(np.float32)})
+
+    def test_tensor_scatter_and_band_part(self):
+        idx = tf.constant(np.asarray([[0, 0], [1, 2]], np.int32))
+
+        def f(x, u):
+            y = tf.tensor_scatter_nd_add(x, idx, u)
+            return tf.linalg.band_part(y, 1, 1)
+
+        import_and_compare(
+            f, {"x": rng.normal(size=(3, 3)).astype(np.float32),
+                "u": rng.normal(size=(2,)).astype(np.float32)})
+
+    def test_linalg_ops(self):
+        def f(x):
+            s = tf.matmul(x, x, transpose_b=True) + 4.0 * tf.eye(4)
+            c = tf.linalg.cholesky(s)
+            return tf.linalg.det(s) + tf.reduce_sum(c) \
+                + tf.reduce_sum(tf.linalg.inv(s))
+
+        import_and_compare(
+            f, {"x": rng.normal(size=(4, 4)).astype(np.float32)},
+            rtol=1e-3, atol=1e-3)
+
+    def test_reverse_roll_cumprod(self):
+        def f(x):
+            y = tf.reverse(x, axis=[1])
+            y = tf.roll(y, shift=2, axis=1)
+            return tf.math.cumprod(y, axis=1, exclusive=True)
+
+        import_and_compare(
+            f, {"x": (rng.random(size=(2, 5)) + 0.5).astype(np.float32)},
+            rtol=1e-5, atol=1e-6)
+
+    def test_lrn(self):
+        def f(x):
+            return tf.nn.local_response_normalization(
+                x, depth_radius=2, bias=1.0, alpha=1e-3, beta=0.75)
+
+        import_and_compare(
+            f, {"x": rng.normal(size=(1, 4, 4, 8)).astype(np.float32)},
+            rtol=1e-4, atol=1e-5)
+
+    def test_fft_real_imag(self):
+        def f(x):
+            c = tf.signal.fft(tf.complex(x, tf.zeros_like(x)))
+            return tf.math.real(c) + tf.math.imag(c)
+
+        import_and_compare(
+            f, {"x": rng.normal(size=(2, 8)).astype(np.float32)},
+            rtol=1e-3, atol=1e-4)
+
+    def test_clip_and_bitshift(self):
+        def f(x):
+            return tf.clip_by_value(x, -0.5, 0.5)
+
+        import_and_compare(
+            f, {"x": rng.normal(size=(3, 3)).astype(np.float32)})
+
+    def test_qr_svd_eigh_multi_output(self):
+        def f(x):
+            s_mat = tf.matmul(x, x, transpose_b=True) + 4.0 * tf.eye(4)
+            q, r = tf.linalg.qr(x)
+            s, u, v = tf.linalg.svd(x)
+            w, vec = tf.linalg.eigh(s_mat)
+            # combine pieces from every output slot (orders checked via
+            # reconstruction, which is basis-invariant)
+            recon = tf.matmul(tf.matmul(u, tf.linalg.diag(s)), v,
+                              transpose_b=True)
+            return tf.reduce_sum(q * 0.0) + tf.reduce_sum(recon) \
+                + tf.reduce_sum(w) + tf.reduce_sum(vec * 0.0) \
+                + tf.reduce_sum(tf.matmul(q, r))
+
+        import_and_compare(
+            f, {"x": rng.normal(size=(4, 4)).astype(np.float32)},
+            rtol=1e-3, atol=1e-3)
+
+    def test_conv2d_transpose_odd_size(self):
+        # H=W=5 forward with stride 2 SAME -> grads 3x3; the backprop must
+        # reconstruct 5, not 6 (the conv_transpose ambiguity).
+        w = tf.constant(rng.normal(size=(3, 3, 2, 4)).astype(np.float32) * 0.2)
+
+        def f(g):
+            return tf.nn.conv2d_transpose(
+                g, tf.transpose(w, [0, 1, 2, 3]) * 1.0,
+                output_shape=[1, 5, 5, 2], strides=[1, 2, 2, 1],
+                padding="SAME")
+
+        import_and_compare(
+            f, {"g": rng.normal(size=(1, 3, 3, 4)).astype(np.float32)},
+            rtol=1e-4, atol=1e-5)
+
+    def test_dilated_depthwise_conv(self):
+        w = tf.constant(rng.normal(size=(3, 3, 2, 1)).astype(np.float32) * 0.2)
+
+        def f(x):
+            return tf.nn.depthwise_conv2d(
+                x, w, strides=[1, 1, 1, 1], padding="SAME",
+                dilations=[2, 2])
+
+        import_and_compare(
+            f, {"x": rng.normal(size=(1, 8, 8, 2)).astype(np.float32)},
+            rtol=1e-4, atol=1e-5)
+
+    def test_bincount_weighted(self):
+        # raw op with a literal size: tf.math.bincount wraps the size in a
+        # Maximum(max(arr)+1, minlength) subgraph, which is dynamic-shape
+        # territory the static importer rejects by design.
+        arr = tf.constant(np.asarray([0, 1, 1, 3], np.int32))
+
+        def f(w):
+            return tf.raw_ops.DenseBincount(
+                input=arr, size=tf.constant(5, tf.int32), weights=w,
+                binary_output=False)
+
+        import_and_compare(
+            f, {"w": rng.normal(size=(4,)).astype(np.float32)})
+
+    def test_batched_matrix_diag_part(self):
+        def f(x):
+            return tf.linalg.diag_part(x)
+
+        import_and_compare(
+            f, {"x": rng.normal(size=(3, 4, 4)).astype(np.float32)})
+
+    def test_resize_bicubic_keys_kernel(self):
+        # TF's half-pixel bicubic is Keys a=-0.5 (Catmull-Rom) — exactly
+        # jax.image's cubic; a=-0.75 is TF's LEGACY corner-origin kernel,
+        # which the importer rejects
+        def f(x):
+            return tf.image.resize(x, [7, 9], method="bicubic")
+
+        # TF's bicubic kernel is a 1024-entry LUT: ~4e-4 quantization noise
+        import_and_compare(
+            f, {"x": rng.random(size=(1, 4, 6, 2)).astype(np.float32)},
+            rtol=1e-3, atol=1e-3)
+
+    def test_resize_rejects_corner_origin(self):
+        def f(x):
+            return tf.raw_ops.ResizeBilinear(
+                images=x, size=tf.constant([8, 8], tf.int32),
+                align_corners=False, half_pixel_centers=False)
+
+        with pytest.raises((NotImplementedError, ValueError)):
+            import_and_compare(
+                f, {"x": rng.random(size=(1, 4, 4, 1)).astype(np.float32)})

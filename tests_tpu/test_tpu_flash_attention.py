@@ -36,8 +36,11 @@ def test_pallas_kernel_matches_xla_on_tpu(tpu_device, t):
     v = _rand(2, 2, 4, t, 64)
     ref = mha_attention_reference(q, k, v)
     out = flash_attention(q, k, v, interpret=False)  # the REAL kernel
+    # TPU default matmul precision routes f32 through bf16 passes on the MXU
+    # (both paths, but with different accumulation orders), so parity is
+    # bf16-mantissa-level: ~4e-3 relative. Measured max abs diff 1.7e-3.
     np.testing.assert_allclose(
-        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=2e-4)
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=5e-3)
 
 
 def test_pallas_kernel_causal_on_tpu(tpu_device):
@@ -47,7 +50,7 @@ def test_pallas_kernel_causal_on_tpu(tpu_device):
     ref = mha_attention_reference(q, k, v, causal=True)
     out = flash_attention(q, k, v, causal=True, interpret=False)
     np.testing.assert_allclose(
-        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=2e-4)
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=5e-3)
 
 
 def test_pallas_kernel_bf16_on_tpu(tpu_device):
@@ -129,7 +132,8 @@ def test_distributed_trainer_single_chip_mesh(tpu_device):
         .set_input_type(InputType.feed_forward(16)).build()
     )
     net = MultiLayerNetwork(conf).init()
-    trainer = DistributedTrainer(net, n_data_shards=1, n_model_shards=1)
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    trainer = DistributedTrainer(net, mesh=make_mesh(data=1))
     rng = np.random.RandomState(0)
     x = rng.rand(8, 16).astype(np.float32)
     y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 8)]
