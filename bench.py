@@ -402,6 +402,50 @@ def measure_resnet50_b128() -> dict:
     return measure_resnet50(batch=128, warmup_iters=3, bench_iters=15)
 
 
+def measure_flash_attention_8k(b: int = 1, h: int = 8, t: int = 8192,
+                               d: int = 64, iters: int = 10) -> dict:
+    """Long-context attention row (SURVEY §5.7): compiled Pallas flash
+    kernel vs the XLA dense reference at t=8192 bf16, both host-fenced.
+    This is where flash earns its keep — the dense path materializes the
+    [t, t] score matrix in HBM."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.flash_attention import (
+        flash_attention, mha_attention_reference)
+
+    q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (b, h, t, d),
+                                 jnp.bfloat16) for i in range(3))
+    flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, interpret=False))
+    dense = jax.jit(mha_attention_reference)
+    flash_c = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                        interpret=False))
+    dense_c = jax.jit(
+        lambda q, k, v: mha_attention_reference(q, k, v, causal=True))
+
+    def timed(fn):
+        _host_fence(fn(q, k, v))
+        start = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn(q, k, v)
+        _host_fence(out)
+        return (time.perf_counter() - start) / iters
+
+    t_flash, t_dense = timed(flash), timed(dense)
+    t_flash_c, t_dense_c = timed(flash_c), timed(dense_c)
+    return {
+        "seq": t, "batch": b, "heads": h, "head_dim": d,
+        "flash_ms": round(t_flash * 1e3, 2),
+        "xla_dense_ms": round(t_dense * 1e3, 2),
+        "speedup_vs_dense": round(t_dense / t_flash, 2),
+        "causal_flash_ms": round(t_flash_c * 1e3, 2),
+        "causal_xla_ms": round(t_dense_c * 1e3, 2),
+        "causal_speedup": round(t_dense_c / t_flash_c, 2),
+    }
+
+
 _MEASUREMENTS = {
     "lenet": measure_lenet,
     "resnet50": measure_resnet50,
@@ -411,6 +455,7 @@ _MEASUREMENTS = {
     "lstm": measure_lstm,
     "calibration": measure_calibration,
     "input_pipeline": measure_input_pipeline,
+    "flash_attention_8k": measure_flash_attention_8k,
 }
 
 
@@ -532,8 +577,10 @@ def main() -> None:
         "calibration": calibration,
         "input_pipeline": _run_measurement("input_pipeline", platform),
     }
-    if not fallback:  # batch-scaling probe only makes sense on the chip
+    if not fallback:  # chip-only rows: batch scaling + long-context kernel
         extras["resnet50_b128"] = _run_measurement("resnet50_b128", platform)
+        extras["flash_attention_8k"] = _run_measurement(
+            "flash_attention_8k", platform)
 
     # input-bound vs compute-bound: one host input pipeline vs the device
     # step rate (SURVEY.md:124). > 1 means the single-threaded host path

@@ -360,7 +360,8 @@ sd_op("cholesky")(jnp.linalg.cholesky)
 sd_op("matrix_inverse")(jnp.linalg.inv)
 sd_op("matrix_determinant")(jnp.linalg.det)
 sd_op("svd")(lambda x, full_matrices=False: jnp.linalg.svd(x, full_matrices=full_matrices))
-sd_op("qr")(lambda x: jnp.linalg.qr(x))
+sd_op("qr")(lambda x, full_matrices=False: jnp.linalg.qr(
+    x, mode="complete" if full_matrices else "reduced"))
 sd_op("solve")(jnp.linalg.solve)
 sd_op("lstsq")(lambda a, b: jnp.linalg.lstsq(a, b)[0])
 sd_op("matrix_band_part")(

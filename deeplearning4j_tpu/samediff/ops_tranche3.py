@@ -32,7 +32,11 @@ sd_op("rdiv")(lambda a, b: b / a)
 sd_op("realdiv")(jnp.true_divide)
 sd_op("truncatediv")(lambda a, b: jnp.trunc(a / b).astype(jnp.result_type(a, b)))
 sd_op("truncatemod")(lambda a, b: a - b * jnp.trunc(a / b))
-sd_op("div_no_nan")(lambda a, b: jnp.where(b == 0, jnp.zeros_like(a * b), a / b))
+# double-where keeps the b==0 branch out of the backward pass too: a single
+# where still routes 0/0 = NaN cotangents through the division VJP (TF's
+# DivNoNan gradient is 0 there).
+sd_op("div_no_nan")(lambda a, b: jnp.where(
+    b == 0, jnp.zeros_like(a * b), a / jnp.where(b == 0, 1, b)))
 sd_op("mul_no_nan")(lambda a, b: jnp.where(b == 0, jnp.zeros_like(a * b), a * b))
 sd_op("floormod")(lambda a, b: a - b * jnp.floor(a / b))
 sd_op("remainder")(jnp.remainder)
@@ -111,17 +115,23 @@ def _mergemaxindex(*xs):
 
 
 @sd_op("dynamic_stitch")
-def _dynamic_stitch(indices, *data):
-    """TF dynamic_stitch with equal-rank parts: result[indices[i][j]] = data[i][j]."""
-    idx = jnp.concatenate([jnp.ravel(i) for i in indices]) \
-        if isinstance(indices, (list, tuple)) else jnp.ravel(indices)
-    parts = jnp.concatenate(
-        [d.reshape((-1,) + d.shape[indices[0].ndim if isinstance(indices, (list, tuple)) else indices.ndim:])
-         for d in data], axis=0) if len(data) > 1 else \
-        data[0].reshape((-1,) + data[0].shape[(indices[0].ndim if isinstance(indices, (list, tuple)) else indices.ndim):])
-    n = idx.shape[0]
-    out = jnp.zeros((n,) + parts.shape[1:], parts.dtype)
-    return out.at[idx].set(parts)
+def _dynamic_stitch(indices, *data, size=None):
+    """TF dynamic_stitch with equal-rank parts: result[indices[i][j]] =
+    data[i][j]. XLA-honest form: output length is static — pass ``size``
+    (= TF's max(indices)+1) or it defaults to the total index count
+    (correct whenever the index lists are a permutation, the common
+    interleave/departition case). Later lists overwrite earlier ones at
+    duplicate indices, matching TF's last-wins across inputs."""
+    idx_list = list(indices) if isinstance(indices, (list, tuple)) \
+        else [indices]
+    ind_ndim = idx_list[0].ndim
+    n = int(size) if size is not None else sum(
+        int(np.prod(i.shape)) for i in idx_list)
+    rest = data[0].shape[ind_ndim:]
+    out = jnp.zeros((n,) + rest, data[0].dtype)
+    for i, d in zip(idx_list, data):
+        out = out.at[jnp.ravel(i)].set(d.reshape((-1,) + rest))
+    return out
 
 
 # ---- conv extras -----------------------------------------------------------
@@ -419,22 +429,25 @@ sd_op("angle")(jnp.angle)
 
 
 # ---- window functions (reference/TF signal windows) ------------------------
-def _window(n, fn):
+def _window(n, fn, periodic):
+    """TF-signal convention: periodic=True (denominator N, for STFT) is the
+    default; periodic=False gives the symmetric numpy windows (N-1)."""
     n = int(n)
     if n == 1:
         return jnp.ones((1,))
-    return fn(jnp.arange(n, dtype=jnp.float32), n)
+    denom = n if periodic else n - 1
+    return fn(jnp.arange(n, dtype=jnp.float32), denom)
 
 
-sd_op("hann_window")(lambda n: _window(
-    n, lambda i, m: 0.5 - 0.5 * jnp.cos(2 * jnp.pi * i / (m - 1))))
-sd_op("hamming_window")(lambda n: _window(
-    n, lambda i, m: 0.54 - 0.46 * jnp.cos(2 * jnp.pi * i / (m - 1))))
-sd_op("blackman_window")(lambda n: _window(
-    n, lambda i, m: 0.42 - 0.5 * jnp.cos(2 * jnp.pi * i / (m - 1))
-    + 0.08 * jnp.cos(4 * jnp.pi * i / (m - 1))))
-sd_op("bartlett_window")(lambda n: _window(
-    n, lambda i, m: 1.0 - jnp.abs(2 * i / (m - 1) - 1.0)))
+sd_op("hann_window")(lambda n, periodic=True: _window(
+    n, lambda i, m: 0.5 - 0.5 * jnp.cos(2 * jnp.pi * i / m), periodic))
+sd_op("hamming_window")(lambda n, periodic=True: _window(
+    n, lambda i, m: 0.54 - 0.46 * jnp.cos(2 * jnp.pi * i / m), periodic))
+sd_op("blackman_window")(lambda n, periodic=True: _window(
+    n, lambda i, m: 0.42 - 0.5 * jnp.cos(2 * jnp.pi * i / m)
+    + 0.08 * jnp.cos(4 * jnp.pi * i / m), periodic))
+sd_op("bartlett_window")(lambda n, periodic=False: _window(
+    n, lambda i, m: 1.0 - jnp.abs(2 * i / m - 1.0), periodic))
 
 
 @sd_op("stft")
@@ -854,11 +867,16 @@ sd_op("shift_bits")(jnp.left_shift)
 sd_op("rshift_bits")(jnp.right_shift)
 
 
+_UNSIGNED_OF = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
+
+
 @sd_op("cyclic_shift_bits")
 def _cyclic_shift_bits(x, shift):
+    # rotate in the SAME-WIDTH unsigned domain: arithmetic right-shift on a
+    # signed dtype would smear the sign bit into the rotated bits
     nbits = x.dtype.itemsize * 8
     shift = shift % nbits
-    ux = x.astype(jnp.uint32) if nbits == 32 else x
+    ux = x.astype(_UNSIGNED_OF[x.dtype.itemsize])
     out = (ux << shift) | (ux >> (nbits - shift))
     return out.astype(x.dtype)
 
@@ -867,7 +885,7 @@ def _cyclic_shift_bits(x, shift):
 def _cyclic_rshift_bits(x, shift):
     nbits = x.dtype.itemsize * 8
     shift = shift % nbits
-    ux = x.astype(jnp.uint32) if nbits == 32 else x
+    ux = x.astype(_UNSIGNED_OF[x.dtype.itemsize])
     out = (ux >> shift) | (ux << (nbits - shift))
     return out.astype(x.dtype)
 
@@ -975,7 +993,9 @@ def _fake_quant_args(x, min=-6.0, max=6.0, num_bits=8):
 
 @sd_op("fake_quant_with_min_max_vars")
 def _fake_quant_vars(x, min, max, num_bits=8):
-    return _fake_quant_args(x, float(min), float(max), num_bits)
+    # min/max stay arrays: they arrive as tracers under jit, and the
+    # arithmetic in _fake_quant_args is elementwise anyway
+    return _fake_quant_args(x, min, max, num_bits)
 
 
 @sd_op("quantize")

@@ -533,7 +533,9 @@ def _matrix_inverse_rule(ctx):
 
 @tf_rule("Qr")
 def _qr_rule(ctx):
-    tup = ctx.importer.sd._op("qr", ctx.var(0), name=ctx.name + "__tuple")
+    full = "full_matrices" in ctx.attr and bool(ctx.attr["full_matrices"].b)
+    tup = ctx.importer.sd._op("qr", ctx.var(0), name=ctx.name + "__tuple",
+                              full_matrices=full)
     return _register_multi_output(ctx, tup, 2)
 
 
@@ -549,7 +551,12 @@ def _svd_rule(ctx):
     # TF emits (s, u, v); jnp.linalg.svd returns (u, s, vh) — reorder and
     # transpose vh so consumers of name:0/:1/:2 see TF's layout.
     sd = ctx.importer.sd
-    tup = sd._op("svd", ctx.var(0), name=ctx.name + "__tuple")
+    full = "full_matrices" in ctx.attr and bool(ctx.attr["full_matrices"].b)
+    if "compute_uv" in ctx.attr and not bool(ctx.attr["compute_uv"].b):
+        raise NotImplementedError(
+            f"Svd node {ctx.name!r}: compute_uv=False is not supported")
+    tup = sd._op("svd", ctx.var(0), name=ctx.name + "__tuple",
+                 full_matrices=full)
     u = sd._op("getitem", tup, item=0)
     s = sd._op("getitem", tup, item=1, name=ctx.name)
     vh = sd._op("getitem", tup, item=2)
@@ -568,7 +575,8 @@ def _depthwise_conv(ctx):
     dil = (1, 1)
     if "dilations" in ctx.attr:
         d = list(ctx.attr["dilations"].list.i)
-        dil = (d[1], d[2]) if df == "NHWC" else (d[2], d[3])
+        if d:
+            dil = (d[1], d[2]) if df == "NHWC" else (d[2], d[3])
     return ctx.importer.sd._op(
         "depthwise_conv2d", ctx.var(0), ctx.var(1), name=ctx.name,
         strides=s, padding=ctx.attr["padding"].s.decode(), data_format=df,

@@ -216,13 +216,17 @@ def test_windows_and_stft():
                       ("hamming_window", np.hamming),
                       ("blackman_window", np.blackman),
                       ("bartlett_window", np.bartlett)]:
-        np.testing.assert_allclose(op(name, 16), ref(16), atol=1e-5,
-                                   err_msg=name)
+        # symmetric form == the numpy windows
+        np.testing.assert_allclose(op(name, 16, periodic=False), ref(16),
+                                   atol=1e-5, err_msg=name)
+    # periodic (TF-signal default) == symmetric window of N+1, truncated
+    np.testing.assert_allclose(op("hann_window", 16),
+                               np.hanning(17)[:16], atol=1e-5)
     rs = np.random.RandomState(9)
     sig = rs.rand(512).astype(np.float32)
     s = op("stft", sig, frame_length=64, frame_step=32)
     assert s.shape == (15, 33)
-    manual = np.fft.rfft(sig[:64] * np.hanning(64))
+    manual = np.fft.rfft(sig[:64] * np.hanning(65)[:64])
     np.testing.assert_allclose(s[0], manual, rtol=1e-3, atol=1e-3)
 
 
@@ -538,3 +542,33 @@ def test_sufficient_statistics_default_axis():
                                          jnp.ones_like(jnp.asarray(x)))
     np.testing.assert_allclose(float(m), 2.5)
     np.testing.assert_allclose(float(v), 1.25)
+
+
+def test_div_no_nan_gradient_safe():
+    g = jax.grad(lambda a, b: jnp.sum(get_sd_op("div_no_nan")(a, b)),
+                 argnums=(0, 1))(jnp.asarray([1.0, 2.0]),
+                                 jnp.asarray([0.0, 4.0]))
+    assert np.all(np.isfinite(np.asarray(g[0])))
+    assert np.all(np.isfinite(np.asarray(g[1])))
+    np.testing.assert_allclose(np.asarray(g[0]), [0.0, 0.25])
+
+
+def test_cyclic_shift_signed_int8():
+    got = op("cyclic_shift_bits", np.asarray([-127], np.int8), 1)  # 0x81
+    np.testing.assert_array_equal(got, [3])
+    got = op("cyclic_rshift_bits", np.asarray([1], np.int8), 1)
+    np.testing.assert_array_equal(got, [np.int8(-128)])  # 0x80
+
+
+def test_dynamic_stitch_last_wins():
+    got = get_sd_op("dynamic_stitch")(
+        [jnp.asarray([0, 1]), jnp.asarray([0])],
+        jnp.asarray([[1.0], [2.0]]), jnp.asarray([[9.0]]), size=2)
+    np.testing.assert_allclose(np.asarray(got), [[9.0], [2.0]])
+
+
+def test_fake_quant_vars_jittable():
+    f = jax.jit(lambda x, lo, hi:
+                get_sd_op("fake_quant_with_min_max_vars")(x, lo, hi))
+    out = f(jnp.asarray([0.3, 2.0]), jnp.asarray(-1.0), jnp.asarray(1.0))
+    assert np.all(np.isfinite(np.asarray(out)))
