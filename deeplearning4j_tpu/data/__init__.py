@@ -1,5 +1,6 @@
 from .dataset import DataSet, MultiDataSet
-from .fetchers import Cifar10DataSetIterator, EmnistDataSetIterator
+from .fetchers import (Cifar10DataSetIterator, EmnistDataSetIterator,
+                       SvhnDataSetIterator, TinyImageNetDataSetIterator)
 from .image_transform import (
     BrightnessTransform,
     CropImageTransform,
@@ -34,6 +35,8 @@ __all__ = [
     "CropImageTransform",
     "DataSet",
     "EmnistDataSetIterator",
+    "SvhnDataSetIterator",
+    "TinyImageNetDataSetIterator",
     "FlipImageTransform",
     "ImageRecordReader",
     "ImageTransform",

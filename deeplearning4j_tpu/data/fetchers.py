@@ -132,3 +132,85 @@ class EmnistDataSetIterator(ListDataSetIterator):
         labels = np.eye(k, dtype=np.float32)[y]
         self.num_classes = k
         super().__init__(DataSet(x, labels), batch, shuffle=shuffle, seed=seed)
+SVHN_PROVENANCE = "procedural-svhn-v1 (synthetic; no-network environment)"
+TINYIMAGENET_PROVENANCE = \
+    "procedural-tinyimagenet-v1 (synthetic; no-network environment)"
+
+
+def _class_image(cls: int, n_classes: int, rng: np.random.Generator,
+                 size: int, channels: int) -> np.ndarray:
+    """Class-conditioned procedural image, learnable at any class count:
+    class identity is factored into stripe orientation (cls mod 10) and a
+    strong localized blob whose grid position encodes cls // 10 — every
+    class pair differs in at least one high-amplitude factor."""
+    img = rng.normal(0.45, 0.08, (size, size, channels)).astype(np.float32)
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32) / size
+    ang = 2 * np.pi * (cls % 10) / 10.0
+    stripe = 0.5 + 0.5 * np.sin(
+        8 * np.pi * (xx * np.cos(ang) + yy * np.sin(ang)) + cls)
+    block = cls // 10  # blob grid position encodes the coarse class
+    grid = max(int(np.ceil(np.sqrt(max(n_classes // 10, 1)))), 1)
+    cy = 0.15 + 0.7 * (block % grid) / max(grid - 1, 1) if grid > 1 else 0.5
+    cx = 0.15 + 0.7 * (block // grid) / max(grid - 1, 1) if grid > 1 else 0.5
+    blob = np.exp(-(((yy - cy) ** 2 + (xx - cx) ** 2) / 0.01))
+    for c in range(channels):
+        w = 0.5 + 0.5 * np.cos(ang + c)
+        img[:, :, c] += 0.35 * w * stripe + 0.5 * blob
+    return np.clip(img, 0.0, 1.0).transpose(2, 0, 1)  # NCHW
+
+
+class _ProceduralImageIterator(ListDataSetIterator):
+    """Shared loader for image datasets with an npz-real-data override and
+    a class-conditioned procedural fallback (the Cifar10 recipe)."""
+
+    def __init__(self, npz_name: str, num_classes: int, size: int,
+                 provenance: str, default_train: int, default_eval: int,
+                 batch: int, train: bool, seed: int,
+                 num_examples: Optional[int], shuffle: bool) -> None:
+        real = _load_npz(f"~/.dl4j_tpu/{npz_name}", None, train)
+        if real is not None:
+            x, y = real
+            if x.ndim == 4 and x.shape[-1] == 3:  # NHWC npz -> NCHW
+                x = x.transpose(0, 3, 1, 2)
+            self.provenance = f"{npz_name} (real)"
+        else:
+            n = num_examples or (default_train if train else default_eval)
+            rng = np.random.default_rng(seed if train else seed + 999)
+            y = rng.integers(0, num_classes, size=n)
+            x = np.stack([_class_image(int(c), num_classes, rng, size, 3)
+                          for c in y])
+            self.provenance = provenance
+        if num_examples is not None:
+            x, y = x[:num_examples], y[:num_examples]
+        labels = np.eye(num_classes, dtype=np.float32)[y]
+        super().__init__(DataSet(x, labels), batch, shuffle=shuffle,
+                         seed=seed)
+
+
+class SvhnDataSetIterator(_ProceduralImageIterator):
+    """Reference-shaped: SvhnDataSetIterator(batch[, train]) — Street View
+    House Numbers. Features [n, 3, 32, 32] NCHW in [0, 1]; labels one-hot
+    [n, 10]. Real data at ``~/.dl4j_tpu/svhn.npz`` is preferred."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, batch: int, train: bool = True, seed: int = 123,
+                 num_examples: Optional[int] = None,
+                 shuffle: bool = True) -> None:
+        super().__init__("svhn.npz", 10, 32, SVHN_PROVENANCE, 8192, 1024,
+                         batch, train, seed, num_examples, shuffle)
+
+
+class TinyImageNetDataSetIterator(_ProceduralImageIterator):
+    """Reference-shaped: TinyImageNetDataSetIterator(batch[, train]) —
+    200 classes at [3, 64, 64] NCHW. Real data at
+    ``~/.dl4j_tpu/tinyimagenet.npz`` is preferred."""
+
+    NUM_CLASSES = 200
+
+    def __init__(self, batch: int, train: bool = True, seed: int = 123,
+                 num_examples: Optional[int] = None,
+                 shuffle: bool = True) -> None:
+        super().__init__("tinyimagenet.npz", 200, 64,
+                         TINYIMAGENET_PROVENANCE, 4096, 512,
+                         batch, train, seed, num_examples, shuffle)

@@ -15,6 +15,7 @@ from .textgen_lstm import TextGenerationLSTM
 from .unet import UNet
 from .vgg16 import AlexNet, VGG16, VGG19
 from .xception import Xception
+from .nasnet import NASNet
 
 __all__ = [
     "AlexNet",
@@ -33,4 +34,5 @@ __all__ = [
     "VGG19",
     "YOLO2",
     "Xception",
+    "NASNet",
 ]

@@ -180,3 +180,23 @@ def test_negative_label_rejected():
     it = RecordReaderDataSetIterator(reader, batch_size=1, num_classes=3)
     with pytest.raises(ValueError, match="label -1"):
         list(it)
+
+
+def test_svhn_and_tinyimagenet_fetchers():
+    from deeplearning4j_tpu.data import (SvhnDataSetIterator,
+                                         TinyImageNetDataSetIterator)
+
+    it = SvhnDataSetIterator(16, num_examples=48, shuffle=False)
+    batches = list(it)
+    assert batches[0].features.shape == (16, 3, 32, 32)
+    assert batches[0].labels.shape == (16, 10)
+    assert 0.0 <= batches[0].features.min() and batches[0].features.max() <= 1.0
+    # deterministic given the seed
+    it2 = SvhnDataSetIterator(16, num_examples=48, shuffle=False)
+    np.testing.assert_array_equal(batches[0].features,
+                                  next(iter(it2)).features)
+
+    it3 = TinyImageNetDataSetIterator(8, num_examples=16, shuffle=False)
+    ds = next(iter(it3))
+    assert ds.features.shape == (8, 3, 64, 64)
+    assert ds.labels.shape == (8, 200)

@@ -142,3 +142,21 @@ def test_facenet_unit_norm_embeddings():
         np.random.RandomState(2).rand(3, 3, 96, 96).astype(np.float32)))
     assert emb.shape == (3, 64)
     np.testing.assert_allclose(np.linalg.norm(emb, axis=-1), 1.0, rtol=1e-5)
+
+
+def test_nasnet_forward_and_fit():
+    from deeplearning4j_tpu.model.zoo import NASNet
+    from deeplearning4j_tpu.train.graph_solver import GraphSolver
+
+    m = NASNet(num_classes=4, height=32, width=32, num_blocks=1,
+               penultimate_filters=120, stem_filters=8).init()
+    out = m.output(_x(2, 3, 32, 32))
+    assert out.shape == (2, 4)
+    assert np.allclose(np.asarray(out).sum(1), 1, atol=1e-4)
+    y = np.eye(4, dtype=np.float32)[np.asarray([0, 1])]
+    s = GraphSolver(m)
+    l0 = float(s.fit_batch((np.asarray(_x(2, 3, 32, 32)),), (y,)))
+    l1 = l0
+    for _ in range(5):
+        l1 = float(s.fit_batch((np.asarray(_x(2, 3, 32, 32)),), (y,)))
+    assert np.isfinite(l1) and l1 < l0
