@@ -78,31 +78,9 @@ def _load_npz(path: str, flatten: Optional[int], train: bool):
     return x, y
 
 
-class Cifar10DataSetIterator(ListDataSetIterator):
-    """Reference-shaped: Cifar10DataSetIterator(batch[, train, seed]).
-    Features [n, 3, 32, 32] (NCHW) in [0, 1]; labels one-hot [n, 10]."""
-
-    NUM_CLASSES = 10
-
-    def __init__(self, batch: int, train: bool = True, seed: int = 123,
-                 num_examples: Optional[int] = None,
-                 shuffle: bool = True) -> None:
-        real = _load_npz("~/.dl4j_tpu/cifar10.npz", None, train)
-        if real is not None:
-            x, y = real
-            if x.ndim == 4 and x.shape[-1] == 3:  # NHWC npz -> NCHW
-                x = x.transpose(0, 3, 1, 2)
-            self.provenance = "cifar10.npz (real)"
-        else:
-            n = num_examples or (8192 if train else 1024)
-            rng = np.random.default_rng(seed if train else seed + 999)
-            y = rng.integers(0, 10, size=n)
-            x = np.stack([_cifar_example(int(c), rng) for c in y])
-            self.provenance = CIFAR_PROVENANCE
-        if num_examples is not None:
-            x, y = x[:num_examples], y[:num_examples]
-        labels = np.eye(10, dtype=np.float32)[y]
-        super().__init__(DataSet(x, labels), batch, shuffle=shuffle, seed=seed)
+# Cifar10DataSetIterator is defined below as a subclass of the shared
+# _ProceduralImageIterator (same npz-override/procedural skeleton as SVHN
+# and TinyImageNet).
 
 
 class EmnistDataSetIterator(ListDataSetIterator):
@@ -166,7 +144,8 @@ class _ProceduralImageIterator(ListDataSetIterator):
     def __init__(self, npz_name: str, num_classes: int, size: int,
                  provenance: str, default_train: int, default_eval: int,
                  batch: int, train: bool, seed: int,
-                 num_examples: Optional[int], shuffle: bool) -> None:
+                 num_examples: Optional[int], shuffle: bool,
+                 make_example=None) -> None:
         real = _load_npz(f"~/.dl4j_tpu/{npz_name}", None, train)
         if real is not None:
             x, y = real
@@ -174,17 +153,32 @@ class _ProceduralImageIterator(ListDataSetIterator):
                 x = x.transpose(0, 3, 1, 2)
             self.provenance = f"{npz_name} (real)"
         else:
+            gen = make_example or (
+                lambda c, rng: _class_image(c, num_classes, rng, size, 3))
             n = num_examples or (default_train if train else default_eval)
             rng = np.random.default_rng(seed if train else seed + 999)
             y = rng.integers(0, num_classes, size=n)
-            x = np.stack([_class_image(int(c), num_classes, rng, size, 3)
-                          for c in y])
+            x = np.stack([gen(int(c), rng) for c in y])
             self.provenance = provenance
         if num_examples is not None:
             x, y = x[:num_examples], y[:num_examples]
         labels = np.eye(num_classes, dtype=np.float32)[y]
         super().__init__(DataSet(x, labels), batch, shuffle=shuffle,
                          seed=seed)
+
+
+class Cifar10DataSetIterator(_ProceduralImageIterator):
+    """Reference-shaped: Cifar10DataSetIterator(batch[, train, seed]).
+    Features [n, 3, 32, 32] (NCHW) in [0, 1]; labels one-hot [n, 10]."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, batch: int, train: bool = True, seed: int = 123,
+                 num_examples: Optional[int] = None,
+                 shuffle: bool = True) -> None:
+        super().__init__("cifar10.npz", 10, 32, CIFAR_PROVENANCE, 8192, 1024,
+                         batch, train, seed, num_examples, shuffle,
+                         make_example=_cifar_example)
 
 
 class SvhnDataSetIterator(_ProceduralImageIterator):

@@ -58,6 +58,7 @@ from .preprocessors import (
     RnnToCnnPreProcessor,
     RnnToFeedForwardPreProcessor,
 )
+from .moe import MixtureOfExpertsLayer
 from .samediff_layer import SameDiffLambdaLayer, SameDiffLayer
 from .recurrent import (
     BidirectionalLayer,

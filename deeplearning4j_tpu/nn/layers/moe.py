@@ -1,0 +1,171 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+Beyond-reference capability (SURVEY §2.3 lists EP as absent upstream;
+"on TPU the absent rows come nearly free from pjit"): a Switch/GShard-style
+sparse FFN whose expert weights carry a leading expert dimension that
+shards over a mesh axis via ``DistributedTrainer(param_sharding_rules=
+[("moe.*/We", P(None, "model"))...])``-like rules — XLA then partitions
+the dispatch/combine einsums and inserts the all-to-alls.
+
+TPU-first design: the classic dense-dispatch formulation (Mesh-TF /
+GShard) — top-k routing becomes two static one-hot einsum contractions
+([tokens, experts, capacity] dispatch and combine tensors), so everything
+is MXU work with static shapes; no gather/scatter, no dynamic shapes.
+Tokens over an expert's capacity are dropped (their combine weight is 0 —
+the residual path carries them), exactly the GShard capacity contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...core.config import register_config
+from ..activations import Activation
+from ..input_type import FeedForwardType, InputType, RecurrentType
+from ..weights import WeightInit, init_weights
+from .base import Layer, LayerContext, Params, State, apply_input_dropout
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class MixtureOfExpertsLayer(Layer):
+    """Sparse MoE FFN: router -> top-k experts (2-layer MLPs) -> combine.
+
+    Params: router ``Wg [nIn, E]``; per-expert ``We1 [E, nIn, hidden]``,
+    ``be1 [E, hidden]``, ``We2 [E, hidden, nOut]``, ``be2 [E, nOut]``.
+    The leading ``E`` dim is the expert-parallel sharding axis.
+    """
+
+    n_in: int = 0
+    n_out: int = 0
+    num_experts: int = 4
+    hidden: int = 0            # defaults to 4 * n_in
+    top_k: int = 2
+    capacity_factor: float = 1.5
+
+    def __post_init__(self) -> None:
+        if self.top_k < 1 or self.top_k > self.num_experts:
+            raise ValueError(
+                f"top_k={self.top_k} must be in [1, num_experts="
+                f"{self.num_experts}]")
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if isinstance(input_type, RecurrentType):
+            return RecurrentType(size=self.n_out,
+                                 timesteps=input_type.timesteps)
+        return FeedForwardType(size=self.n_out)
+
+    def with_input(self, input_type: InputType) -> "MixtureOfExpertsLayer":
+        if self.n_in:
+            return self
+        size = input_type.size if isinstance(
+            input_type, (FeedForwardType, RecurrentType)) \
+            else input_type.flat_size()
+        return dataclasses.replace(self, n_in=size)
+
+    def has_params(self) -> bool:
+        return True
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return ("Wg", "We1", "be1", "We2", "be2")
+
+    def _hidden(self) -> int:
+        return self.hidden or 4 * self.n_in
+
+    def init_state(self, dtype: Any) -> State:
+        # declared up-front so the state pytree structure is stable across
+        # jitted steps (apply refreshes the value every call)
+        return {"aux_load_balance": jnp.zeros((), dtype)}
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        e, d, h, o = self.num_experts, self.n_in, self._hidden(), self.n_out
+        kg, k1, k2 = jax.random.split(key, 3)
+        wi = self.weight_init or WeightInit.XAVIER
+        return {
+            "Wg": init_weights(kg, (d, e), wi, fan_in=d, fan_out=e,
+                               distribution=self.weight_init_distribution,
+                               dtype=dtype),
+            "We1": init_weights(k1, (e, d, h), wi, fan_in=d, fan_out=h,
+                                distribution=self.weight_init_distribution,
+                                dtype=dtype),
+            "be1": jnp.zeros((e, h), dtype),
+            "We2": init_weights(k2, (e, h, o), wi, fan_in=h, fan_out=o,
+                                distribution=self.weight_init_distribution,
+                                dtype=dtype),
+            "be2": jnp.zeros((e, o), dtype),
+        }
+
+    def _route(self, gates: jax.Array, capacity: int):
+        """Top-k dense dispatch: returns (dispatch [b, E, C] 0/1,
+        combine [b, E, C] gate-weighted). Position assignment is
+        first-come-first-served in batch order per expert (GShard)."""
+        b, e = gates.shape
+        dispatch = jnp.zeros((b, e, capacity), gates.dtype)
+        combine = jnp.zeros((b, e, capacity), gates.dtype)
+        # tokens already assigned per expert as the k rounds proceed
+        fill = jnp.zeros((b, e), gates.dtype)
+        masked = gates
+        for _ in range(self.top_k):
+            idx = jnp.argmax(masked, axis=-1)                    # [b]
+            sel = jax.nn.one_hot(idx, e, dtype=gates.dtype)      # [b, E]
+            # position of each token within its chosen expert's buffer,
+            # counting earlier rounds' fills
+            pos = (jnp.cumsum(sel, axis=0) - 1.0 +
+                   jnp.sum(fill, axis=0, keepdims=True)) * sel   # [b, E]
+            pos_idx = jnp.sum(pos, axis=-1).astype(jnp.int32)    # [b]
+            keep = (pos_idx < capacity).astype(gates.dtype)
+            slot = jax.nn.one_hot(pos_idx, capacity,
+                                  dtype=gates.dtype)             # [b, C]
+            d_i = sel[:, :, None] * slot[:, None, :] * keep[:, None, None]
+            dispatch = dispatch + d_i
+            gate = jnp.sum(gates * sel, axis=-1)                 # [b]
+            combine = combine + d_i * gate[:, None, None]
+            fill = fill + sel * keep[:, None]
+            masked = masked * (1.0 - sel)
+        # renormalize combine weights over the k selected experts
+        denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+        combine = combine / jnp.maximum(denom, 1e-9)
+        return dispatch, combine
+
+    def apply(self, params: Params, state: State, x: jax.Array,
+              ctx: LayerContext) -> Tuple[jax.Array, State]:
+        x = apply_input_dropout(self, x, ctx)
+        recurrent = x.ndim == 3
+        if recurrent:  # [b, f, t] -> tokens [b*t, f]
+            b_, f_, t_ = x.shape
+            x2 = jnp.transpose(x, (0, 2, 1)).reshape(b_ * t_, f_)
+        else:
+            x2 = x
+        n_tok = x2.shape[0]
+        e = self.num_experts
+        capacity = max(1, int(math.ceil(
+            self.top_k * n_tok / e * self.capacity_factor)))
+
+        gates = jax.nn.softmax(x2 @ params["Wg"], axis=-1)       # [b, E]
+        dispatch, combine = self._route(gates, capacity)
+
+        expert_in = jnp.einsum("bec,bd->ecd", dispatch, x2)      # [E, C, d]
+        h = jnp.einsum("ecd,edh->ech", expert_in, params["We1"]) \
+            + params["be1"][:, None, :]
+        act = self.activation or Activation.RELU
+        h = act(h)
+        out_e = jnp.einsum("ech,eho->eco", h, params["We2"]) \
+            + params["be2"][:, None, :]
+        y = jnp.einsum("bec,eco->bo", combine, out_e)            # [b, o]
+
+        # load-balance diagnostic (GShard aux): fraction routed per expert
+        # x mean gate mass per expert, E-scaled; exposed via state for
+        # listeners, NOT added to the training loss
+        frac = jnp.mean(jnp.sum(dispatch, axis=-1), axis=0)
+        mass = jnp.mean(gates, axis=0)
+        new_state = dict(state)
+        new_state["aux_load_balance"] = e * jnp.sum(frac * mass)
+
+        if recurrent:
+            y = jnp.transpose(y.reshape(b_, t_, self.n_out), (0, 2, 1))
+        return y, new_state

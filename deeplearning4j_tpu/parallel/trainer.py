@@ -90,14 +90,37 @@ class DistributedTrainer:
         self.optim = LayerOptimizers(model)
         self._replicated = NamedSharding(self.mesh, P())
         self._data_sharding = NamedSharding(self.mesh, P(data_axis))  # batch dim sharded
-        self.params = jax.device_put(model.params, self._param_shardings())
-        self.state = jax.device_put(model.state, self._replicated)
-        self.opt_state = jax.device_put(self.optim.init(self.params), self._replicated)
-        self.strat_state = jax.device_put(
-            self.strategy.init_state(self.params), self._replicated
-        )
+        # Multi-process ("multi-node without a cluster", SURVEY §4): the mesh
+        # spans devices this process cannot address, so global arrays are
+        # assembled from process-local data. Pure DP only — every process
+        # must hold identical params (same seed), the reference's
+        # SharedTrainingWrapper contract.
+        self._multiprocess = jax.process_count() > 1 and any(
+            d.process_index != jax.process_index() for d in self.mesh.devices.flat)
+        if self._multiprocess and self.rules:
+            raise ValueError(
+                "param_sharding_rules (TP) is single-process; multi-process "
+                "training is data-parallel with replicated params")
+        self.params = self._put_tree(model.params, self._param_shardings())
+        self.state = self._put_tree(model.state, self._replicated)
+        self.opt_state = self._put_tree(
+            self.optim.init(model.params), self._replicated)
+        self.strat_state = self._put_tree(
+            self.strategy.init_state(model.params), self._replicated)
         self.iteration = 0
         self._step = None
+
+    def _put_tree(self, tree, shardings):
+        if not self._multiprocess:
+            return jax.device_put(tree, shardings)
+
+        def put_one(leaf, sh):
+            return jax.make_array_from_process_local_data(sh, np.asarray(leaf))
+
+        if isinstance(shardings, NamedSharding):
+            return jax.tree_util.tree_map(
+                lambda leaf: put_one(leaf, shardings), tree)
+        return jax.tree_util.tree_map(put_one, tree, shardings)
 
     # ----- shardings -------------------------------------------------
     def _spec_for(self, path: str) -> P:
@@ -208,10 +231,21 @@ class DistributedTrainer:
         x = as_input_np(x, model.dtype, self._keeps_int_input())
         y = np.asarray(y)
         n = self.n_data_shards
-        if x.shape[0] % n:
-            raise ValueError(f"batch {x.shape[0]} not divisible by data axis {n}")
-        x = jax.device_put(x, self._data_sharding)
-        y = jax.device_put(y, self._data_sharding)
+        if self._multiprocess:
+            # each process feeds its LOCAL rows; the global batch is the
+            # concatenation across processes (local_rows * process_count)
+            global_rows = x.shape[0] * jax.process_count()
+            if global_rows % n:
+                raise ValueError(
+                    f"global batch {global_rows} not divisible by data axis {n}")
+            x = jax.make_array_from_process_local_data(self._data_sharding, x)
+            y = jax.make_array_from_process_local_data(self._data_sharding, y)
+        else:
+            if x.shape[0] % n:
+                raise ValueError(
+                    f"batch {x.shape[0]} not divisible by data axis {n}")
+            x = jax.device_put(x, self._data_sharding)
+            y = jax.device_put(y, self._data_sharding)
         rng = model._rng.next_key()
         self.iteration += 1
         it = jnp.asarray(self.iteration, jnp.int32)
@@ -236,6 +270,13 @@ class DistributedTrainer:
 
         model = self.model
         n = self.n_data_shards
+        if self._multiprocess:
+            # fit() sees only this process's LOCAL rows; the divisibility
+            # unit is the local shard count. Every process MUST iterate the
+            # same number of identically-sized batches (the reference's
+            # Spark repartition contract) — a shorter stream on one process
+            # would leave the others blocked in the all-reduce.
+            n = max(n // jax.process_count(), 1)
         last = None
         sync = bool(model.listeners.listeners)
         for _ in range(epochs):
@@ -309,8 +350,11 @@ class DistributedTrainer:
                 out_shardings=self._data_sharding,
             )
         self._reconcile_params()
-        return self._fwd(self.params, self.state,
-                         as_input(x, model.dtype, self._keeps_int_input()))
+        xa = as_input_np(x, model.dtype, self._keeps_int_input())
+        if self._multiprocess:  # local rows -> global array (as in fit_batch)
+            xa = jax.make_array_from_process_local_data(
+                self._data_sharding, np.asarray(xa))
+        return self._fwd(self.params, self.state, xa)
 
     def _reconcile_params(self) -> None:
         """For strategies whose replicas drift between sync points
