@@ -100,10 +100,13 @@ class MixtureOfExpertsLayer(Layer):
             "be2": jnp.zeros((e, o), dtype),
         }
 
-    def _route(self, gates: jax.Array, capacity: int):
+    def _route(self, gates: jax.Array, capacity: int,
+               token_mask: Optional[jax.Array] = None):
         """Top-k dense dispatch: returns (dispatch [b, E, C] 0/1,
         combine [b, E, C] gate-weighted). Position assignment is
-        first-come-first-served in batch order per expert (GShard)."""
+        first-come-first-served in batch order per expert (GShard).
+        ``token_mask`` [b] excludes padding tokens entirely: they claim no
+        capacity slot and contribute nothing to dispatch/combine."""
         b, e = gates.shape
         dispatch = jnp.zeros((b, e, capacity), gates.dtype)
         combine = jnp.zeros((b, e, capacity), gates.dtype)
@@ -113,6 +116,8 @@ class MixtureOfExpertsLayer(Layer):
         for _ in range(self.top_k):
             idx = jnp.argmax(masked, axis=-1)                    # [b]
             sel = jax.nn.one_hot(idx, e, dtype=gates.dtype)      # [b, E]
+            if token_mask is not None:
+                sel = sel * token_mask[:, None]
             # position of each token within its chosen expert's buffer,
             # counting earlier rounds' fills
             pos = (jnp.cumsum(sel, axis=0) - 1.0 +
@@ -146,8 +151,13 @@ class MixtureOfExpertsLayer(Layer):
         capacity = max(1, int(math.ceil(
             self.top_k * n_tok / e * self.capacity_factor)))
 
+        token_mask = None
+        if recurrent and ctx.mask is not None:  # [b, t] -> [b*t]
+            token_mask = jnp.reshape(
+                jnp.asarray(ctx.mask, x2.dtype), (b_ * t_,))
+
         gates = jax.nn.softmax(x2 @ params["Wg"], axis=-1)       # [b, E]
-        dispatch, combine = self._route(gates, capacity)
+        dispatch, combine = self._route(gates, capacity, token_mask)
 
         expert_in = jnp.einsum("bec,bd->ecd", dispatch, x2)      # [E, C, d]
         h = jnp.einsum("ecd,edh->ech", expert_in, params["We1"]) \
@@ -160,9 +170,14 @@ class MixtureOfExpertsLayer(Layer):
 
         # load-balance diagnostic (GShard aux): fraction routed per expert
         # x mean gate mass per expert, E-scaled; exposed via state for
-        # listeners, NOT added to the training loss
-        frac = jnp.mean(jnp.sum(dispatch, axis=-1), axis=0)
-        mass = jnp.mean(gates, axis=0)
+        # listeners, NOT added to the training loss. Real tokens only.
+        if token_mask is not None:
+            denom_tok = jnp.maximum(jnp.sum(token_mask), 1.0)
+            frac = jnp.sum(jnp.sum(dispatch, axis=-1), axis=0) / denom_tok
+            mass = jnp.sum(gates * token_mask[:, None], axis=0) / denom_tok
+        else:
+            frac = jnp.mean(jnp.sum(dispatch, axis=-1), axis=0)
+            mass = jnp.mean(gates, axis=0)
         new_state = dict(state)
         new_state["aux_load_balance"] = e * jnp.sum(frac * mass)
 

@@ -32,6 +32,8 @@ from .strategies import (
     ThresholdCompressedSync,
 )
 from .sequence import ring_attention, ulysses_attention
+from .pipeline import (dense_block_stage, pipeline_apply,
+                       pipeline_stages_init, shard_stage_params)
 from .trainer import DistributedTrainer
 from .inference import InferenceMode, ParallelInference
 
@@ -40,6 +42,10 @@ __all__ = [
     "shard_rows",
     "DistributedTrainer",
     "ring_attention",
+    "pipeline_apply",
+    "pipeline_stages_init",
+    "shard_stage_params",
+    "dense_block_stage",
     "ulysses_attention",
     "GradientSyncStrategy",
     "InferenceMode",

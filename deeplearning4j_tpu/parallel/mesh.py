@@ -144,3 +144,25 @@ def force_host_device_count(n: int) -> None:
         os.environ.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={n}"
     ).strip()
     os.environ[_ENV_FLAG] = str(n)
+
+
+# ---- shard_map compatibility shim (single home; jax renamed check_rep ->
+# check_vma across versions, and moved shard_map out of experimental) ------
+try:  # jax >= 0.6 public API
+    from jax import shard_map as _shard_map  # type: ignore
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore
+
+
+def shmap(fn, mesh, in_specs, out_specs):
+    """shard_map with replication checking off, across jax API versions."""
+    try:
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
+    except TypeError:
+        try:
+            return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=False)
+        except TypeError:
+            return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs)

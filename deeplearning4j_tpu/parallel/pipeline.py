@@ -1,0 +1,125 @@
+"""Pipeline parallelism (GPipe-style microbatch pipelining).
+
+Beyond-reference capability (SURVEY §2.3: PP absent upstream — "model must
+fit on one device"). TPU-native design: the layer stack is split into S
+uniform stages whose stacked params shard over a ``pipe`` mesh axis; a
+``shard_map`` + ``lax.scan`` schedule runs M microbatches through
+M + S - 1 ticks, handing activations to the next stage with ``ppermute``
+each tick (the neighbor transfer rides ICI). Reverse-mode AD differentiates
+straight through the schedule — the backward pass is the reversed pipeline
+with reversed ppermutes, which is exactly GPipe's backward.
+
+Constraint (the classic one): every stage maps [mb, d] -> [mb, d] with
+identical shapes — transformer-block pipelining. Stage 0 additionally owns
+an input projection and the last stage an output head, applied outside the
+rotated region.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import shmap as _shmap
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    axis: str = "pipe",
+) -> jax.Array:
+    """Run ``x`` through S pipelined stages.
+
+    ``stage_params``: pytree whose leaves have leading dim S (one slice per
+    stage), sharded over ``axis``. ``x``: [M, mb, d] microbatches.
+    ``stage_fn(params_slice, act) -> act`` with identical act shapes.
+    Returns [M, mb, d] — equal to folding the stages sequentially.
+    """
+    n_stages = mesh.shape[axis]
+    n_micro = x.shape[0]
+    for path, leaf in jax.tree_util.tree_flatten_with_path(stage_params)[0]:
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stage_params leaf {jax.tree_util.keystr(path)} has leading "
+                f"dim {leaf.shape[0]} but the {axis!r} mesh axis has "
+                f"{n_stages} stages — each shard would silently apply only "
+                "its first slice")
+
+    def worker(params, xs):
+        # params leaves [1, ...] (this stage's slice); xs [M, mb, d]
+        # replicated. Stage index: position along the pipe axis.
+        idx = lax.axis_index(axis)
+        p_local = jax.tree_util.tree_map(lambda a: a[0], params)
+        mb_shape = xs.shape[1:]
+        buf = jnp.zeros((n_micro,) + mb_shape, xs.dtype)  # last-stage output
+        carry = jnp.zeros(mb_shape, xs.dtype)  # activation arriving this tick
+
+        def tick(state, t):
+            carry, buf = state
+            # stage 0 injects microbatch t (when one is still due)
+            inject = jnp.where(t < n_micro, t, n_micro - 1)
+            act_in = jnp.where(idx == 0, xs[inject], carry)
+            act_out = stage_fn(p_local, act_in)
+            # the last stage banks microbatch t - (S - 1) as it completes
+            done = t - (n_stages - 1)
+            slot = jnp.clip(done, 0, n_micro - 1)
+            bank = (idx == n_stages - 1) & (done >= 0)
+            buf = lax.dynamic_update_index_in_dim(
+                buf,
+                jnp.where(bank, act_out,
+                          lax.dynamic_index_in_dim(buf, slot, 0, False)),
+                slot, 0)
+            # rotate: stage i's output becomes stage i+1's next input
+            nxt = lax.ppermute(
+                act_out, axis,
+                [(i, (i + 1) % n_stages) for i in range(n_stages)])
+            return (nxt, buf), None
+
+        (carry, buf), _ = lax.scan(
+            tick, (carry, buf), jnp.arange(n_micro + n_stages - 1))
+        # every device returns its buf; only the last stage's is filled —
+        # psum-select so the result is replicated
+        keep = (idx == n_stages - 1).astype(buf.dtype)
+        return lax.psum(buf * keep, axis)
+
+    spec_params = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    mapped = _shmap(worker, mesh, in_specs=(spec_params, P()),
+                    out_specs=P())
+    return mapped(stage_params, x)
+
+
+def pipeline_stages_init(
+    key: jax.Array, n_stages: int, d: int, hidden: int,
+    dtype=jnp.float32,
+):
+    """Stacked params for S identical dense blocks (tanh MLP with residual):
+    the standard pipelined-transformer-block stand-in."""
+    k1, k2 = jax.random.split(key)
+    s1 = 1.0 / np.sqrt(d)
+    s2 = 1.0 / np.sqrt(hidden)
+    return {
+        "W1": jax.random.uniform(k1, (n_stages, d, hidden), dtype, -s1, s1),
+        "b1": jnp.zeros((n_stages, hidden), dtype),
+        "W2": jax.random.uniform(k2, (n_stages, hidden, d), dtype, -s2, s2),
+        "b2": jnp.zeros((n_stages, d), dtype),
+    }
+
+
+def dense_block_stage(p, x):
+    """One pipeline stage: residual tanh MLP [mb, d] -> [mb, d]."""
+    h = jnp.tanh(x @ p["W1"] + p["b1"])
+    return x + h @ p["W2"] + p["b2"]
+
+
+def shard_stage_params(stage_params, mesh: Mesh, axis: str = "pipe"):
+    """Place the stacked stage params with the leading dim over ``axis``."""
+    sh = NamedSharding(mesh, P(axis))
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh),
+                                  stage_params)

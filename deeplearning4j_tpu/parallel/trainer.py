@@ -27,27 +27,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.6 public API
-    from jax import shard_map as _shard_map  # type: ignore
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore
-
 from ..core.dtypes import as_input, as_input_np
 from ..train.solver import LayerOptimizers, _normalize_gradients
-from .mesh import make_mesh
+from .mesh import make_mesh, shmap
 from .strategies import GradientSyncStrategy, SyncAllReduce
 
 
-def _shmap(fn, mesh, in_specs, out_specs):
-    try:
-        return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                          check_rep=False)
-    except TypeError:  # newer jax renamed/removed check_rep
-        try:
-            return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                              check_vma=False)
-        except TypeError:
-            return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+_shmap = shmap  # single-home compatibility shim (parallel/mesh.py)
 
 
 class DistributedTrainer:

@@ -127,3 +127,30 @@ def test_expert_parallel_matches_single_device():
                 np.asarray(jax.device_get(t_ep.params[ln][k])),
                 np.asarray(jax.device_get(t_ref.params[ln][k])),
                 rtol=2e-3, atol=2e-5, err_msg=f"{ln}/{k}")
+
+
+def test_masked_tokens_claim_no_capacity():
+    """Padding tokens (ctx.mask=0) must not consume expert capacity slots
+    or influence real-token outputs (recurrent [b, f, t] input path)."""
+    lay, params = _layer(k=1, cap=0.5)  # tight capacity
+    rs = np.random.RandomState(6)
+    b, d, t = 2, 8, 6
+    x = np.asarray(rs.rand(b, d, t), np.float32)
+    mask = np.ones((b, t), np.float32)
+    mask[:, t // 2:] = 0.0  # second half is padding
+
+    # padding CONTENT must be irrelevant: swap it for adversarial values
+    # that would (unmasked) win every router argmax and steal all slots
+    x2 = x.copy()
+    x2[:, :, t // 2:] = 50.0
+
+    y1, state = lay.apply(params, lay.init_state(jnp.float32),
+                          jnp.asarray(x), LayerContext(mask=jnp.asarray(mask)))
+    y2, _ = lay.apply(params, lay.init_state(jnp.float32),
+                      jnp.asarray(x2), LayerContext(mask=jnp.asarray(mask)))
+    np.testing.assert_allclose(np.asarray(y1)[:, :, :t // 2],
+                               np.asarray(y2)[:, :, :t // 2],
+                               rtol=1e-5, atol=1e-6)
+    # padding positions get no combine weight -> zero output rows
+    np.testing.assert_allclose(np.asarray(y1)[:, :, t // 2:], 0.0, atol=1e-6)
+    assert np.isfinite(float(state["aux_load_balance"]))
