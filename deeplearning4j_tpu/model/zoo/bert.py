@@ -41,6 +41,7 @@ class BertEncoder:
         updater=None,
         dtype: str = "float32",
         compute_dtype: str = None,
+        gradient_checkpointing: bool = False,
     ) -> None:
         self.vocab_size = vocab_size
         self.hidden = hidden
@@ -52,6 +53,7 @@ class BertEncoder:
         self.updater = updater or Adam(1e-4)
         self.dtype = dtype
         self.compute_dtype = compute_dtype
+        self.gradient_checkpointing = gradient_checkpointing
 
     def _block(self, g, name: str, inp: str) -> str:
         """Pre-LN transformer block: x + Attn(LN(x)), then x + FFN(LN(x))."""
@@ -82,6 +84,7 @@ class BertEncoder:
             .seed(self.seed)
             .data_type(self.dtype)
             .compute_dtype(self.compute_dtype)
+            .gradient_checkpointing(self.gradient_checkpointing)
             .updater(self.updater)
             .weight_init(WeightInit.XAVIER)
             .graph_builder()

@@ -179,6 +179,11 @@ class MultiLayerConfiguration:
     tbptt_back_length: int = 20
     gradient_normalization: GradientNormalization = GradientNormalization.NONE
     gradient_normalization_threshold: float = 1.0
+    # Per-layer rematerialization (jax.checkpoint around each layer apply):
+    # frees intra-layer intermediates (attention probs, FFN hidden) in the
+    # backward at the cost of one recompute — the HBM/FLOPs trade
+    # (SURVEY §7 "jax.checkpoint / rematerialisation").
+    gradient_checkpointing: bool = False
     mini_batch: bool = True
     max_num_line_search_iterations: int = 5
     training_workspace_mode: WorkspaceMode = WorkspaceMode.ENABLED
@@ -264,6 +269,7 @@ class ListBuilder:
             tbptt_back_length=self._tbptt_back,
             gradient_normalization=p._grad_norm,
             gradient_normalization_threshold=p._grad_norm_threshold,
+            gradient_checkpointing=p._grad_ckpt,
             mini_batch=p._mini_batch,
             training_workspace_mode=p._train_ws,
             inference_workspace_mode=p._infer_ws,
@@ -277,6 +283,7 @@ class NeuralNetConfigurationBuilder:
         self._seed = 0
         self._dtype = "float32"
         self._compute_dtype: Optional[str] = None
+        self._grad_ckpt: bool = False
         self._activation: Optional[Activation] = None
         self._weight_init: Optional[WeightInit] = None
         self._dist: Optional[Distribution] = None
@@ -306,6 +313,11 @@ class NeuralNetConfigurationBuilder:
         """Mixed-precision compute dtype (e.g. "bfloat16"); params stay in
         ``data_type``. See MultiLayerConfiguration.compute_dtype."""
         self._compute_dtype = dtype
+        return self
+
+    def gradient_checkpointing(self, enabled: bool = True) -> "NeuralNetConfigurationBuilder":
+        """Remat each layer in the backward pass (activation-memory saver)."""
+        self._grad_ckpt = bool(enabled)
         return self
 
     def activation(self, a) -> "NeuralNetConfigurationBuilder":

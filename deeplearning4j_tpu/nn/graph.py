@@ -23,7 +23,7 @@ from ..core.dtypes import as_input
 from ..core.listeners import ListenerBus, TrainingListener
 from ..core.rng import RngState
 from .graph_conf import ComputationGraphConfiguration, VertexSpec
-from .layers.base import Layer, LayerContext
+from .layers.base import Layer, LayerContext, apply_layer as _apply_layer
 from .layers.output import BaseOutputLayer
 from .sequential import _layer_reg_score
 
@@ -137,7 +137,9 @@ class ComputationGraph:
                 if spec.preprocessor is not None:
                     x, _ = spec.preprocessor.apply({}, {}, x, ctx)
                 lstate = dict(state.get(spec.name, {}))
-                y, lstate_out = spec.layer.apply(params.get(spec.name, {}), lstate, x, ctx)
+                y, lstate_out = _apply_layer(
+                    spec.layer, params.get(spec.name, {}), lstate, x, ctx,
+                    remat=self.conf.gradient_checkpointing and train)
                 persistent = self._persistent_keys.get(spec.name, ())
                 new_state[spec.name] = {k: v for k, v in lstate_out.items() if k in persistent}
                 vmasks[spec.name] = spec.layer.feed_forward_mask(in_mask, None) if in_mask is not None else None
@@ -197,7 +199,9 @@ class ComputationGraph:
                         params.get(spec.name, {}), x, label_by_output[spec.name],
                         ctx, label_mask=lmask_by_output.get(spec.name),
                     )
-                y, lstate_out = spec.layer.apply(params.get(spec.name, {}), lstate, x, ctx)
+                y, lstate_out = _apply_layer(
+                    spec.layer, params.get(spec.name, {}), lstate, x, ctx,
+                    remat=self.conf.gradient_checkpointing and train)
                 persistent = self._persistent_keys.get(spec.name, ())
                 new_state[spec.name] = {k: v for k, v in lstate_out.items() if k in persistent}
                 vmasks[spec.name] = None if in_mask is None else spec.layer.feed_forward_mask(in_mask, None)

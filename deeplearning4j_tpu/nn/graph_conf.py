@@ -59,6 +59,7 @@ class ComputationGraphConfiguration:
     tbptt_back_length: int = 20
     gradient_normalization: GradientNormalization = GradientNormalization.NONE
     gradient_normalization_threshold: float = 1.0
+    gradient_checkpointing: bool = False  # see MultiLayerConfiguration
     training_workspace_mode: WorkspaceMode = WorkspaceMode.ENABLED
     inference_workspace_mode: WorkspaceMode = WorkspaceMode.ENABLED
 
@@ -204,6 +205,7 @@ class GraphBuilder:
             tbptt_back_length=self._tbptt_back,
             gradient_normalization=p._grad_norm,
             gradient_normalization_threshold=p._grad_norm_threshold,
+            gradient_checkpointing=p._grad_ckpt,
             training_workspace_mode=p._train_ws,
             inference_workspace_mode=p._infer_ws,
         )

@@ -126,3 +126,18 @@ def apply_input_dropout(cfg: Layer, x: jax.Array, ctx: LayerContext) -> jax.Arra
         return x
     keep = jax.random.bernoulli(ctx.rng, retain, x.shape)
     return jax.numpy.where(keep, x / retain, 0.0).astype(x.dtype)
+
+
+def apply_layer(layer, lparams, lstate, x, ctx, *, remat: bool = False):
+    """Layer apply, optionally under jax.checkpoint: the backward then
+    recomputes this layer's intermediates (attention probs, FFN hidden)
+    instead of holding them in HBM — SURVEY §7's remat trade. Homed here
+    next to LayerContext so both network classes import it cycle-free."""
+    if not remat:
+        return layer.apply(lparams, lstate, x, ctx)
+
+    def fn(p, s, xx, key, mask):
+        c = LayerContext(train=ctx.train, rng=key, mask=mask)
+        return layer.apply(p, s, xx, c)
+
+    return jax.checkpoint(fn)(lparams, lstate, x, ctx.rng, ctx.mask)

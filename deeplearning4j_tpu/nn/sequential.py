@@ -33,7 +33,7 @@ from ..core.listeners import ListenerBus, TrainingListener
 from ..core.rng import RngState
 from .conf import BackpropType, MultiLayerConfiguration
 from .input_type import RecurrentType
-from .layers.base import Layer, LayerContext
+from .layers.base import Layer, LayerContext, apply_layer as _apply_layer
 from .layers.output import BaseOutputLayer
 
 
@@ -159,7 +159,9 @@ class MultiLayerNetwork:
                 lstate.update(rnn_state[name])
             key = jax.random.fold_in(rng, i) if rng is not None else None
             ctx = LayerContext(train=train, rng=key, mask=cur_mask)
-            y, lstate_out = layer.apply(params.get(name, {}), lstate, x, ctx)
+            y, lstate_out = _apply_layer(
+                layer, params.get(name, {}), lstate, x, ctx,
+                remat=self.conf.gradient_checkpointing and train)
             persistent = self._persistent_keys.get(name, ())
             new_state[name] = {k: v for k, v in lstate_out.items() if k in persistent}
             transient = {k: v for k, v in lstate_out.items() if k not in persistent}

@@ -185,3 +185,63 @@ def test_bert_encoder_f32_graph_shapes():
     ids = jnp.zeros((2, 8), jnp.int32)
     out = model.output(ids)
     assert out.shape == (2, 40, 8)
+
+
+def test_gradient_checkpointing_preserves_values():
+    """Per-layer remat (SURVEY §7 jax.checkpoint trade) must not change the
+    training math: same seed, same batch -> identical losses with and
+    without gradient_checkpointing, on both Sequential and Graph paths."""
+    import numpy as np
+
+    from deeplearning4j_tpu.model.zoo import BertEncoder
+    from deeplearning4j_tpu.train.graph_solver import GraphSolver
+
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 300, (2, 16)).astype(np.int32)
+
+    def losses(remat):
+        enc = BertEncoder(vocab_size=300, hidden=32, n_layers=2, n_heads=2,
+                          ffn_size=64, max_len=32, seed=11,
+                          gradient_checkpointing=remat)
+        model = enc.init()
+        s = GraphSolver(model)
+        return [float(s.fit_batch((ids,), (ids,))) for _ in range(3)]
+
+    np.testing.assert_allclose(losses(False), losses(True), rtol=1e-6)
+
+
+def test_gradient_checkpointing_sequential_with_masks():
+    """Sequential path under remat: identical losses with/without, on a
+    recurrent net with dropout rng + sequence masks (exercises the
+    rng/mask threading through the checkpointed fn)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.nn import (Activation, InputType, LossFunction,
+                                       NeuralNetConfiguration, WeightInit)
+    from deeplearning4j_tpu.nn.layers import LSTMLayer, RnnOutputLayer
+    from deeplearning4j_tpu.nn.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.train.solver import Solver
+    from deeplearning4j_tpu.train.updaters import Sgd
+
+    rs = np.random.RandomState(1)
+    x = rs.rand(2, 4, 6).astype(np.float32)  # [b, f, t]
+    y = rs.rand(2, 3, 6).astype(np.float32)
+    mask = np.ones((2, 6), np.float32)
+    mask[:, 4:] = 0.0
+
+    def losses(remat):
+        b = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.1))
+             .weight_init(WeightInit.XAVIER))
+        if remat:
+            b = b.gradient_checkpointing(True)
+        conf = (b.list()
+                .layer(LSTMLayer(n_out=8, activation=Activation.TANH,
+                                 dropout=0.9))
+                .layer(RnnOutputLayer(n_out=3, loss=LossFunction.MSE,
+                                      activation=Activation.IDENTITY))
+                .set_input_type(InputType.recurrent(4, 6)).build())
+        net = MultiLayerNetwork(conf).init()
+        s = Solver(net)
+        return [float(s.fit_batch(x, y, mask=mask)[0]) for _ in range(3)]
+
+    np.testing.assert_allclose(losses(False), losses(True), rtol=1e-6)
