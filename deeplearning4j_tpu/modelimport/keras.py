@@ -67,6 +67,38 @@ _ACTIVATIONS = {
 _LSTM_GATE_PERM = (0, 1, 3, 2)
 
 
+# ---- custom layer registry (reference: KerasLayer.registerCustomLayer +
+# KerasLambdaLayer). Custom classes map class_name -> handler(importer,
+# conf); Lambda layers map LAYER NAME -> a python callable (Keras
+# serializes Lambda bodies as marshalled bytecode, which no importer can
+# portably execute — the reference requires pre-registering a
+# SameDiffLambdaLayer the same way).
+KERAS_CUSTOM_LAYERS: Dict[str, Any] = {}
+KERAS_LAMBDAS: Dict[str, Any] = {}
+
+
+def register_keras_custom_layer(class_name: str, handler=None):
+    """Register an import handler for a custom Keras layer class.
+    ``handler(importer, conf)`` appends to importer.layers/params.
+    Usable as a decorator."""
+    def deco(fn):
+        KERAS_CUSTOM_LAYERS[class_name] = fn
+        return fn
+
+    return deco(handler) if handler is not None else deco
+
+
+def register_keras_lambda(layer_name: str, fn=None):
+    """Register the forward fn for a Keras ``Lambda`` layer by its layer
+    NAME (``fn(x) -> array`` or ``fn(sd, x)``, SameDiffLambdaLayer
+    contract)."""
+    def deco(f):
+        KERAS_LAMBDAS[layer_name] = f
+        return f
+
+    return deco(fn) if fn is not None else deco
+
+
 class KerasImportError(ValueError):
     pass
 
@@ -176,6 +208,15 @@ class _SequentialImporter:
                 continue
             if self.shape is None and "batch_input_shape" in conf:
                 self.shape = _Shape(tuple(conf["batch_input_shape"][1:]))
+            # registered custom classes; keras serializes registered
+            # classes as "package>ClassName" — accept both spellings
+            custom = KERAS_CUSTOM_LAYERS.get(cls) \
+                or KERAS_CUSTOM_LAYERS.get(cls.split(">")[-1])
+            if handler is None and custom is not None:
+                if self.shape is None:
+                    raise KerasImportError("no input shape before first layer")
+                custom(self, conf)
+                continue
             if handler is None:
                 raise KerasImportError(
                     f"unsupported Keras layer {cls!r} ({conf.get('name')})")
@@ -183,6 +224,20 @@ class _SequentialImporter:
                 raise KerasImportError("no input shape before first layer")
             handler(conf)
         return self.layers, self.params, self.state
+
+    def _import_Lambda(self, conf):
+        from ..nn.layers.samediff_layer import SameDiffLambdaLayer
+
+        name = conf.get("name")
+        fn = KERAS_LAMBDAS.get(name)
+        if fn is None:
+            raise KerasImportError(
+                f"Lambda layer {name!r}: Keras serializes Lambda bodies as "
+                "marshalled bytecode, which cannot be imported portably — "
+                "register the forward with "
+                f"register_keras_lambda({name!r}, fn) first "
+                "(reference: SameDiffLambdaLayer registration)")
+        self._add(SameDiffLambdaLayer(fn=fn, name=name))
 
     # --- per-class handlers -------------------------------------------
 

@@ -277,3 +277,70 @@ def test_embedding_lstm_import(tmp_path):
     ours = KerasModelImport.import_keras_model_and_weights(path)
     got = np.asarray(ours.output(ids.astype(np.int32)))
     np.testing.assert_allclose(got, expected, atol=1e-4, rtol=1e-3)
+
+
+def test_lambda_layer_via_registry(tmp_path):
+    """Lambda imports through the pre-registered forward (the reference's
+    SameDiffLambdaLayer registration contract); unregistered Lambda fails
+    with a clear error."""
+    from deeplearning4j_tpu.modelimport.keras import (
+        KERAS_LAMBDAS, register_keras_lambda,
+    )
+
+    m = keras.Sequential([
+        keras.layers.Input((6,)),
+        keras.layers.Dense(5, activation="relu"),
+        keras.layers.Lambda(lambda t: t * 2.0 + 1.0, name="double_shift"),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    x = np.random.RandomState(3).randn(4, 6).astype(np.float32)
+
+    path = str(tmp_path / "lam.h5")
+    m.save(path)
+    with pytest.raises(KerasImportError, match="register_keras_lambda"):
+        KerasModelImport.import_keras_model_and_weights(path)
+
+    register_keras_lambda("double_shift", lambda t: t * 2.0 + 1.0)
+    try:
+        ours = KerasModelImport.import_keras_model_and_weights(path)
+        got = np.asarray(ours.output(x))
+        np.testing.assert_allclose(got, np.asarray(m(x)), atol=1e-4,
+                                   rtol=1e-3)
+    finally:
+        KERAS_LAMBDAS.pop("double_shift", None)
+
+
+def test_custom_layer_registry(tmp_path):
+    """A custom Keras class imports through a registered handler
+    (reference: KerasLayer.registerCustomLayer)."""
+    from deeplearning4j_tpu.modelimport.keras import (
+        KERAS_CUSTOM_LAYERS, register_keras_custom_layer,
+    )
+    from deeplearning4j_tpu.nn.layers import ActivationLayer
+    from deeplearning4j_tpu.nn import Activation
+
+    @keras.utils.register_keras_serializable("test")
+    class Swish6(keras.layers.Layer):
+        def call(self, t):
+            return tf.nn.relu6(t)
+
+    m = keras.Sequential([
+        keras.layers.Input((4,)),
+        keras.layers.Dense(5),
+        Swish6(name="r6"),
+    ])
+    x = np.random.RandomState(4).randn(3, 4).astype(np.float32)
+    path = str(tmp_path / "custom.h5")
+    m.save(path)
+
+    register_keras_custom_layer(
+        "Swish6",
+        lambda imp, conf: imp._add(ActivationLayer(
+            name=conf["name"], activation=Activation.RELU6)))
+    try:
+        ours = KerasModelImport.import_keras_model_and_weights(path)
+        got = np.asarray(ours.output(x))
+        np.testing.assert_allclose(got, np.asarray(m(x)), atol=1e-4,
+                                   rtol=1e-3)
+    finally:
+        KERAS_CUSTOM_LAYERS.pop("Swish6", None)
