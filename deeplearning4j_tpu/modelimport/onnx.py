@@ -536,6 +536,280 @@ def _resize(ctx):
                                size=sizes[2:], data_format="NCHW")
 
 
+# ---- tranche-3 rule widening (mirrors the TF-import widening; SURVEY
+# §2.2 ONNX import breadth) --------------------------------------------------
+_SIMPLE_T3 = {
+    "Celu": "celu", "HardSwish": "hard_swish", "Mish": "mish",
+    "ThresholdedRelu": "thresholded_relu", "PRelu": "prelu",
+    "Xor": "logical_xor", "Mod": "mod",
+    "BitwiseAnd": "bitwise_and", "BitwiseOr": "bitwise_or",
+    "BitwiseXor": "bitwise_xor", "BitwiseNot": "bitwise_not",
+    "Det": "matrix_determinant", "Atan2": "atan2",
+    "Mod": None, "ReverseSequence": None,  # attr rules below
+}
+for _onnx_name, _sd_name in _SIMPLE_T3.items():
+    if _sd_name is None or _onnx_name in ONNX_OP_RULES:
+        continue
+
+    def _mk_t3(sd_name):
+        def rule(ctx: _NodeCtx):
+            return ctx.importer.sd._op(
+                sd_name, *(ctx.var(i) for i in range(len(ctx.inputs))),
+                name=ctx.outputs[0])
+
+        return rule
+
+    ONNX_OP_RULES[_onnx_name] = _mk_t3(_sd_name)
+
+
+ONNX_OP_RULES["ReduceLogSumExp"] = _reduce("logsumexp")
+
+
+@onnx_rule("ReduceL1", "ReduceL2", "ReduceSumSquare", "ReduceLogSum")
+def _reduce_composed(ctx):
+    sd = ctx.importer.sd
+    axes = ctx.a_ints("axes")
+    if axes is None and ctx.has(1):  # opset 18 axes-as-input
+        axes = [int(v) for v in ctx.const_value(1).reshape(-1)]
+    keep = bool(ctx.a_int("keepdims", 1))
+    x = ctx.var(0)
+    pre = {"ReduceL1": "abs", "ReduceL2": "square",
+           "ReduceSumSquare": "square", "ReduceLogSum": None}[ctx.op]
+    if pre:
+        x = sd._op(pre, x)
+    s = sd._op("reduce_sum", x, axis=None if axes is None else axes,
+               keepdims=keep,
+               name=ctx.outputs[0] if ctx.op in ("ReduceL1",
+                                                 "ReduceSumSquare") else None)
+    if ctx.op == "ReduceL2":
+        return sd._op("sqrt", s, name=ctx.outputs[0])
+    if ctx.op == "ReduceLogSum":
+        return sd._op("log", s, name=ctx.outputs[0])
+    return s
+
+
+@onnx_rule("ConvTranspose")
+def _conv_transpose(ctx):
+    sd = ctx.importer.sd
+    if ctx.a_int("group", 1) != 1:
+        raise NotImplementedError("grouped ConvTranspose unsupported")
+    kernel = ctx.a_ints("kernel_shape")
+    if kernel is not None and len(kernel) != 2:
+        raise NotImplementedError("ConvTranspose 2D only")
+    # ONNX W [C, M, kH, kW] -> our deconv2d forward-kernel [kH, kW, M, C]
+    w_name = ctx.inputs[1]
+    if w_name in ctx.importer.const_values:
+        w_np = ctx.importer.const_values[w_name].transpose(2, 3, 1, 0)
+        w = sd.constant(np.ascontiguousarray(w_np))
+    else:
+        w = sd._op("transpose", ctx.var(1), perm=[2, 3, 1, 0])
+    if "output_padding" in ctx.attr or "output_shape" in ctx.attr:
+        raise NotImplementedError(
+            "ConvTranspose output_padding/output_shape unsupported")
+    pads = ctx.a_ints("pads", [0, 0, 0, 0])
+    strides_ = ctx.a_ints("strides", [1, 1])
+    kern = ctx.a_ints("kernel_shape")
+    if not any(pads):
+        padding = "VALID"
+    else:
+        # ONNX out = (in-1)*s + k - total_pad; total_pad == k - s gives
+        # out = in*s, exactly lax SAME — anything else has no string form
+        tot = [pads[0] + pads[2], pads[1] + pads[3]]
+        if kern is not None and all(
+                t == k - st for t, k, st in zip(tot, kern, strides_)):
+            padding = "SAME"
+        else:
+            raise NotImplementedError(
+                f"ConvTranspose pads={pads} (kernel={kern}, "
+                f"strides={strides_}): only VALID (all-zero) or the "
+                "SAME-equivalent total pad k-s is supported")
+    bias = ctx.var(2) if ctx.has(2) else None
+    args = (ctx.var(0), w) if bias is None else (ctx.var(0), w, bias)
+    return sd._op("deconv2d", *args, name=ctx.outputs[0],
+                  strides=tuple(ctx.a_ints("strides", [1, 1])),
+                  padding=padding, data_format="NCHW")
+
+
+@onnx_rule("Mod")
+def _mod_onnx(ctx):
+    # fmod=1 (C fmod, REQUIRED for float inputs per spec) vs integer mod
+    op = "fmod" if ctx.a_int("fmod", 0) else "mod"
+    return ctx.importer.sd._op(op, ctx.var(0), ctx.var(1),
+                               name=ctx.outputs[0])
+
+
+@onnx_rule("InstanceNormalization")
+def _instance_norm(ctx):
+    return ctx.importer.sd._op(
+        "instance_norm", ctx.var(0), ctx.var(1), ctx.var(2),
+        name=ctx.outputs[0], eps=ctx.a_float("epsilon", 1e-5))
+
+
+@onnx_rule("GroupNormalization")
+def _group_norm(ctx):
+    return ctx.importer.sd._op(
+        "group_norm", ctx.var(0), ctx.var(1), ctx.var(2),
+        name=ctx.outputs[0], groups=ctx.a_int("num_groups"),
+        eps=ctx.a_float("epsilon", 1e-5))
+
+
+@onnx_rule("LRN")
+def _lrn_onnx(ctx):
+    # ONNX normalizes over channel dim of NCHW with alpha/size scaling:
+    # out = x / (bias + alpha/size * sqr_sum)^beta
+    size = ctx.a_int("size")
+    if size % 2 == 0:
+        raise NotImplementedError(
+            f"LRN size={size}: the symmetric window implementation "
+            "supports odd sizes only")
+    sd = ctx.importer.sd
+    # our op normalizes the LAST axis: NCHW -> NHWC -> back
+    x = sd._op("transpose", ctx.var(0), perm=[0, 2, 3, 1])
+    y = sd._op("local_response_normalization", x, depth=size,
+               bias=ctx.a_float("bias", 1.0),
+               alpha=ctx.a_float("alpha", 1e-4) / size,
+               beta=ctx.a_float("beta", 0.75))
+    return sd._op("transpose", y, name=ctx.outputs[0], perm=[0, 3, 1, 2])
+
+
+@onnx_rule("OneHot")
+def _one_hot_onnx(ctx):
+    depth = int(ctx.const_value(1))
+    values = ctx.const_value(2).reshape(-1)  # [off, on]
+    return ctx.importer.sd._op(
+        "one_hot", ctx.var(0), name=ctx.outputs[0], depth=depth,
+        axis=ctx.a_int("axis", -1), on_value=float(values[1]),
+        off_value=float(values[0]))
+
+
+@onnx_rule("TopK")
+def _top_k_onnx(ctx):
+    k = int(ctx.const_value(1))
+    sd = ctx.importer.sd
+    if ctx.a_int("axis", -1) not in (-1,):
+        raise NotImplementedError("TopK axis != -1 unsupported")
+    if not ctx.a_int("largest", 1):
+        raise NotImplementedError("TopK largest=0 unsupported")
+    tup = sd._op("top_k", ctx.var(0), k=k)
+    vals = sd._op("getitem", tup, item=0, name=ctx.outputs[0])
+    if len(ctx.outputs) > 1:
+        sd._op("getitem", tup, item=1, name=ctx.outputs[1])
+    return vals
+
+
+@onnx_rule("ScatterND")
+def _scatter_nd_onnx(ctx):
+    return ctx.importer.sd._op("scatter_nd_update", ctx.var(0), ctx.var(1),
+                               ctx.var(2), name=ctx.outputs[0])
+
+
+@onnx_rule("GatherElements")
+def _gather_elements(ctx):
+    return ctx.importer.sd._op("take_along_axis", ctx.var(0), ctx.var(1),
+                               name=ctx.outputs[0],
+                               axis=ctx.a_int("axis", 0))
+
+
+@onnx_rule("CumSum")
+def _cumsum_onnx(ctx):
+    return ctx.importer.sd._op(
+        "cumsum", ctx.var(0), name=ctx.outputs[0],
+        axis=int(ctx.const_value(1)),
+        exclusive=bool(ctx.a_int("exclusive", 0)),
+        reverse=bool(ctx.a_int("reverse", 0)))
+
+
+@onnx_rule("Trilu")
+def _trilu(ctx):
+    k = int(ctx.const_value(1)) if ctx.has(1) else 0
+    op = "triu" if ctx.a_int("upper", 1) else "tril"
+    return ctx.importer.sd._op(op, ctx.var(0), name=ctx.outputs[0], k=k)
+
+
+@onnx_rule("SpaceToDepth", "DepthToSpace")
+def _space_depth_onnx(ctx):
+    op = "space_to_depth" if ctx.op == "SpaceToDepth" else "depth_to_space"
+    if ctx.op == "DepthToSpace" and ctx.a_str("mode", "DCR") != "DCR":
+        # our NCHW depth_to_space matches ONNX's DCR element order
+        raise NotImplementedError("DepthToSpace CRD mode unsupported")
+    return ctx.importer.sd._op(op, ctx.var(0), name=ctx.outputs[0],
+                               block_size=ctx.a_int("blocksize"),
+                               data_format="NCHW")
+
+
+@onnx_rule("ReverseSequence")
+def _reverse_seq_onnx(ctx):
+    t_ax = ctx.a_int("time_axis", 0)
+    b_ax = ctx.a_int("batch_axis", 1)
+    sd = ctx.importer.sd
+    if b_ax == 0:
+        return sd._op("reverse_sequence", ctx.var(0), ctx.var(1),
+                      name=ctx.outputs[0], seq_axis=t_ax, batch_axis=0)
+    if (t_ax, b_ax) == (0, 1):
+        # spec-default time-major: transpose to batch-major and back
+        x = sd._op("swapaxes", ctx.var(0), a=0, b=1)
+        y = sd._op("reverse_sequence", x, ctx.var(1), seq_axis=1,
+                   batch_axis=0)
+        return sd._op("swapaxes", y, name=ctx.outputs[0], a=0, b=1)
+    raise NotImplementedError(
+        f"ReverseSequence time_axis={t_ax} batch_axis={b_ax} unsupported")
+
+
+@onnx_rule("MeanVarianceNormalization")
+def _mvn(ctx):
+    return ctx.importer.sd._op(
+        "standardize", ctx.var(0), name=ctx.outputs[0],
+        axis=ctx.a_ints("axes", [0, 2, 3]))
+
+
+@onnx_rule("QuantizeLinear")
+def _quantize_linear(ctx):
+    scale = float(ctx.const_value(1))
+    zp = 0
+    signed = False
+    if ctx.has(2):
+        zp_arr = ctx.const_value(2)
+        zp = int(zp_arr)
+        signed = np.issubdtype(zp_arr.dtype, np.signedinteger) \
+            and zp_arr.dtype != np.int32  # int8 zero point = signed range
+    return ctx.importer.sd._op("quantize", ctx.var(0), name=ctx.outputs[0],
+                               scale=scale, zero_point=zp, signed=signed)
+
+
+@onnx_rule("DequantizeLinear")
+def _dequantize_linear(ctx):
+    scale = float(ctx.const_value(1))
+    zp = int(ctx.const_value(2)) if ctx.has(2) else 0
+    return ctx.importer.sd._op("dequantize", ctx.var(0), name=ctx.outputs[0],
+                               scale=scale, zero_point=zp)
+
+
+@onnx_rule("Mean")
+def _mean_onnx(ctx):
+    return ctx.importer.sd._op(
+        "mergeavg", *(ctx.var(i) for i in range(len(ctx.inputs))),
+        name=ctx.outputs[0])
+
+
+@onnx_rule("Shrink")
+def _shrink(ctx):
+    lambd = ctx.a_float("lambd", 0.5)
+    bias = ctx.a_float("bias", 0.0)
+    sd = ctx.importer.sd
+    if bias == 0.0:
+        return sd._op("hardshrink", ctx.var(0), name=ctx.outputs[0],
+                      lambd=lambd)
+    # general form: x < -lambd -> x + bias; x > lambd -> x - bias; else 0
+    x = ctx.var(0)
+    neg = sd._op("mul", sd._op("cast", sd._op("lt", x, sd.constant(
+        np.asarray(-lambd, np.float32))), dtype="float32"),
+        sd._op("add", x, sd.constant(np.asarray(bias, np.float32))))
+    pos = sd._op("mul", sd._op("cast", sd._op("gt", x, sd.constant(
+        np.asarray(lambd, np.float32))), dtype="float32"),
+        sd._op("sub", x, sd.constant(np.asarray(bias, np.float32))))
+    return sd._op("add", neg, pos, name=ctx.outputs[0])
+
+
 class OnnxGraphMapper:
     """Reference spelling: OnnxFrameworkImporter.runImport(model.onnx)."""
 
