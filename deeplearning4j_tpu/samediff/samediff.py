@@ -284,7 +284,8 @@ class SameDiff:
 
     # ------------------------------------------------------- control flow
     def while_loop(self, loop_vars: Sequence[SDVariable], cond_fn, body_fn,
-                   name: Optional[str] = None) -> List[SDVariable]:
+                   name: Optional[str] = None,
+                   max_iters: Optional[int] = None) -> List[SDVariable]:
         """Structured while loop (reference: SameDiff.whileLoop; SURVEY.md
         §2.2 "THE thing XLA while replaces"): compiles to ONE
         ``lax.while_loop`` HLO instead of the reference's
@@ -295,6 +296,12 @@ class SameDiff:
         carry (same arity/dtypes as ``loop_vars``). Both receive a fresh
         sub-SameDiff whose placeholders ``arg0..argN`` are the loop carry.
         Returns one SDVariable per loop var (the final carry).
+
+        ``max_iters``: when set, lowers to a BOUNDED ``lax.scan`` of that
+        many steps with the condition applied as a pass-through select —
+        identical forward values when the loop exits within the bound, and
+        REVERSE-MODE DIFFERENTIABLE (``lax.while_loop`` is not; training
+        through imported/authored loops needs this form).
         """
         n = len(loop_vars)
         cond_sd, cond_outs = self._build_subgraph(cond_fn, n)
@@ -308,6 +315,7 @@ class SameDiff:
             "while_loop", *loop_vars, name=name,
             cond_graph=cond_sd, cond_outputs=cond_outs,
             body_graph=body_sd, body_outputs=body_outs, n_vars=n,
+            max_iters=max_iters,
         )
         node_var.node.n_outputs = n
         return [self._op("getitem", node_var, item=i) for i in range(n)]
@@ -467,6 +475,20 @@ class SameDiff:
                 jnp.asarray(r, jnp.asarray(c).dtype) for r, c in zip(res, carry))
 
         init = tuple(jnp.asarray(v) for v in ins)
+        max_iters = node.attrs.get("max_iters")
+        if max_iters is not None:
+            # bounded, reverse-differentiable form: scan max_iters steps,
+            # selecting pass-through once the condition goes false
+            def scan_step(carry, _):
+                active = cond(carry)
+                nxt = body(carry)
+                out = tuple(
+                    jnp.where(active, nn, cc) for nn, cc in zip(nxt, carry))
+                return out, None
+
+            final, _ = jax.lax.scan(scan_step, init, None,
+                                    length=int(max_iters))
+            return final
         return jax.lax.while_loop(cond, body, init)
 
     def _eval_cond(self, node: Node, ins, rng, training: bool):

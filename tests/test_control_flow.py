@@ -230,3 +230,46 @@ def test_v1_cond_switch_merge_import_matches_tf():
     for xv, expected in zip(xs, expecteds):
         got = np.asarray(sd.output({"x": xv}, ["result"])["result"])
         np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+
+def test_bounded_while_is_differentiable():
+    """max_iters lowers the loop to lax.scan: same forward values, and
+    reverse-mode gradients flow (lax.while_loop cannot do this — training
+    through loops needs the bounded form)."""
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.samediff import SameDiff
+
+    def build(max_iters):
+        sd = SameDiff.create()
+        x = sd.var("x", np.asarray([2.0], np.float32))
+        i0 = sd.constant(np.asarray(0, np.int32), name="i0")
+        outs = sd.while_loop(
+            [i0, x],
+            lambda s, i, a: s.math.lt(
+                i, s.constant(np.asarray(3, np.int32))),
+            lambda s, i, a: [
+                s.math.add(i, s.constant(np.asarray(1, np.int32))),
+                s.math.mul(a, a)],
+            max_iters=max_iters)
+        loss = sd.math.reduce_sum(outs[1])
+        sd.set_loss_variables(loss.name)
+        return sd, outs[1]
+
+    # forward parity: bounded == unbounded (x^(2^3) = 256)
+    sd_b, y_b = build(max_iters=8)
+    sd_u, y_u = build(max_iters=None)
+    vb = float(np.asarray(sd_b.output({}, [y_b.name])[y_b.name])[0])
+    vu = float(np.asarray(sd_u.output({}, [y_u.name])[y_u.name])[0])
+    assert vb == vu == 256.0
+
+    # gradient flows through the bounded form: d(x^8)/dx = 8 x^7 = 1024
+    grads = sd_b.calculate_gradients({}, ["x"])
+    g = float(np.asarray(list(grads.values())[0])[0])
+    np.testing.assert_allclose(g, 8 * 2.0 ** 7, rtol=1e-5)
+
+    # the unbounded form still fails loudly (jax's documented limitation)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="Reverse-mode"):
+        sd_u.calculate_gradients({}, ["x"])
