@@ -477,13 +477,15 @@ class SameDiff:
         init = tuple(jnp.asarray(v) for v in ins)
         max_iters = node.attrs.get("max_iters")
         if max_iters is not None:
-            # bounded, reverse-differentiable form: scan max_iters steps,
-            # selecting pass-through once the condition goes false
+            # bounded, reverse-differentiable form: scan max_iters steps.
+            # lax.cond (not a both-branches select) so the body is NOT
+            # evaluated on the frozen carry after exit — a where-based
+            # select would poison gradients (0 * inf in the dead branch's
+            # VJP) for bodies like sqrt/division whose domain the loop
+            # condition guards.
             def scan_step(carry, _):
-                active = cond(carry)
-                nxt = body(carry)
-                out = tuple(
-                    jnp.where(active, nn, cc) for nn, cc in zip(nxt, carry))
+                out = jax.lax.cond(cond(carry), body,
+                                   lambda c: tuple(c), carry)
                 return out, None
 
             final, _ = jax.lax.scan(scan_step, init, None,

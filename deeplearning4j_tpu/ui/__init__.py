@@ -12,6 +12,7 @@ JSON (chrome://tracing / perfetto), like SameDiff's ProfilingListener;
 is the "NaN panic" tripwire (reference: OpExecutionerUtil checkForNAN).
 """
 
+from .server import UIServer
 from .stats import FileStatsStorage, InMemoryStatsStorage, StatsListener, StatsStorage
 from .profiling import (
     NanPanicListener,
@@ -21,6 +22,7 @@ from .profiling import (
 )
 
 __all__ = [
+    "UIServer",
     "FileStatsStorage",
     "InMemoryStatsStorage",
     "NanPanicListener",

@@ -273,3 +273,32 @@ def test_bounded_while_is_differentiable():
     import pytest as _pytest
     with _pytest.raises(ValueError, match="Reverse-mode"):
         sd_u.calculate_gradients({}, ["x"])
+
+
+def test_bounded_while_gradient_safe_past_exit():
+    """The bounded lowering must not evaluate the body on the frozen carry
+    after exit: a sqrt whose domain the loop condition guards would turn
+    gradients NaN under a where-select lowering (0 * inf in the dead
+    branch's VJP); the lax.cond lowering keeps them finite."""
+    import numpy as np
+
+    from deeplearning4j_tpu.samediff import SameDiff
+
+    sd = SameDiff.create()
+    x = sd.var("x", np.asarray([9.0], np.float32))
+    i0 = sd.constant(np.asarray(0, np.int32), name="i0")
+    # body: a <- sqrt(a); cond: a > 1.1  (sqrt repeatedly -> exits at ~1.07;
+    # more iterations would drive d/da sqrt toward the steep region)
+    outs = sd.while_loop(
+        [i0, x],
+        lambda s, i, a: s.math.gt(
+            s.math.reduce_sum(a), s.constant(np.asarray(1.1, np.float32))),
+        lambda s, i, a: [
+            s.math.add(i, s.constant(np.asarray(1, np.int32))),
+            s.math.sqrt(a)],
+        max_iters=50)  # far beyond the ~5 real iterations
+    loss = sd.math.reduce_sum(outs[1])
+    sd.set_loss_variables(loss.name)
+    grads = sd.calculate_gradients({}, ["x"])
+    g = np.asarray(list(grads.values())[0])
+    assert np.all(np.isfinite(g)), f"NaN/inf gradient through bounded loop: {g}"

@@ -127,3 +127,37 @@ def test_stats_listener_on_computation_graph():
     model.fit([x], [y], epochs=3)
     full = [r for r in storage.records() if "gradients" in r]
     assert full and "d/W" in full[-1]["gradients"]
+
+
+def test_ui_server_serves_dashboard_and_stats():
+    """UIServer (reference: Vert.x dashboard): attach a storage, GET the
+    page and the JSON endpoints over real HTTP."""
+    import json
+    import urllib.request
+
+    from deeplearning4j_tpu.ui import InMemoryStatsStorage
+    from deeplearning4j_tpu.ui.server import UIServer
+
+    storage = InMemoryStatsStorage()
+    for i in range(5):
+        storage.put({"session": "s1", "iteration": i,
+                     "score": 2.0 / (i + 1),
+                     "update_ratios": {"layer_0/W": 10.0 ** (-3 + 0.1 * i)}})
+
+    ui = UIServer(port=0).attach(storage).start()
+    try:
+        base = f"http://127.0.0.1:{ui.port}"
+        page = urllib.request.urlopen(base + "/train/overview").read()
+        assert b"training UI" in page
+        sessions = json.loads(
+            urllib.request.urlopen(base + "/train/sessions").read())
+        assert sessions == ["s1"]
+        stats = json.loads(urllib.request.urlopen(
+            base + "/train/stats?sessionId=s1").read())
+        assert len(stats["scores"]) == 5
+        assert stats["scores"][0] == 2.0
+        ratios = stats["update_ratios"]["layer_0/W"]
+        assert len(ratios) == 5 and abs(ratios[0] + 3.0) < 1e-6
+        assert urllib.request.urlopen(base + "/train/stats").status == 200
+    finally:
+        ui.stop()
