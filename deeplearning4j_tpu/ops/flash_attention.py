@@ -393,16 +393,20 @@ def flash_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     block_q: int = 256,
-    block_k: int = 512,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention over [b, h, t, d] tensors. ``mask`` is a [b, t_k]
     key-padding mask (1 = keep). Runs the Pallas kernel compiled on TPU and
     in interpreter mode elsewhere (the CPU test path).
 
-    Default blocks (256, 512) are tuned on TPU v5e (d=64, bf16): 1.0x XLA
-    at t=2048 and 4.8-6x at t=8192, where the dense path thrashes HBM
-    (sweep archived in ROUND4_NOTES.md)."""
+    Blocks are tuned on TPU v5e (d=64, bf16; sweep in ROUND4_NOTES.md):
+    block_q=256 with block_k adaptive on sequence length — 512 up to 4k
+    (1.0x XLA at t=2048) and 1024 beyond (6x at t=8192, 18.6 ms at 16k,
+    32.4 ms at 32k; the larger k-tile amortizes the running-softmax
+    rescale over more MXU work once the k loop is long)."""
+    if block_k is None:
+        block_k = 512 if k.shape[2] < 8192 else 1024
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
