@@ -32,4 +32,9 @@ from .updaters import (
     Sgd,
 )
 
+try:  # optional: orbax-backed async/sharded checkpointing
+    from .orbax_checkpoint import OrbaxCheckpointer
+except ImportError:  # pragma: no cover
+    pass
+
 __all__ = [n for n in dir() if not n.startswith("_")]
