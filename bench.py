@@ -468,6 +468,28 @@ def measure_flash_attention_8k(b: int = 1, h: int = 8, t: int = 8192,
 
     t_flash, t_dense = timed(flash), timed(dense)
     t_flash_c, t_dense_c = timed(flash_c), timed(dense_c)
+
+    # training path: gradient through the kernel (blockwise O(t*d) backward)
+    def bwd(fn):
+        return jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(jnp.square(
+                fn(q, k, v).astype(jnp.float32))), argnums=(0, 1, 2)))
+
+    def timed_tree(fn):
+        def fence_tree(tree):
+            for leaf in jax.tree_util.tree_leaves(tree):
+                _host_fence(leaf)
+        fence_tree(fn(q, k, v))
+        start = time.perf_counter()
+        out = None
+        for _ in range(max(iters // 2, 2)):
+            out = fn(q, k, v)
+        fence_tree(out)
+        return (time.perf_counter() - start) / max(iters // 2, 2)
+
+    t_fb = timed_tree(bwd(lambda q, k, v: flash_attention(
+        q, k, v, interpret=False)))
+    t_db = timed_tree(bwd(mha_attention_reference))
     return {
         "seq": t, "batch": b, "heads": h, "head_dim": d,
         "flash_ms": round(t_flash * 1e3, 2),
@@ -476,6 +498,9 @@ def measure_flash_attention_8k(b: int = 1, h: int = 8, t: int = 8192,
         "causal_flash_ms": round(t_flash_c * 1e3, 2),
         "causal_xla_ms": round(t_dense_c * 1e3, 2),
         "causal_speedup": round(t_dense_c / t_flash_c, 2),
+        "backward_flash_ms": round(t_fb * 1e3, 2),
+        "backward_xla_ms": round(t_db * 1e3, 2),
+        "backward_speedup": round(t_db / t_fb, 2),
     }
 
 
