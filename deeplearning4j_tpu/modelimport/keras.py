@@ -537,6 +537,229 @@ class _SequentialImporter:
             self._add(LastTimeStepLayer(name=conf["name"] + "_last"))
             s.kind, s.n = "ff", units
 
+    def _import_GRU(self, conf):
+        s = self.shape
+        if s.kind != "rnn":
+            raise KerasImportError("GRU needs sequence input")
+        if conf.get("activation", "tanh") != "tanh" or conf.get(
+                "recurrent_activation", "sigmoid") != "sigmoid":
+            raise KerasImportError("non-default GRU activations unsupported")
+        if conf.get("go_backwards", False):
+            raise KerasImportError("GRU go_backwards unsupported")
+        from ..nn.layers import GRULayer
+
+        units = int(conf["units"])
+        reset_after = bool(conf.get("reset_after", True))
+        w = self._weights(conf)
+        # keras GRU fused columns are already [z, r, h~] — our storage order
+        params = {"W": w["kernel"], "RW": w["recurrent_kernel"]}
+        if conf.get("use_bias", True):
+            bias = w["bias"]
+            if reset_after and bias.ndim == 1:
+                bias = bias.reshape(2, -1)
+            params["b"] = bias
+        else:
+            params["b"] = np.zeros(
+                (2, 3 * units) if reset_after else (3 * units,), np.float32)
+        self._add(GRULayer(name=conf["name"], n_in=int(s.f), n_out=units,
+                           reset_after=reset_after), params)
+        s.f = units
+        if not conf.get("return_sequences", False):
+            self._add(LastTimeStepLayer(name=conf["name"] + "_last"))
+            s.kind, s.n = "ff", units
+
+    def _import_SimpleRNN(self, conf):
+        s = self.shape
+        if s.kind != "rnn":
+            raise KerasImportError("SimpleRNN needs sequence input")
+        if conf.get("go_backwards", False):
+            raise KerasImportError("SimpleRNN go_backwards unsupported")
+        from ..nn.layers import SimpleRnnLayer
+
+        units = int(conf["units"])
+        w = self._weights(conf)
+        params = {"W": w["kernel"], "RW": w["recurrent_kernel"]}
+        params["b"] = w["bias"] if conf.get("use_bias", True) \
+            else np.zeros((units,), np.float32)
+        self._add(SimpleRnnLayer(
+            name=conf["name"], n_in=int(s.f), n_out=units,
+            activation=_map_activation(conf.get("activation", "tanh"))),
+            params)
+        s.f = units
+        if not conf.get("return_sequences", False):
+            self._add(LastTimeStepLayer(name=conf["name"] + "_last"))
+            s.kind, s.n = "ff", units
+
+    def _import_Conv1D(self, conf):
+        s = self.shape
+        if s.kind != "rnn":
+            raise KerasImportError(
+                "Conv1D expects sequence input [batch, steps, features]")
+        if conf.get("padding") == "causal":
+            raise KerasImportError("causal Conv1D unsupported")
+        if conf.get("data_format") not in (None, "channels_last"):
+            raise KerasImportError("only channels_last Keras models supported")
+        from ..nn.layers import Convolution1DLayer
+
+        mode = _pad_mode(conf.get("padding", "valid"))
+        (k,) = conf["kernel_size"] if isinstance(
+            conf["kernel_size"], (list, tuple)) else (conf["kernel_size"],)
+        (st,) = conf.get("strides", (1,)) if isinstance(
+            conf.get("strides", (1,)), (list, tuple)) else (conf["strides"],)
+        (dil,) = conf.get("dilation_rate", (1,)) if isinstance(
+            conf.get("dilation_rate", (1,)), (list, tuple)) \
+            else (conf["dilation_rate"],)
+        w = self._weights(conf)
+        # keras [k, in, out] -> ours [out, in, k]
+        params = {"W": w["kernel"].transpose(2, 1, 0)}
+        if conf.get("use_bias", True):
+            params["b"] = w["bias"]
+        self._add(Convolution1DLayer(
+            name=conf["name"], n_in=int(s.f), n_out=int(conf["filters"]),
+            kernel_size=int(k), stride=int(st), dilation=int(dil),
+            convolution_mode=mode,
+            activation=_map_activation(conf.get("activation")),
+            has_bias=conf.get("use_bias", True)), params)
+        if s.t is not None:
+            s.t = _conv_out(s.t, int(k), int(st), mode, int(dil))
+        s.f = int(conf["filters"])
+
+    def _import_DepthwiseConv2D(self, conf):
+        s = self.shape
+        if s.kind != "conv":
+            raise KerasImportError("DepthwiseConv2D on non-convolutional input")
+        if conf.get("data_format") not in (None, "channels_last"):
+            raise KerasImportError("only channels_last Keras models supported")
+        from ..nn.layers import DepthwiseConvolution2DLayer
+
+        mode = _pad_mode(conf.get("padding", "valid"))
+        kh, kw = conf["kernel_size"]
+        sh, sw = conf.get("strides", (1, 1))
+        dh, dw = conf.get("dilation_rate", (1, 1))
+        dm = int(conf.get("depth_multiplier", 1))
+        w = self._weights(conf)
+        # keras depthwise [kh, kw, in, mult] == our W layout directly
+        # (keras 2 names it depthwise_kernel; keras 3 just kernel)
+        params = {"W": w.get("depthwise_kernel", w.get("kernel"))}
+        if conf.get("use_bias", True):
+            params["b"] = w["bias"]
+        self._add(DepthwiseConvolution2DLayer(
+            name=conf["name"], n_in=int(s.c), n_out=int(s.c) * dm,
+            depth_multiplier=dm, kernel_size=(kh, kw), stride=(sh, sw),
+            dilation=(dh, dw), convolution_mode=mode,
+            activation=_map_activation(conf.get("activation")),
+            has_bias=conf.get("use_bias", True)), params)
+        s.h = _conv_out(s.h, kh, sh, mode, dh)
+        s.w = _conv_out(s.w, kw, sw, mode, dw)
+        s.c = int(s.c) * dm
+
+    def _import_TimeDistributed(self, conf):
+        s = self.shape
+        if s.kind != "rnn":
+            raise KerasImportError("TimeDistributed needs sequence input")
+        inner = conf["layer"]
+        if inner["class_name"] != "Dense":
+            raise KerasImportError(
+                f"TimeDistributed({inner['class_name']}) unsupported "
+                "(Dense only — the reference wrapper covers the same case)")
+        from ..nn.layers import TimeDistributedLayer
+
+        icfg = inner["config"]
+        units = int(icfg["units"])
+        w = self._weights(conf)
+        params = {"W": w["kernel"]}
+        if icfg.get("use_bias", True):
+            params["b"] = w["bias"]
+        self._add(TimeDistributedLayer(
+            name=conf["name"],
+            underlying=DenseLayer(
+                n_in=int(s.f), n_out=units,
+                activation=_map_activation(icfg.get("activation")),
+                has_bias=icfg.get("use_bias", True))), params)
+        s.f = units
+
+    def _import_ZeroPadding2D(self, conf):
+        s = self.shape
+        if s.kind != "conv":
+            raise KerasImportError("ZeroPadding2D on non-convolutional input")
+        if conf.get("data_format") not in (None, "channels_last"):
+            raise KerasImportError("only channels_last Keras models supported")
+        from ..nn.layers import ZeroPaddingLayer
+
+        pad = conf.get("padding", (1, 1))
+        if isinstance(pad, int):
+            t = b = l = r = pad
+        else:
+            ph, pw = pad
+            t, b = (ph, ph) if isinstance(ph, int) else ph
+            l, r = (pw, pw) if isinstance(pw, int) else pw
+        self._add(ZeroPaddingLayer(name=conf["name"],
+                                   padding=(int(t), int(b), int(l), int(r))))
+        s.h = s.h + t + b
+        s.w = s.w + l + r
+
+    def _import_UpSampling2D(self, conf):
+        s = self.shape
+        if s.kind != "conv":
+            raise KerasImportError("UpSampling2D on non-convolutional input")
+        if conf.get("data_format") not in (None, "channels_last"):
+            raise KerasImportError("only channels_last Keras models supported")
+        if conf.get("interpolation", "nearest") != "nearest":
+            raise KerasImportError(
+                "only nearest-neighbor UpSampling2D supported")
+        from ..nn.layers import Upsampling2DLayer
+
+        sh, sw = conf.get("size", (2, 2))
+        self._add(Upsampling2DLayer(name=conf["name"],
+                                    size=(int(sh), int(sw))))
+        s.h, s.w = s.h * int(sh), s.w * int(sw)
+
+    def _import_LeakyReLU(self, conf):
+        # keras 2 spells it alpha (default 0.3); keras 3 negative_slope
+        alpha = conf.get("negative_slope", conf.get("alpha", 0.3))
+        self._add(ActivationLayer(name=conf["name"],
+                                  activation=Activation.LEAKYRELU,
+                                  alpha=float(alpha)))
+
+    def _import_ELU(self, conf):
+        self._add(ActivationLayer(name=conf["name"],
+                                  activation=Activation.ELU,
+                                  alpha=float(conf.get("alpha", 1.0))))
+
+    def _import_PReLU(self, conf):
+        s = self.shape
+        from ..nn.layers import PReLULayer
+
+        w = self._weights(conf)
+        alpha = w["alpha"]
+        shared = conf.get("shared_axes") or ()
+        if isinstance(shared, int):
+            shared = (shared,)
+        if s.kind == "conv":
+            # keras alpha is NHWC-shaped [h, w, c] (dims possibly 1 where
+            # shared); ours is NCHW-shaped [c, h, w]
+            alpha = np.transpose(alpha, (2, 0, 1))
+            shape = (int(s.c), int(s.h), int(s.w))
+            ax_map = {1: 2, 2: 3, 3: 1}  # keras axis -> our axis (1-indexed)
+            shared_ours = tuple(sorted(ax_map[a] for a in shared))
+            shape = tuple(1 if (i + 1) in shared_ours else d
+                          for i, d in enumerate(shape))
+        elif s.kind == "ff":
+            shape = (int(s.n),)
+            shared_ours = ()
+            if shared:
+                raise KerasImportError(
+                    "PReLU shared_axes on flat input unsupported")
+        else:
+            raise KerasImportError("PReLU on sequence input unsupported")
+        if tuple(alpha.shape) != shape:
+            raise KerasImportError(
+                f"PReLU alpha shape {alpha.shape} != expected {shape}")
+        full_shape = (int(s.c), int(s.h), int(s.w)) if s.kind == "conv" \
+            else (int(s.n),)
+        self._add(PReLULayer(name=conf["name"], input_shape=full_shape,
+                             shared_axes=shared_ours), {"W": alpha})
+
 
 def _inbound_names(layer_cfg: dict) -> List[str]:
     """Producer layer names feeding this functional-API layer — handles the

@@ -541,11 +541,11 @@ def _resize(ctx):
 _SIMPLE_T3 = {
     "Celu": "celu", "HardSwish": "hard_swish", "Mish": "mish",
     "ThresholdedRelu": "thresholded_relu", "PRelu": "prelu",
-    "Xor": "logical_xor", "Mod": "mod",
+    "Xor": "logical_xor",
     "BitwiseAnd": "bitwise_and", "BitwiseOr": "bitwise_or",
     "BitwiseXor": "bitwise_xor", "BitwiseNot": "bitwise_not",
     "Det": "matrix_determinant", "Atan2": "atan2",
-    "Mod": None, "ReverseSequence": None,  # attr rules below
+    "ReverseSequence": None,  # attr rule below; Mod handled by attr rule too
 }
 for _onnx_name, _sd_name in _SIMPLE_T3.items():
     if _sd_name is None or _onnx_name in ONNX_OP_RULES:
@@ -762,14 +762,27 @@ def _mvn(ctx):
         axis=ctx.a_ints("axes", [0, 2, 3]))
 
 
+def _item(value):
+    """Extract a python scalar from a 0-d/1-element ndarray without relying on
+    float()/int() of a sized array (deprecated in NumPy >= 1.25). Raises on
+    larger tensors so per-axis quantization params fail loudly instead of
+    silently collapsing to the first element."""
+    arr = np.asarray(value)
+    if arr.size != 1:
+        raise NotImplementedError(
+            f"per-axis quantization params unsupported (got shape "
+            f"{arr.shape}); only per-tensor scale/zero_point import")
+    return arr.reshape(-1)[0].item()
+
+
 @onnx_rule("QuantizeLinear")
 def _quantize_linear(ctx):
-    scale = float(ctx.const_value(1))
+    scale = float(_item(ctx.const_value(1)))
     zp = 0
     signed = False
     if ctx.has(2):
-        zp_arr = ctx.const_value(2)
-        zp = int(zp_arr)
+        zp_arr = np.asarray(ctx.const_value(2))
+        zp = int(_item(zp_arr))
         signed = np.issubdtype(zp_arr.dtype, np.signedinteger) \
             and zp_arr.dtype != np.int32  # int8 zero point = signed range
     return ctx.importer.sd._op("quantize", ctx.var(0), name=ctx.outputs[0],
@@ -778,8 +791,8 @@ def _quantize_linear(ctx):
 
 @onnx_rule("DequantizeLinear")
 def _dequantize_linear(ctx):
-    scale = float(ctx.const_value(1))
-    zp = int(ctx.const_value(2)) if ctx.has(2) else 0
+    scale = float(_item(ctx.const_value(1)))
+    zp = int(_item(ctx.const_value(2))) if ctx.has(2) else 0
     return ctx.importer.sd._op("dequantize", ctx.var(0), name=ctx.outputs[0],
                                scale=scale, zero_point=zp)
 

@@ -1,18 +1,23 @@
-"""Word2Vec — skip-gram with negative sampling, trained on device.
+"""Word2Vec — skip-gram with negative sampling OR hierarchical softmax.
 
 Reference: org.deeplearning4j.models.word2vec.Word2Vec (SURVEY.md §2.2
-"NLP"): vocab build with min_count, frequency subsampling, unigram^0.75
-negative-sampling table, lock-free hogwild trainer threads.
+"NLP", SURVEY.md:139 "hierarchical-softmax + neg-sampling"): vocab build
+with min_count, frequency subsampling, unigram^0.75 negative-sampling
+table, Huffman coding for HS, lock-free hogwild trainer threads.
 
 TPU design: hogwild's point was keeping many CPU cores busy with tiny
 rank-1 updates. On TPU the same math batches into MXU-shaped work: each
-jitted step takes [B] center ids, [B] context ids, and [B, K] negative
-ids, computes the sigmoid NS loss, and applies dense adagrad updates via
-segment-sum scatters — thousands of (center, context) pairs per launch
-instead of one per thread. Semantics (objective, sampling, lr decay)
-follow the reference; the execution schedule is synchronous minibatch.
+jitted step takes [B] center ids plus either [B, K] negative ids (NS) or
+the context words' padded Huffman paths [B, L] (HS), computes the sigmoid
+loss, and applies updates via scatters — thousands of (center, context)
+pairs per launch instead of one per thread. The Huffman paths are
+precomputed host-side into static-shape [V, L] code/point/mask tables so
+the HS step is one fixed XLA program (no per-word path lengths at trace
+time). Semantics (objective, coding, lr decay) follow the reference; the
+execution schedule is synchronous minibatch.
 
-API parity: fit(), get_word_vector(), similarity(), words_nearest().
+API parity: fit(), get_word_vector(), similarity(), words_nearest();
+``hs=True`` mirrors the reference's useHierarchicSoftmax(true).
 """
 
 from __future__ import annotations
@@ -36,6 +41,7 @@ class Word2Vec(WordVectorLookup):
         window: int = 5,
         min_count: int = 5,
         negative: int = 5,
+        hs: bool = False,
         subsample: float = 1e-3,
         learning_rate: float = 2.5,  # per-BATCH rate; pair-level ≈ lr/batch
         min_learning_rate: float = 1e-4,
@@ -49,6 +55,7 @@ class Word2Vec(WordVectorLookup):
         self.window = int(window)
         self.min_count = int(min_count)
         self.negative = int(negative)
+        self.hs = bool(hs)
         self.subsample = float(subsample)
         self.learning_rate = float(learning_rate)
         self.min_learning_rate = float(min_learning_rate)
@@ -91,6 +98,59 @@ class Word2Vec(WordVectorLookup):
         probs /= probs.sum()
         return np.random.RandomState(self.seed).choice(
             len(self.vocab), size=size, p=probs).astype(np.int32)
+
+    def _build_huffman(self) -> None:
+        """Huffman-code the vocab by frequency (reference: Huffman applied
+        over the VocabCache before HS training; canonical word2vec array
+        construction). Produces static-shape tables for the jitted step:
+        ``hs_points`` [V, L] inner-node ids, ``hs_codes`` [V, L] bits,
+        ``hs_mask`` [V, L] 1.0 where the path is real, 0 padding."""
+        v = len(self.vocab)
+        if v < 2:
+            raise ValueError("hierarchical softmax needs vocab size >= 2")
+        # classic 2V-array construction: leaves 0..V-1 (descending counts),
+        # inner nodes V..2V-2 created in nondecreasing count order
+        count = np.empty(2 * v - 1, np.float64)
+        count[:v] = self.counts
+        count[v:] = np.inf
+        parent = np.zeros(2 * v - 1, np.int64)
+        binary = np.zeros(2 * v - 1, np.int8)
+        pos1, pos2 = v - 1, v  # scan heads: leaves downward, inners upward
+        for a in range(v - 1):
+            picks = []
+            for _ in range(2):
+                if pos1 >= 0 and count[pos1] < count[pos2]:
+                    picks.append(pos1)
+                    pos1 -= 1
+                else:
+                    picks.append(pos2)
+                    pos2 += 1
+            m1, m2 = picks
+            count[v + a] = count[m1] + count[m2]
+            parent[m1] = parent[m2] = v + a
+            binary[m2] = 1
+        paths: List[List[int]] = []
+        codes: List[List[int]] = []
+        for w in range(v):
+            code: List[int] = []
+            pts: List[int] = []
+            node = w
+            while node != 2 * v - 2:
+                code.append(int(binary[node]))
+                node = int(parent[node])
+                pts.append(node - v)  # inner-node id in [0, V-1)
+            # root-first order, as the reference stores them
+            paths.append(pts[::-1])
+            codes.append(code[::-1])
+        L = max(len(p) for p in paths)
+        self.hs_points = np.zeros((v, L), np.int32)
+        self.hs_codes = np.zeros((v, L), np.float32)
+        self.hs_mask = np.zeros((v, L), np.float32)
+        for w in range(v):
+            n = len(paths[w])
+            self.hs_points[w, :n] = paths[w]
+            self.hs_codes[w, :n] = codes[w]
+            self.hs_mask[w, :n] = 1.0
 
     # ----- training ---------------------------------------------------
 
@@ -153,6 +213,30 @@ class Word2Vec(WordVectorLookup):
 
         return step
 
+    def _make_hs_step(self):
+        @jax.jit
+        def step(syn0, syn1, centers, points, codes, mask, lr):
+            c_vec = syn0[centers]                    # [B, D]
+            t_vec = syn1[points]                     # [B, L, D]
+            logits = jnp.einsum("bd,bld->bl", c_vec, t_vec)
+            sig = jax.nn.sigmoid(logits)
+            # canonical word2vec HS gradient: g = (1 - code) - sigmoid,
+            # i.e. label = 1 - code bit at each inner node
+            labels = 1.0 - codes
+            g = (sig - labels) * mask * (lr / logits.shape[0])  # [B, L]
+            grad_c = jnp.einsum("bl,bld->bd", g, t_vec)
+            grad_t = g[..., None] * c_vec[:, None, :]           # [B, L, D]
+            syn0 = syn0.at[centers].add(-grad_c)
+            syn1 = syn1.at[points.reshape(-1)].add(
+                -grad_t.reshape(-1, grad_t.shape[-1]))
+            loss = -jnp.sum(
+                mask * (labels * jnp.log(sig + 1e-10)
+                        + (1 - labels) * jnp.log(1 - sig + 1e-10))
+            ) / jnp.maximum(jnp.sum(mask), 1.0)
+            return syn0, syn1, loss
+
+        return step
+
     def fit(self, sentences: Sequence[Sequence[str]],
             verbose: bool = False) -> "Word2Vec":
         """``sentences`` is an iterable of token lists (use a tokenizer from
@@ -162,9 +246,15 @@ class Word2Vec(WordVectorLookup):
         rng = np.random.RandomState(self.seed)
         v, d = len(self.vocab), self.vector_size
         self.syn0 = ((rng.rand(v, d) - 0.5) / d).astype(np.float32)
-        self.syn1 = np.zeros((v, d), np.float32)
-        table = self._negative_table()
-        step = self._make_step()
+        if self.hs:
+            self._build_huffman()
+            self.syn1 = np.zeros((max(v - 1, 1), d), np.float32)
+            step = self._make_hs_step()
+            table = None
+        else:
+            self.syn1 = np.zeros((v, d), np.float32)
+            table = self._negative_table()
+            step = self._make_step()
 
         if self.mesh is not None:
             from ..parallel.sharded_embedding import shard_rows
@@ -192,15 +282,25 @@ class Word2Vec(WordVectorLookup):
                 contexts = np.resize(np.asarray(buf_x, np.int32), total)
                 row_valid = np.zeros(total, np.float32)
                 row_valid[:n] = 1.0
-                negs = table[rng.randint(0, table.size,
-                                         (centers.size, self.negative))]
                 frac = min(1.0, batch_i / total_batches)
                 lr = max(self.min_learning_rate,
                          self.learning_rate * (1 - frac))
-                syn0, syn1, loss = step(syn0, syn1, centers, contexts,
-                                        jnp.asarray(negs),
-                                        jnp.asarray(row_valid),
-                                        jnp.float32(lr))
+                if self.hs:
+                    points = self.hs_points[contexts]        # [B, L]
+                    codes = self.hs_codes[contexts]
+                    mask = self.hs_mask[contexts] * row_valid[:, None]
+                    syn0, syn1, loss = step(syn0, syn1, centers,
+                                            jnp.asarray(points),
+                                            jnp.asarray(codes),
+                                            jnp.asarray(mask),
+                                            jnp.float32(lr))
+                else:
+                    negs = table[rng.randint(0, table.size,
+                                             (centers.size, self.negative))]
+                    syn0, syn1, loss = step(syn0, syn1, centers, contexts,
+                                            jnp.asarray(negs),
+                                            jnp.asarray(row_valid),
+                                            jnp.float32(lr))
                 return syn0, syn1, batch_i + 1, float(loss)
 
             for center, ctx in self._pairs(sentences, rng):
@@ -213,7 +313,7 @@ class Word2Vec(WordVectorLookup):
                     buf_c, buf_x = [], []
             syn0, syn1, batch_i, _ = flush(syn0, syn1, batch_i)
         self.syn0 = np.asarray(syn0)[:v]  # drop shard padding, if any
-        self.syn1 = np.asarray(syn1)[:v]
+        self.syn1 = np.asarray(syn1)[:max(v - 1, 1) if self.hs else v]
         return self
 
     # query API (has_word/get_word_vector/similarity/words_nearest)

@@ -217,6 +217,13 @@ class ComputationGraph:
         for name, layer in self.named_param_layers():
             if master_params.get(name):
                 total = total + _layer_reg_score(layer, master_params[name], score_dtype)
+            # MoE load-balance aux loss (GShard), same contract as the
+            # sequential path: forward stashed this batch's aux in state
+            bl_w = getattr(layer, "balance_loss_weight", 0.0)
+            if bl_w:
+                aux = new_state.get(name, {}).get("aux_load_balance")
+                if aux is not None:
+                    total = total + bl_w * aux.astype(score_dtype)
         return total, new_state
 
     # -------------------------------------------------------------- user API

@@ -64,6 +64,7 @@ from .recurrent import (
     BidirectionalLayer,
     BidirectionalMode,
     GravesLSTMLayer,
+    GRULayer,
     LSTMLayer,
     LastTimeStepLayer,
     MaskZeroLayer,

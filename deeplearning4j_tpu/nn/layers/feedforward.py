@@ -80,10 +80,22 @@ class DenseLayer(Layer):
 @register_config
 @dataclasses.dataclass(frozen=True, kw_only=True)
 class ActivationLayer(Layer):
-    """Applies an activation only (reference: ActivationLayer)."""
+    """Applies an activation only (reference: ActivationLayer).
+
+    ``alpha`` overrides the fixed slope/scale for LEAKYRELU (default 0.01)
+    and ELU (default 1.0) — needed by the Keras importer, whose
+    LeakyReLU/ELU layers carry arbitrary alphas (keras LeakyReLU default
+    is 0.3)."""
+
+    alpha: Optional[float] = None
 
     def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
         act = self.activation or Activation.IDENTITY
+        if self.alpha is not None:
+            if act is Activation.LEAKYRELU:
+                return jax.nn.leaky_relu(x, self.alpha), state
+            if act is Activation.ELU:
+                return jax.nn.elu(x, self.alpha), state
         return act(x), state
 
 

@@ -47,6 +47,11 @@ class MixtureOfExpertsLayer(Layer):
     hidden: int = 0            # defaults to 4 * n_in
     top_k: int = 2
     capacity_factor: float = 1.5
+    # GShard aux load-balance loss weight: when > 0, the training score
+    # adds balance_loss_weight * (E * sum(frac_e * mass_e)) so the router
+    # is PUSHED toward uniform expert load, not merely observed. 0 keeps
+    # it diagnostic-only (read from state["aux_load_balance"]).
+    balance_loss_weight: float = 0.0
 
     def __post_init__(self) -> None:
         if self.top_k < 1 or self.top_k > self.num_experts:
@@ -168,9 +173,10 @@ class MixtureOfExpertsLayer(Layer):
             + params["be2"][:, None, :]
         y = jnp.einsum("bec,eco->bo", combine, out_e)            # [b, o]
 
-        # load-balance diagnostic (GShard aux): fraction routed per expert
-        # x mean gate mass per expert, E-scaled; exposed via state for
-        # listeners, NOT added to the training loss. Real tokens only.
+        # load-balance aux (GShard): fraction routed per expert x mean gate
+        # mass per expert, E-scaled. Exposed via state for listeners; added
+        # to the training score iff balance_loss_weight > 0 (the loss paths
+        # in sequential.py/graph.py read it back). Real tokens only.
         if token_mask is not None:
             denom_tok = jnp.maximum(jnp.sum(token_mask), 1.0)
             frac = jnp.sum(jnp.sum(dispatch, axis=-1), axis=0) / denom_tok

@@ -166,6 +166,111 @@ class GravesLSTMLayer(LSTMLayer):
 
 @register_config
 @dataclasses.dataclass(frozen=True, kw_only=True)
+class GRULayer(Layer):
+    """Gated recurrent unit (Cho 2014). The reference's layer zoo has no
+    GRU (SURVEY.md:129 lists LSTM/GravesLSTM/SimpleRnn), but its Keras
+    importer maps KerasGRU (SURVEY.md:137 '~60 KerasLayer subclasses') —
+    this layer exists for that import path and as a first-class layer.
+
+    Same TPU shape as LSTMLayer: the whole sequence's input projection is
+    one [b*t, nIn]@[nIn, 3n] matmul, then lax.scan carries h with only the
+    [b, n]@[n, 3n] recurrent matmul in the loop.
+
+    Conventions (keras-compatible so import is a direct weight copy):
+    * fused gate columns ordered [z, r, h~] (update, reset, candidate)
+    * ``reset_after=True`` (keras v2+ default): candidate uses
+      r * (h@RW_h + rb_h) — bias ``b`` has shape [2, 3n] (input row 0,
+      recurrent row 1). ``reset_after=False`` (CuDNN-incompatible classic
+      form): candidate uses (r*h)@RW_h — bias is [3n].
+    * masked timesteps: state carried through unchanged, output zeroed
+      (same contract as LSTMLayer/SimpleRnnLayer).
+    """
+
+    n_in: int = 0
+    n_out: int = 0
+    reset_after: bool = True
+    gate_activation: Activation = Activation.SIGMOID
+
+    def output_type(self, input_type: InputType) -> InputType:
+        ts = input_type.timesteps if isinstance(input_type, RecurrentType) else None
+        return RecurrentType(size=self.n_out, timesteps=ts)
+
+    def with_input(self, input_type: InputType) -> "GRULayer":
+        if self.n_in or not isinstance(input_type, RecurrentType):
+            return self
+        return dataclasses.replace(self, n_in=input_type.size)
+
+    def has_params(self) -> bool:
+        return True
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return ("W", "RW", "b")
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        k1, k2 = jax.random.split(key)
+        wi = self.weight_init or WeightInit.XAVIER
+        n = self.n_out
+        w = init_weights(k1, (self.n_in, 3 * n), wi, self.n_in, 3 * n,
+                         self.weight_init_distribution, dtype)
+        rw = init_weights(k2, (n, 3 * n), wi, n, 3 * n,
+                          self.weight_init_distribution, dtype)
+        b_shape = (2, 3 * n) if self.reset_after else (3 * n,)
+        return {"W": w, "RW": rw, "b": jnp.zeros(b_shape, dtype)}
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        x = apply_input_dropout(self, x, ctx)
+        b, _, t = x.shape
+        n = self.n_out
+        gate = self.gate_activation
+        act = self.activation or Activation.TANH
+        bias = params["b"]
+        in_bias = bias[0] if self.reset_after else bias
+        rec_bias = bias[1] if self.reset_after else None
+        xt = x.transpose(0, 2, 1)  # [b, t, nIn]
+        x_proj = (xt.reshape(b * t, self.n_in) @ params["W"]
+                  + in_bias).reshape(b, t, 3 * n)
+        h0 = state.get("h")
+        if h0 is None:
+            h0 = jnp.zeros((b, n), x.dtype)
+        rw = params["RW"]
+        mask = ctx.mask
+
+        def step(h, inp):
+            if mask is None:
+                xp, m = inp, None
+            else:
+                xp, m = inp
+            xz, xr, xh = jnp.split(xp, 3, axis=-1)
+            if self.reset_after:
+                rec = h @ rw + rec_bias  # [b, 3n]
+                rz, rr, rh = jnp.split(rec, 3, axis=-1)
+                z = gate(xz + rz)
+                r = gate(xr + rr)
+                hh = act(xh + r * rh)
+            else:
+                rec_zr = h @ rw[:, : 2 * n]
+                z = gate(xz + rec_zr[:, :n])
+                r = gate(xr + rec_zr[:, n:])
+                hh = act(xh + (r * h) @ rw[:, 2 * n:])
+            h_new = z * h + (1.0 - z) * hh
+            if m is not None:
+                mm = m[:, None]
+                h_out = mm * h_new
+                h_new = mm * h_new + (1.0 - mm) * h
+            else:
+                h_out = h_new
+            return h_new, h_out
+
+        xs = x_proj.transpose(1, 0, 2)
+        inputs = (xs, mask.T.astype(x.dtype)) if mask is not None else xs
+        from ...ops import helpers
+
+        h_f, hs = helpers.rnn_sequence(inputs, step, h0)
+        return hs.transpose(1, 2, 0), {"h": h_f}
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
 class SimpleRnnLayer(Layer):
     """Vanilla RNN: h_t = act(x_t W + h_{t-1} RW + b) (reference: SimpleRnn)."""
 

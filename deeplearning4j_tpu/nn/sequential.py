@@ -223,6 +223,13 @@ class MultiLayerNetwork:
             lname = self.conf.layer_name(i)
             if master_params.get(lname):
                 reg = reg + _layer_reg_score(layer, master_params[lname], score_dtype)
+            # MoE load-balance aux loss (GShard): the layer computed this
+            # batch's aux during forward and stashed it in state
+            bl_w = getattr(layer, "balance_loss_weight", 0.0)
+            if bl_w:
+                aux = new_state.get(lname, {}).get("aux_load_balance")
+                if aux is not None:
+                    reg = reg + bl_w * aux.astype(score_dtype)
         return loss.astype(score_dtype) + reg, (new_state, new_rnn)
 
     # -------------------------------------------------------------- user API

@@ -125,8 +125,28 @@ def _dynamic_stitch(indices, *data, size=None):
     idx_list = list(indices) if isinstance(indices, (list, tuple)) \
         else [indices]
     ind_ndim = idx_list[0].ndim
-    n = int(size) if size is not None else sum(
-        int(np.prod(i.shape)) for i in idx_list)
+    if size is not None:
+        n = int(size)
+    else:
+        n = sum(int(np.prod(i.shape)) for i in idx_list)
+        # TF semantics are max(indices)+1; with no ``size`` given we can only
+        # honour that when the index lists form a permutation of range(n).
+        # Validate when indices are concrete so out-of-range updates raise
+        # loudly instead of being silently dropped by the clamping scatter.
+        try:
+            concrete = np.sort(np.concatenate(
+                [np.asarray(i).ravel() for i in idx_list]))
+        except Exception:  # traced values: cannot check, document-only
+            concrete = None
+        if concrete is not None and (
+                len(concrete) != n or not np.array_equal(
+                    concrete, np.arange(n, dtype=concrete.dtype))):
+            raise ValueError(
+                "dynamic_stitch without size= requires the index lists to "
+                "form a permutation of range(total); got max index "
+                f"{int(concrete.max()) if len(concrete) else -1} over "
+                f"{n} total indices. Pass size=max(indices)+1 for TF "
+                "semantics with gaps/duplicates.")
     rest = data[0].shape[ind_ndim:]
     out = jnp.zeros((n,) + rest, data[0].dtype)
     for i, d in zip(idx_list, data):

@@ -109,14 +109,18 @@ class UIServer:
         self._thread: Optional[threading.Thread] = None
 
     @classmethod
-    def get_instance(cls, port: int = 9000) -> "UIServer":
+    def get_instance(cls, port: Optional[int] = None) -> "UIServer":
+        """``port=None`` means "no preference" — it never overrides a port
+        an earlier caller configured explicitly."""
         if cls._instance is None:
-            cls._instance = cls(port)
-        elif cls._instance._httpd is not None \
-                and port != cls._instance.port:
-            raise ValueError(
-                f"UIServer already running on port {cls._instance.port}; "
-                "stop() it before requesting a different port")
+            cls._instance = cls(port if port is not None else 9000)
+        elif port is not None and port != cls._instance.port:
+            if cls._instance._httpd is not None:
+                raise ValueError(
+                    f"UIServer already running on port {cls._instance.port}; "
+                    "stop() it before requesting a different port")
+            # not yet started: honour the newly requested explicit port
+            cls._instance.port = port
         return cls._instance
 
     getInstance = get_instance

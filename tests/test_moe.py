@@ -154,3 +154,88 @@ def test_masked_tokens_claim_no_capacity():
     # padding positions get no combine weight -> zero output rows
     np.testing.assert_allclose(np.asarray(y1)[:, :, t // 2:], 0.0, atol=1e-6)
     assert np.isfinite(float(state["aux_load_balance"]))
+
+
+# ---- round-5 "MoE under load" (VERDICT r4 ask 10) -------------------------
+
+
+def test_drop_rate_at_realistic_token_counts():
+    """4096 tokens, 8 experts, top-2, capacity_factor 1.25: with a skewed
+    router some tokens MUST drop; the dispatch tensor's per-token mass
+    quantifies the drop rate, which must stay under the worst case implied
+    by the capacity bound and hit zero when capacity is generous."""
+    e, d, k = 8, 16, 2
+    n_tok = 4096
+    rs = np.random.RandomState(7)
+    # centered features: an all-positive input makes any random router
+    # column-mean dominated (one expert wins most tokens by chance)
+    x = jnp.asarray(rs.randn(n_tok, d).astype(np.float32))
+
+    def drop_rate(cap, skew):
+        lay = MixtureOfExpertsLayer(
+            n_in=d, n_out=d, num_experts=e, hidden=32, top_k=k,
+            capacity_factor=cap, activation=Activation.RELU)
+        params = lay.init(jax.random.PRNGKey(3), jnp.float32)
+        # skew the router toward expert 0 so overflow actually occurs
+        params["Wg"] = params["Wg"].at[:, 0].add(skew)
+        gates = jax.nn.softmax(x @ params["Wg"], axis=-1)
+        capacity = int(np.ceil(k * n_tok / e * cap))
+        dispatch, combine = lay._route(gates, capacity)
+        # per-token assigned slot count, out of k requested
+        assigned = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
+        assert assigned.max() <= k + 1e-6
+        dropped = (k - assigned).sum() / (k * n_tok)
+        # every surviving combine weight sits in a claimed slot; per-expert
+        # fill never exceeds capacity
+        assert float(jnp.sum(combine)) <= n_tok + 1e-3
+        per_expert = np.asarray(jnp.sum(dispatch, axis=(0, 2)))
+        assert per_expert.max() <= capacity + 1e-6
+        return float(dropped)
+
+    balanced = drop_rate(1.25, 0.0)
+    skewed = drop_rate(1.25, 8.0)
+    generous = drop_rate(float(e), 8.0)  # capacity == all tokens
+    assert generous == 0.0
+    assert skewed > 0.05, "hard-skewed router at cf=1.25 must overflow"
+    # a near-uniform random router barely overflows at cf=1.25
+    assert balanced < 0.05, balanced
+    assert balanced < skewed
+
+
+def test_balance_loss_weight_improves_balance():
+    """With balance_loss_weight > 0 the aux term is part of the training
+    score and gradient descent actively flattens expert load; weight 0
+    leaves the (deliberately skewed) router skewed."""
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+
+    def train(bl_weight, seed=5):
+        lb = (NeuralNetConfiguration.builder().seed(seed)
+              .updater(Sgd(learning_rate=0.5)).list())
+        lb.layer(MixtureOfExpertsLayer(
+            n_in=8, n_out=8, num_experts=4, hidden=16, top_k=1,
+            capacity_factor=4.0, activation=Activation.RELU,
+            balance_loss_weight=bl_weight))
+        lb.layer(OutputLayer(n_in=8, n_out=4, activation=Activation.SOFTMAX,
+                             loss=LossFunction.MCXENT))
+        lb.set_input_type(InputType.feed_forward(8))
+        net = MultiLayerNetwork(lb.build()).init()
+        # skew the router so imbalance is the starting condition
+        # moderate skew: extreme offsets saturate the softmax and kill
+        # the aux gradient (gate*(1-gate) -> 0)
+        net.params["layer_0"]["Wg"] = \
+            net.params["layer_0"]["Wg"] + jnp.asarray(
+                np.r_[1.5, np.zeros(3)][None, :], jnp.float32)
+        rs = np.random.RandomState(11)
+        x = rs.rand(256, 8).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 256)]
+        solver = Solver(net)
+        for _ in range(80):
+            solver.fit_batch(x, y)
+        return float(net.state["layer_0"]["aux_load_balance"])
+
+    aux_off = train(0.0)
+    aux_on = train(2.0)
+    # aux == 1.0 is perfectly balanced (E * sum(frac*mass) with uniform
+    # frac=mass=1/E); the trained-with-loss router must be much closer
+    assert aux_on < aux_off - 1.0, (aux_on, aux_off)
+    assert aux_on < 1.5, aux_on

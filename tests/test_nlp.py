@@ -137,3 +137,48 @@ def test_word2vec_min_count():
     assert not w2v.has_word("c")  # below min_count
     with pytest.raises(ValueError):
         Word2Vec(min_count=10).fit([["x", "y"]])
+
+
+def test_word2vec_hierarchical_softmax_parity():
+    """HS and NS modes learn the same toy cluster structure (VERDICT r4
+    ask 9; reference: useHierarchicSoftmax — SURVEY.md:139)."""
+    rng = np.random.RandomState(0)
+    animals = ["cat", "dog", "fox", "wolf"]
+    tools = ["hammer", "wrench", "drill", "saw"]
+    sentences = []
+    for _ in range(400):
+        group = animals if rng.rand() < 0.5 else tools
+        sentences.append([group[rng.randint(4)] for _ in range(8)])
+    w2v = Word2Vec(vector_size=16, window=3, min_count=1, hs=True,
+                   epochs=5, batch_size=256, seed=3,
+                   learning_rate=5.0, subsample=0)
+    w2v.fit(sentences)
+    # Huffman tables: V leaves, V-1 inner nodes, mask rows all non-empty
+    v = len(w2v.vocab)
+    assert w2v.syn1.shape[0] == v - 1
+    assert w2v.hs_points.shape == w2v.hs_codes.shape == w2v.hs_mask.shape
+    assert (w2v.hs_mask.sum(axis=1) >= 1).all()
+    # same qualitative structure as the NS-mode test
+    assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "hammer")
+    nearest = w2v.words_nearest("cat", 3)
+    assert set(nearest) <= set(animals) - {"cat"}, nearest
+
+
+def test_word2vec_huffman_codes_prefix_free():
+    """Huffman invariants: shorter codes for frequent words, prefix-free."""
+    sents = [["the"] * 50, ["quick"] * 20, ["brown"] * 10, ["fox"] * 5,
+             ["jumps"] * 2, ["over"] * 2]
+    w2v = Word2Vec(vector_size=4, min_count=1, hs=True, epochs=1,
+                   batch_size=8, subsample=0)
+    w2v.fit(sents)
+    lens = w2v.hs_mask.sum(axis=1).astype(int)
+    # vocab is sorted by descending count: code lengths must be
+    # nondecreasing
+    assert all(lens[i] <= lens[i + 1] for i in range(len(lens) - 1)), lens
+    codes = ["".join(str(int(b)) for b in w2v.hs_codes[i][: lens[i]])
+             for i in range(len(w2v.vocab))]
+    assert len(set(codes)) == len(codes)
+    for i, a in enumerate(codes):
+        for j, b in enumerate(codes):
+            if i != j:
+                assert not b.startswith(a), (a, b)

@@ -278,3 +278,76 @@ def test_distributed_trainer_computation_graph():
     out = np.asarray(trainer.output(x))
     assert out.shape == (16, 4)
     np.testing.assert_allclose(out.sum(1), 1.0, atol=1e-4)
+
+
+class TestTransformerTensorParallel:
+    """VERDICT r4 ask 5: TP proven on a transformer, not LeNet's Dense
+    layers — BertEncoder QKV/FFN kernels sharded over 'model' with
+    Megatron column/row rules, loss-equal to the unsharded run."""
+
+    BERT_KW = dict(vocab_size=50, hidden=32, n_layers=2, n_heads=4,
+                   ffn_size=64, max_len=16, seed=7)
+
+    # Megatron layout: QKV and FFN-in are column-parallel (activations
+    # split over heads/ffn), attention-out and FFN-out are row-parallel
+    # (XLA inserts the psum). Biases of column-parallel layers shard too.
+    TP_RULES = [
+        (r".*_attn/W[qkv]$", P(None, "model")),
+        (r".*_attn/Wo$", P("model", None)),
+        (r".*_ffn1/W$", P(None, "model")),
+        (r".*_ffn1/b$", P("model")),
+        (r".*_ffn2/W$", P("model", None)),
+    ]
+
+    def _data(self, batch=8):
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, 50, (batch, 16)).astype(np.int32)
+        labels = rs.randint(0, 50, (batch, 16)).astype(np.int32)
+        return ids, labels
+
+    def test_bert_tp_loss_equals_unsharded(self):
+        from deeplearning4j_tpu.model.zoo import BertEncoder
+        from deeplearning4j_tpu.train.graph_solver import GraphSolver
+
+        m_ref = BertEncoder(**self.BERT_KW).init()
+        m_tp = BertEncoder(**self.BERT_KW).init()
+        mesh = make_mesh(data=2, model=4)
+        trainer = DistributedTrainer(m_tp, mesh=mesh,
+                                     param_sharding_rules=self.TP_RULES)
+        ids, labels = self._data()
+        solver = GraphSolver(m_ref)
+        for _ in range(3):
+            s_ref = solver.fit_batch((ids,), (labels,))
+            s_tp = trainer.fit_batch(ids, labels)
+        s_ref = s_ref[0] if isinstance(s_ref, tuple) else s_ref
+        assert np.allclose(float(s_ref), float(s_tp), rtol=1e-4), \
+            (float(s_ref), float(s_tp))
+        trainer.sync_to_model()
+        for lname in m_ref.params:
+            for pname in m_ref.params[lname]:
+                np.testing.assert_allclose(
+                    np.asarray(jax.device_get(m_ref.params[lname][pname])),
+                    np.asarray(jax.device_get(m_tp.params[lname][pname])),
+                    rtol=5e-3, atol=5e-5, err_msg=f"{lname}/{pname}")
+
+    def test_bert_tp_kernels_actually_sharded(self):
+        """The rules must HIT: each block's Wq/Wk/Wv/Wo/ffn kernels live
+        sharded over the model axis, not replicated."""
+        from deeplearning4j_tpu.model.zoo import BertEncoder
+
+        m_tp = BertEncoder(**self.BERT_KW).init()
+        mesh = make_mesh(data=2, model=4)
+        trainer = DistributedTrainer(m_tp, mesh=mesh,
+                                     param_sharding_rules=self.TP_RULES)
+        ids, labels = self._data()
+        trainer.fit_batch(ids, labels)
+        hit = []
+        for lname, lparams in trainer.params.items():
+            for pname, arr in lparams.items():
+                spec = getattr(arr.sharding, "spec", None)
+                if spec is not None and "model" in str(spec):
+                    hit.append(f"{lname}/{pname}")
+        for blk in ("blk0", "blk1"):
+            for suffix in ("_attn/Wq", "_attn/Wk", "_attn/Wv", "_attn/Wo",
+                           "_ffn1/W", "_ffn2/W"):
+                assert any(h == blk + suffix for h in hit), (blk + suffix, hit)
