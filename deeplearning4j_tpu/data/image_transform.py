@@ -23,7 +23,13 @@ import numpy as np
 
 
 class ImageTransform:
-    """Base: ``call(image, rng)`` -> image, both [h, w, c] float32."""
+    """Base: ``call(image, rng)`` -> image, both [h, w, c] float32.
+
+    ``uint8_safe`` marks transforms whose math is dtype-agnostic (pure
+    index shuffles: flip/crop) — the only ones ImageRecordReader's uint8
+    fast path may run before the on-device float cast."""
+
+    uint8_safe = False
 
     def call(self, image: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
         raise NotImplementedError
@@ -40,6 +46,7 @@ class FlipImageTransform(ImageTransform):
     1 = horizontal, -1 = both, None = random choice per call."""
 
     mode: Optional[int] = 1
+    uint8_safe = True
 
     def call(self, image, rng):
         mode = self.mode
@@ -49,7 +56,9 @@ class FlipImageTransform(ImageTransform):
             image = image[::-1]
         if mode in (1, -1):
             image = image[:, ::-1]
-        return np.ascontiguousarray(image)
+        # a VIEW, not a copy: downstream consumers (reader _load, resize)
+        # make one contiguous copy at the end of the whole pipeline
+        return image
 
 
 @dataclasses.dataclass
@@ -60,6 +69,7 @@ class CropImageTransform(ImageTransform):
     left: int = 0
     bottom: int = 0
     right: int = 0
+    uint8_safe = True
 
     def call(self, image, rng):
         h, w = image.shape[:2]
@@ -73,6 +83,7 @@ class RandomCropTransform(ImageTransform):
 
     height: int = 0
     width: int = 0
+    uint8_safe = True
 
     def call(self, image, rng):
         h, w = image.shape[:2]
@@ -141,6 +152,7 @@ class PipelineImageTransform(ImageTransform):
             s if isinstance(s, tuple) else (s, 1.0) for s in steps
         ]
         self.shuffle = shuffle
+        self.uint8_safe = all(t.uint8_safe for t, _ in self.steps)
 
     def call(self, image, rng):
         order = list(range(len(self.steps)))

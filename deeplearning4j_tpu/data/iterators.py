@@ -203,3 +203,35 @@ class MultipleEpochsIterator(DataSetIterator):
 
     def batch_size(self) -> int:
         return self.underlying.batch_size()
+
+
+class MappedDataSetIterator(DataSetIterator):
+    """Applies ``feature_fn`` (and optionally ``label_fn``) to each batch —
+    the composition point for ON-DEVICE preprocessing: pass a jitted fn
+    (cast/normalize/augment) and wrap an AsyncDataSetIterator whose
+    device_put already landed the raw (e.g. uint8) batch in HBM. The
+    augment program queues on the device stream ahead of the train step,
+    so the host stays on the cheap byte path end to end."""
+
+    def __init__(self, underlying: DataSetIterator, feature_fn,
+                 label_fn=None) -> None:
+        self.underlying = underlying
+        self.feature_fn = feature_fn
+        self.label_fn = label_fn
+
+    def has_next(self) -> bool:
+        return self.underlying.has_next()
+
+    def next(self) -> DataSet:
+        ds = self.underlying.next()
+        return DataSet(
+            self.feature_fn(ds.features),
+            ds.labels if self.label_fn is None else self.label_fn(ds.labels),
+            ds.features_mask, ds.labels_mask,
+        )
+
+    def reset(self) -> None:
+        self.underlying.reset()
+
+    def batch_size(self) -> int:
+        return self.underlying.batch_size()

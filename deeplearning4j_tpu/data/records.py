@@ -160,12 +160,31 @@ class ImageRecordReader(RecordReader):
                  root: Optional[str] = None,
                  paths: Optional[Sequence[str]] = None,
                  label_from_path: bool = True,
-                 transform=None, seed: int = 0) -> None:
+                 transform=None, seed: int = 0,
+                 output_dtype: str = "float32",
+                 workers: int = 1) -> None:
+        """``output_dtype="uint8"`` is the TPU-native fast path: pixels stay
+        uint8 on host end to end (decode header parse + crop/flip as numpy
+        VIEWS, one small contiguous copy), transfer to HBM at 1 byte/px,
+        and the cast to the model's float dtype happens ON DEVICE inside
+        the jitted step (core.dtypes.as_input) — the host float conversion
+        + [0,1] scaling that dominates the float32 path (~300us/img of its
+        ~400us on this host) disappears. Values are raw 0..255; fold the
+        1/255 into the model (or BN absorbs it). Only geometric transforms
+        (flip/crop) are uint8-safe; value-space transforms raise.
+
+        ``workers > 1`` decodes+augments on a thread pool (the netpbm/PIL
+        decode and the resize release the GIL), preserving record order —
+        the reference's multi-threaded NativeImageLoader ingestion."""
         if (root is None) == (paths is None):
             raise ValueError("provide exactly one of root= or paths=")
+        if output_dtype not in ("float32", "uint8"):
+            raise ValueError("output_dtype must be float32 or uint8")
         self.height, self.width, self.channels = height, width, channels
         self.label_from_path = label_from_path
         self.transform = transform
+        self.output_dtype = output_dtype
+        self.workers = int(workers)
         self._rng = np.random.RandomState(seed)
         # resolved once: PIL availability can't change mid-scan, and the
         # walk below tests this per file at ImageNet scale
@@ -208,30 +227,114 @@ class ImageRecordReader(RecordReader):
             arr = arr[:, :, None]
         return arr
 
-    def _load(self, path: str) -> np.ndarray:
-        img = self._decode(path)
-        if self.transform is not None:
-            img = np.asarray(self.transform.call(
-                np.asarray(img, np.float32), self._rng))
-        if img.shape[:2] != (self.height, self.width):
-            img = native.resize_bilinear(img, self.height, self.width)
+    def _decode_u8(self, path: str) -> np.ndarray:
+        """Decode to uint8 HWC with ZERO per-pixel host math: netpbm is a
+        header parse + frombuffer view; PIL hands back uint8 natively."""
+        if path.lower().endswith(self.NETPBM_EXTENSIONS):
+            with open(path, "rb") as f:
+                buf = f.read()
+            # P5/P6 header: magic, width, height, maxval, single whitespace
+            parts = buf.split(maxsplit=4)
+            if len(parts) < 5 or parts[0] not in (b"P5", b"P6"):
+                raise ValueError(f"{path}: not a binary netpbm (P5/P6)")
+            w, h = int(parts[1]), int(parts[2])
+            if int(parts[3]) > 255:
+                raise ValueError(
+                    f"{path}: 16-bit netpbm (maxval {int(parts[3])}) "
+                    "unsupported on the uint8 fast path")
+            c = 3 if parts[0] == b"P6" else 1
+            data = buf[len(buf) - h * w * c:]
+            return np.frombuffer(data, np.uint8).reshape(h, w, c)
+        Image = _pil()
+        if Image is None:
+            raise ValueError(f"{path}: only netpbm decodable without Pillow")
+        with Image.open(path) as im:
+            if im.mode not in ("RGB", "L"):
+                im = im.convert("RGB" if self.channels == 3 else "L")
+            arr = np.asarray(im)  # uint8
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr
+
+    def _load(self, path: str, rng=None) -> np.ndarray:
+        rng = rng if rng is not None else self._rng
+        if self.output_dtype == "uint8":
+            img = self._decode_u8(path)
+            if self.transform is not None:
+                if not getattr(self.transform, "uint8_safe", False):
+                    raise ValueError(
+                        "output_dtype='uint8' supports only geometric "
+                        "(uint8_safe) transforms — flip/crop; value-space "
+                        "transforms need the float32 path")
+                img = np.asarray(self.transform.call(img, rng))
+            if img.shape[:2] != (self.height, self.width):
+                # resize needs float math; round back so output stays u8
+                img = np.clip(native.resize_bilinear(
+                    img.astype(np.float32), self.height, self.width),
+                    0, 255).astype(np.uint8)
+        else:
+            img = self._decode(path)
+            if self.transform is not None:
+                img = np.asarray(self.transform.call(
+                    np.asarray(img, np.float32), rng))
+            if img.shape[:2] != (self.height, self.width):
+                img = native.resize_bilinear(img, self.height, self.width)
         if img.shape[2] != self.channels:
             if self.channels == 3 and img.shape[2] == 1:
                 img = np.repeat(img, 3, axis=2)
             elif self.channels == 1 and img.shape[2] == 3:
                 img = img.mean(axis=2, keepdims=True)
+                if self.output_dtype == "uint8":
+                    img = img.astype(np.uint8)
             else:
                 raise ValueError(
                     f"cannot adapt {img.shape[2]} channels to "
                     f"{self.channels}: {path}")
-        return img
+        return np.ascontiguousarray(img)
 
     def __iter__(self) -> Iterator[Record]:
+        if self.workers > 1:
+            yield from self._iter_parallel()
+            return
         for i, p in enumerate(self.paths):
             rec: Record = [self._load(p)]
             if self.label_from_path:
                 rec.append(self._path_labels[i])
             yield rec
+
+    def _iter_parallel(self) -> Iterator[Record]:
+        """Thread-pool decode+augment, order-preserving, bounded in-flight
+        window (the reference's multi-threaded image ingestion; decode and
+        resize release the GIL, so workers scale with real cores)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        # per-image independent rngs keep augmentation deterministic
+        # regardless of worker scheduling
+        seeds = self._rng.randint(0, 2**31 - 1, size=len(self.paths))
+
+        def load(i: int):
+            return self._load(self.paths[i],
+                              rng=np.random.RandomState(seeds[i]))
+
+        window = 4 * self.workers
+        with ThreadPoolExecutor(max_workers=self.workers) as pool:
+            pending = {}
+            nxt = 0
+            for i in range(len(self.paths)):
+                pending[i] = pool.submit(load, i)
+                while len(pending) >= window or (
+                        nxt in pending and pending[nxt].done()):
+                    rec: Record = [pending.pop(nxt).result()]
+                    if self.label_from_path:
+                        rec.append(self._path_labels[nxt])
+                    yield rec
+                    nxt += 1
+            while nxt in pending:
+                rec = [pending.pop(nxt).result()]
+                if self.label_from_path:
+                    rec.append(self._path_labels[nxt])
+                yield rec
+                nxt += 1
 
 
 class RecordReaderDataSetIterator:
@@ -247,14 +350,44 @@ class RecordReaderDataSetIterator:
                  label_index: int = -1, num_classes: Optional[int] = None,
                  regression: bool = False) -> None:
         self.reader = reader
-        self.batch_size = int(batch_size)
+        self._batch = int(batch_size)
         self.label_index = label_index
         self.num_classes = num_classes
         self.regression = regression
         if not regression and num_classes is None:
             raise ValueError("classification needs num_classes")
 
+    # -- DataSetIterator protocol (lookahead over the generator) so this
+    # composes with AsyncDataSetIterator / MappedDataSetIterator ----------
+    _gen = None
+    _lookahead = None
+
+    def batch_size(self) -> int:
+        return self._batch
+
+    def has_next(self) -> bool:
+        if self._gen is None:
+            self._gen = self._generate()
+        if self._lookahead is None:
+            self._lookahead = next(self._gen, None)
+        return self._lookahead is not None
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        item, self._lookahead = self._lookahead, None
+        return item
+
+    def reset(self) -> None:
+        self.reader.reset()
+        self._gen = None
+        self._lookahead = None
+
     def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self._generate()
+
+    def _generate(self) -> Iterator[DataSet]:
         feats: List[np.ndarray] = []
         labels: List[np.ndarray] = []
         for rec in self.reader:
@@ -263,7 +396,9 @@ class RecordReaderDataSetIterator:
             label_val = rec[li]
             fields = [v for i, v in enumerate(rec) if i != li]
             if len(fields) == 1 and isinstance(fields[0], np.ndarray):
-                feats.append(np.asarray(fields[0], np.float32))
+                # keep the reader's dtype: uint8 readers ship raw bytes to
+                # the device, where as_input does the float cast
+                feats.append(fields[0])
             else:
                 feats.append(np.asarray([float(v) for v in fields],
                                         np.float32))
@@ -278,11 +413,8 @@ class RecordReaderDataSetIterator:
                 onehot = np.zeros(self.num_classes, np.float32)
                 onehot[cls] = 1.0
                 labels.append(onehot)
-            if len(feats) == self.batch_size:
+            if len(feats) == self._batch:
                 yield DataSet(np.stack(feats), np.stack(labels))
                 feats, labels = [], []
         if feats:
             yield DataSet(np.stack(feats), np.stack(labels))
-
-    def reset(self) -> None:
-        self.reader.reset()

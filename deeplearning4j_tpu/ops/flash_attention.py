@@ -256,13 +256,15 @@ def _flash_forward(q, k, v, mask, causal, scale, block_q, block_k, interpret,
     return out, lse.reshape(b, h, tq_p)[:, :, :tq]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, mask, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, mask, causal, scale, block_q, block_k, bwd_block_q,
+           bwd_block_k, interpret):
     return _flash_forward(q, k, v, mask, causal, scale, block_q, block_k,
                           interpret)
 
 
-def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, mask, causal, scale, block_q, block_k, bwd_block_q,
+               bwd_block_k, interpret):
     out, lse = _flash_forward(q, k, v, mask, causal, scale, block_q, block_k,
                               interpret, with_lse=True)
     return out, (q, k, v, mask, out, lse)
@@ -274,11 +276,18 @@ def _mea_bwd_single(q, k, v, mask_k, g, out, lse_rows, *, causal, scale,
     the XLA spelling): two-level ``lax.scan`` over (q-chunk, k-chunk)
     recomputes score blocks instead of materializing the [tq, tk] matrix —
     backward memory is O(t·d) like the flash forward, so long-context
-    TRAINING fits, not just inference. Inputs are f32, pre-padded to chunk
-    multiples. Returns (dq, dk, dv)."""
+    TRAINING fits, not just inference. Returns (dq, dk, dv).
+
+    MXU discipline (round-5 backward tuning): operands stay in the INPUT
+    dtype (bf16 on TPU) and every matmul accumulates in f32 via
+    ``preferred_element_type`` — the same policy as the forward kernel.
+    The softmax/statistics math (exp, lse, delta, ds scaling) runs in f32;
+    only the 5 big dot_generals see bf16 operands, which doubles their MXU
+    rate vs the previous cast-everything-to-f32 spelling."""
     tq, d = q.shape
     tk, dv = v.shape
     nq, nk = tq // bq, tk // bk
+    op_dtype = q.dtype  # matmul operand dtype (bf16 on the TPU path)
     qc = q.reshape(nq, bq, d)
     gc = g.reshape(nq, bq, dv)
     oc = out.reshape(nq, bq, dv)
@@ -288,8 +297,12 @@ def _mea_bwd_single(q, k, v, mask_k, g, out, lse_rows, *, causal, scale,
     mc = mask_k.reshape(nk, bk)
     neg = jnp.float32(_NEG)
 
+    def dotf32(a, b, dims):
+        return lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32)
+
     def scores(qch, kch, mch, qi, ki):
-        s = (qch @ kch.T) * scale  # [bq, bk]
+        s = dotf32(qch, kch, ((1,), (1,))) * scale  # [bq, bk] f32
         s = jnp.where(mch[None, :] > 0, s, neg)
         if causal:
             q_ids = (qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
@@ -322,17 +335,20 @@ def _mea_bwd_single(q, k, v, mask_k, g, out, lse_rows, *, causal, scale,
             # fully-masked rows: force P = 0 downstream, not exp(s+inf)
             lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)),
                             jnp.float32(-_NEG))
-        delta = jnp.sum(gch * och, axis=-1, keepdims=True)  # D_i
+        delta = jnp.sum(gch.astype(jnp.float32) * och.astype(jnp.float32),
+                        axis=-1, keepdims=True)  # D_i
 
         # pass 2: dq for this q-chunk; per-k-chunk dk/dv contributions
         def p2(dq, ys):
             ki, kch, vch, mch = ys
             s = scores(qch, kch, mch, qi, ki)
             p = jnp.where(s > neg * 0.5, jnp.exp(s - lse), 0.0)  # [bq, bk]
-            dp = gch @ vch.T                                     # [bq, bk]
-            ds = p * (dp - delta)
-            dq = dq + (ds @ kch) * scale
-            return dq, ((ds.T @ qch) * scale, p.T @ gch)
+            dp = dotf32(gch, vch, ((1,), (1,)))                  # [bq, bk]
+            ds = (p * (dp - delta)).astype(op_dtype)
+            p_c = p.astype(op_dtype)
+            dq = dq + dotf32(ds, kch, ((1,), (0,))) * scale
+            return dq, (dotf32(ds, qch, ((0,), (0,))) * scale,
+                        dotf32(p_c, gch, ((0,), (0,))))
 
         dq, (dks, dvs) = lax.scan(
             p2, jnp.zeros((bq, d), jnp.float32),
@@ -347,20 +363,241 @@ def _mea_bwd_single(q, k, v, mask_k, g, out, lse_rows, *, causal, scale,
     return dqs.reshape(tq, d), dk_out.reshape(tk, d), dv_out.reshape(tk, dv)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, mask, out, lse = res
+def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, mask_ref,
+               dq_ref, dq_scr, *, scale, block_q, block_k, causal, tk_offset):
+    """Pallas backward kernel 1: dq. Grid (bh, q-block, k-block), k
+    innermost; dq accumulates in VMEM scratch across the sequential k
+    steps (same carry discipline as the forward kernel's online softmax).
+    Per step: recompute the score block from q/k (bf16 operands, f32
+    accumulation), p = exp(s - lse), ds = p * (g·vᵀ - delta),
+    dq += ds·k · scale."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    def body():
+        q = q_ref[0]                       # [bq, d] bf16
+        ks = k_ref[0]                      # [bk, d]
+        vs = v_ref[0]                      # [bk, dv]
+        gs = g_ref[0]                      # [bq, dv]
+        lse = lse_ref[0]                   # [bq, 1] f32
+        delta = delta_ref[0]               # [bq, 1] f32
+        s = jax.lax.dot_general(
+            q, ks, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        mk = mask_ref[0, 0]
+        s = jnp.where(mk[None, :] > 0, s, _NEG)
+        if causal:
+            q_ids = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + tk_offset
+            k_ids = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, _NEG)
+        p = jnp.where(s > _NEG * 0.5, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            gs, vs, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dq_scr[...] += jax.lax.dot_general(
+            ds, ks, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        @pl.when(qi * block_q + tk_offset + block_q - 1 >= ki * block_k)
+        def _():
+            body()
+    else:
+        body()
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, mask_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, block_q, block_k,
+                causal, tk_offset):
+    """Pallas backward kernel 2: dk and dv. Grid (bh, k-block, q-block),
+    q innermost; dk/dv accumulate in VMEM scratch across q steps.
+
+    Everything is computed in TRANSPOSED orientation — sᵀ = k·qᵀ [bk, bq],
+    pᵀ, dsᵀ — so the two accumulating contractions are natural
+    ([bk, bq]·[bq, d]) with no Mosaic tile transposes; lse/delta arrive as
+    ROW vectors (tile (1, bq)) for the same reason."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    def body():
+        q = q_ref[0]                        # [bq, d]
+        ks = k_ref[0]                       # [bk, d]
+        vs = v_ref[0]                       # [bk, dv]
+        gs = g_ref[0]                       # [bq, dv]
+        lse_row = lse_ref[0]                # [1, bq] f32
+        delta_row = delta_ref[0]            # [1, bq] f32
+        s_t = jax.lax.dot_general(
+            ks, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bk, bq]
+        mk = mask_ref[0, 0]                 # [bk]
+        s_t = jnp.where(mk[:, None] > 0, s_t, _NEG)
+        if causal:
+            k_ids = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 0)
+            q_ids = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 1) + tk_offset
+            s_t = jnp.where(q_ids >= k_ids, s_t, _NEG)
+        p_t = jnp.where(s_t > _NEG * 0.5, jnp.exp(s_t - lse_row), 0.0)
+        dp_t = jax.lax.dot_general(
+            vs, gs, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bk, bq]
+        ds_t = (p_t * (dp_t - delta_row)).astype(q.dtype)
+        dk_scr[...] += jax.lax.dot_general(
+            ds_t, q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bk, d]
+        dv_scr[...] += jax.lax.dot_general(
+            p_t.astype(q.dtype), gs, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bk, dv]
+
+    if causal:
+        # skip q-blocks entirely ABOVE the diagonal for this k block
+        @pl.when(qi * block_q + tk_offset + block_q - 1 >= ki * block_k)
+        def _():
+            body()
+    else:
+        body()
+
+    @pl.when(qi == pl.num_programs(2) - 1)
+    def _():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, mask, out, lse, g, causal, scale, bq, bk):
+    """Pallas two-kernel backward (dq pass + dkv pass). Requires the lse
+    saved by the Pallas forward. Inputs [b, h, t, d]."""
     b, h, tq, d = q.shape
     tk, dv = k.shape[2], v.shape[3]
-    bq = min(block_q, max(tq, 1))
-    bk = min(block_k, max(tk, 1))
+    bq = min(bq, max(tq, 1))
+    bk = min(bk, max(tk, 1))
+    # halve blocks while padding waste exceeds 25% (t=1100 with bq=1024
+    # would pad to 2048 — every padded tile still runs all five matmuls)
+    while bq > 128 and -(-tq // bq) * bq > 1.25 * tq:
+        bq //= 2
+    while bk > 128 and -(-tk // bk) * bk > 1.25 * tk:
+        bk //= 2
 
     mask_k = jnp.ones((b, tk), jnp.float32) if mask is None \
         else mask.astype(jnp.float32)
-    qp = _pad_to(q.astype(jnp.float32), 2, bq)
-    gp = _pad_to(g.astype(jnp.float32), 2, bq)
-    op = _pad_to(out.astype(jnp.float32), 2, bq)
-    kp = _pad_to(k.astype(jnp.float32), 2, bk)
-    vp = _pad_to(v.astype(jnp.float32), 2, bk)
+    mp = _pad_to(mask_k, 1, bk, 0.0)[:, None, :]
+    qp = _pad_to(q, 2, bq)
+    gp = _pad_to(g.astype(q.dtype), 2, bq)
+    # delta precomputed in XLA (cheap elementwise+reduce, fuses upstream)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    dp_ = _pad_to(delta[..., None], 2, bq, 0.0)
+    lp = _pad_to(lse.astype(jnp.float32)[..., None], 2, bq, -_NEG)
+    kp = _pad_to(k, 2, bk)
+    vp = _pad_to(v, 2, bk)
+    tq_p, tk_p = qp.shape[2], kp.shape[2]
+
+    qp = qp.reshape(b * h, tq_p, d)
+    kp = kp.reshape(b * h, tk_p, d)
+    vp = vp.reshape(b * h, tk_p, dv)
+    gp = gp.reshape(b * h, tq_p, dv)
+    lp = lp.reshape(b * h, tq_p, 1)
+    dp_ = dp_.reshape(b * h, tq_p, 1)
+
+    kw = dict(memory_space=_VMEM)
+    kern_q = functools.partial(
+        _dq_kernel, scale=scale, block_q=bq, block_k=bk, causal=causal,
+        tk_offset=tk - tq)
+    dq = pl.pallas_call(
+        kern_q,
+        grid=(b * h, tq_p // bq, tk_p // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0), **kw),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0), **kw),
+            pl.BlockSpec((1, bk, dv), lambda bh, qi, ki: (bh, ki, 0), **kw),
+            pl.BlockSpec((1, bq, dv), lambda bh, qi, ki: (bh, qi, 0), **kw),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0), **kw),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0), **kw),
+            pl.BlockSpec((1, 1, bk), lambda bh, qi, ki: (bh // h, 0, ki),
+                         **kw),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
+                               **kw),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+    )(qp, kp, vp, gp, lp, dp_, mp)
+
+    kern_kv = functools.partial(
+        _dkv_kernel, scale=scale, block_q=bq, block_k=bk, causal=causal,
+        tk_offset=tk - tq)
+    # row-vector stats for the transposed dkv kernel
+    lp_row = jnp.transpose(lp, (0, 2, 1))     # [bh, 1, tq_p]
+    dp_row = jnp.transpose(dp_, (0, 2, 1))
+    dk, dv_out = pl.pallas_call(
+        kern_kv,
+        grid=(b * h, tk_p // bk, tq_p // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0), **kw),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0), **kw),
+            pl.BlockSpec((1, bk, dv), lambda bh, ki, qi: (bh, ki, 0), **kw),
+            pl.BlockSpec((1, bq, dv), lambda bh, ki, qi: (bh, qi, 0), **kw),
+            pl.BlockSpec((1, 1, bq), lambda bh, ki, qi: (bh, 0, qi), **kw),
+            pl.BlockSpec((1, 1, bq), lambda bh, ki, qi: (bh, 0, qi), **kw),
+            pl.BlockSpec((1, 1, bk), lambda bh, ki, qi: (bh // h, 0, ki),
+                         **kw),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0), **kw),
+            pl.BlockSpec((1, bk, dv), lambda bh, ki, qi: (bh, ki, 0), **kw),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk_p, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk_p, dv), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, dv), jnp.float32)],
+    )(qp, kp, vp, gp, lp_row, dp_row, mp)
+
+    dq = dq.reshape(b, h, tq_p, d)[:, :, :tq].astype(q.dtype)
+    dk = dk.reshape(b, h, tk_p, d)[:, :, :tk].astype(k.dtype)
+    dv_out = dv_out.reshape(b, h, tk_p, dv)[:, :, :tk].astype(v.dtype)
+    return dq, dk, dv_out
+
+
+def _flash_bwd(causal, scale, block_q, block_k, bwd_block_q, bwd_block_k,
+               interpret, res, g):
+    q, k, v, mask, out, lse = res
+    b, h, tq, d = q.shape
+    tk, dv = k.shape[2], v.shape[3]
+    if _VMEM is not None and not interpret and lse is not None:
+        # compiled path: the two-kernel Pallas backward
+        dq, dk, dv_g = _flash_bwd_pallas(
+            q, k, v, mask, out, lse, g, causal, scale,
+            bwd_block_q or block_q, bwd_block_k or block_k)
+        dmask = None if mask is None else jnp.zeros_like(mask)
+        return dq, dk, dv_g, dmask
+    # interpreter/CPU fallback: the scan-based memory-efficient backward
+    bq = min(bwd_block_q or block_q, max(tq, 1))
+    bk = min(bwd_block_k or block_k, max(tk, 1))
+
+    mask_k = jnp.ones((b, tk), jnp.float32) if mask is None \
+        else mask.astype(jnp.float32)
+    # operands stay in the input dtype (bf16 on TPU): every matmul in
+    # _mea_bwd_single accumulates f32 via preferred_element_type
+    qp = _pad_to(q, 2, bq)
+    gp = _pad_to(g.astype(q.dtype), 2, bq)
+    op = _pad_to(out.astype(q.dtype), 2, bq)
+    kp = _pad_to(k, 2, bk)
+    vp = _pad_to(v, 2, bk)
     mp = _pad_to(mask_k, 1, bk, 0.0)
     have_lse = lse is not None
     if have_lse:
@@ -394,25 +631,32 @@ def flash_attention(
     scale: Optional[float] = None,
     block_q: int = 256,
     block_k: Optional[int] = None,
+    bwd_block_q: Optional[int] = None,
+    bwd_block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention over [b, h, t, d] tensors. ``mask`` is a [b, t_k]
     key-padding mask (1 = keep). Runs the Pallas kernel compiled on TPU and
     in interpreter mode elsewhere (the CPU test path).
 
-    Blocks are tuned on TPU v5e (d=64, bf16; sweep in ROUND4_NOTES.md):
+    Blocks are tuned on TPU v5e (d=64, bf16; forward sweep in
+    ROUND4_NOTES.md, backward sweep in ROUND5_NOTES.md): forward
     block_q=256 with block_k adaptive on sequence length — 512 up to 4k
-    (1.0x XLA at t=2048) and 1024 beyond (6x at t=8192, 18.6 ms at 16k,
-    32.4 ms at 32k; the larger k-tile amortizes the running-softmax
-    rescale over more MXU work once the k loop is long)."""
+    and 1024 beyond. The scan-based backward defaults to LARGER tiles
+    (bwd 1024x1024) because each scan step's five matmuls must fill the
+    MXU on their own; operands stay bf16 with f32 accumulation."""
     if block_k is None:
         block_k = 512 if k.shape[2] < 8192 else 1024
+    if bwd_block_q is None:
+        bwd_block_q = 1024
+    if bwd_block_k is None:
+        bwd_block_k = 1024
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     return _flash(q, k, v, mask, causal, float(scale), block_q, block_k,
-                  interpret)
+                  bwd_block_q, bwd_block_k, interpret)
 
 
 def mha_attention(

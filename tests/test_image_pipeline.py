@@ -165,3 +165,137 @@ def test_input_pipeline_throughput_vs_resnet_step(tmp_path, capsys):
         print(f"\n[input-pipeline] {rate:.0f} img/s host vs "
               f"{resnet_tpu_sps:.0f} samples/s ResNet-50/TPU -> "
               f"need ~{resnet_tpu_sps / rate:.1f} input workers")
+
+
+# ---- round-5 input-pipeline (VERDICT r4 ask 2) ----------------------------
+
+
+def _make_ppm_tree(tmp_path, n=12, size=32):
+    rng = np.random.RandomState(0)
+    header = f"P6 {size} {size} 255\n".encode()
+    for cls in ("a", "b"):
+        (tmp_path / cls).mkdir(exist_ok=True)
+    for i in range(n):
+        body = rng.randint(0, 256, (size, size, 3), np.uint8).tobytes()
+        (tmp_path / "ab"[i % 2] / f"{i}.ppm").write_bytes(header + body)
+    return str(tmp_path)
+
+
+def test_uint8_reader_matches_float_reader(tmp_path):
+    from deeplearning4j_tpu.data.image_transform import CropImageTransform
+    from deeplearning4j_tpu.data.records import ImageRecordReader
+
+    root = _make_ppm_tree(tmp_path, n=6)
+    crop = CropImageTransform(top=4, left=4, bottom=4, right=4)
+    u8 = list(ImageRecordReader(24, 24, 3, root=root, transform=crop,
+                                output_dtype="uint8"))
+    f32 = list(ImageRecordReader(24, 24, 3, root=root, transform=crop))
+    assert len(u8) == len(f32) == 6
+    for (a, la), (b, lb) in zip(u8, f32):
+        assert a.dtype == np.uint8 and b.dtype == np.float32
+        assert la == lb
+        np.testing.assert_allclose(a.astype(np.float32) / 255.0, b,
+                                   atol=1e-6)
+
+
+def test_uint8_reader_rejects_value_transforms(tmp_path):
+    from deeplearning4j_tpu.data.image_transform import BrightnessTransform
+    from deeplearning4j_tpu.data.records import ImageRecordReader
+
+    root = _make_ppm_tree(tmp_path, n=2)
+    reader = ImageRecordReader(32, 32, 3, root=root,
+                               transform=BrightnessTransform(delta=0.1),
+                               output_dtype="uint8")
+    with pytest.raises(ValueError, match="uint8"):
+        next(iter(reader))
+
+
+def test_parallel_reader_preserves_order_and_content(tmp_path):
+    from deeplearning4j_tpu.data.records import ImageRecordReader
+
+    root = _make_ppm_tree(tmp_path, n=16)
+    serial = list(ImageRecordReader(32, 32, 3, root=root,
+                                    output_dtype="uint8"))
+    parallel = list(ImageRecordReader(32, 32, 3, root=root,
+                                      output_dtype="uint8", workers=4))
+    assert len(serial) == len(parallel) == 16
+    for (a, la), (b, lb) in zip(serial, parallel):
+        np.testing.assert_array_equal(a, b)
+        assert la == lb
+
+
+def test_uint8_batches_flow_to_device_augment_and_fit(tmp_path):
+    """End-to-end: u8 files -> RecordReader -> async prefetch+device_put ->
+    jitted on-device augment (crop+cast+scale) -> train step. The host
+    never touches a float pixel (SURVEY.md §3.1 I/O-overlap boundary)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.data.image_transform import batch_random_crop
+    from deeplearning4j_tpu.data.iterators import (
+        AsyncDataSetIterator, MappedDataSetIterator, device_put_dataset,
+    )
+    from deeplearning4j_tpu.data.records import (
+        ImageRecordReader, RecordReaderDataSetIterator,
+    )
+    from deeplearning4j_tpu.nn import (
+        Activation, InputType, LossFunction, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.layers import (
+        ConvolutionLayer, GlobalPoolingLayer, OutputLayer, PoolingType,
+    )
+    from deeplearning4j_tpu.nn.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.train.solver import Solver
+
+    root = _make_ppm_tree(tmp_path, n=8, size=32)
+    reader = ImageRecordReader(32, 32, 3, root=root, output_dtype="uint8")
+    base = RecordReaderDataSetIterator(reader, batch_size=4, label_index=1,
+                                       num_classes=2)
+    key = jax.random.PRNGKey(0)
+
+    def prep(features):  # [b, h, w, c] u8 -> [b, c, 24, 24] f32 in [0,1]
+        x = jnp.transpose(jnp.asarray(features), (0, 3, 1, 2))
+        x = x.astype(jnp.float32) / 255.0
+        return batch_random_crop(x, key, 24, 24)
+
+    it = MappedDataSetIterator(
+        AsyncDataSetIterator(base, device_put_fn=device_put_dataset),
+        feature_fn=jax.jit(prep))
+
+    lb = (NeuralNetConfiguration.builder().seed(3).list()
+          .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3)))
+          .layer(GlobalPoolingLayer(pooling_type=PoolingType.AVG))
+          .layer(OutputLayer(n_out=2, loss=LossFunction.MCXENT,
+                             activation=Activation.SOFTMAX)))
+    lb.set_input_type(InputType.convolutional(24, 24, 3))
+    net = MultiLayerNetwork(lb.build()).init()
+    solver = Solver(net)
+    n = 0
+    for ds in it:
+        assert ds.features.dtype == jnp.float32
+        score = float(solver.fit_batch(ds.features, ds.labels)[0])
+        assert np.isfinite(score)
+        n += ds.features.shape[0]
+    assert n == 8
+
+
+def test_record_iterator_multi_epoch_reset(tmp_path):
+    """Regression: reset() must clear the protocol lookahead so wrappers
+    like MultipleEpochsIterator see every epoch, not just the first."""
+    from deeplearning4j_tpu.data.iterators import MultipleEpochsIterator
+    from deeplearning4j_tpu.data.records import (
+        ImageRecordReader, RecordReaderDataSetIterator,
+    )
+
+    root = _make_ppm_tree(tmp_path, n=8)
+    reader = ImageRecordReader(32, 32, 3, root=root, output_dtype="uint8")
+    base = RecordReaderDataSetIterator(reader, batch_size=4, label_index=1,
+                                       num_classes=2)
+    assert base.batch_size() == 4
+    it = MultipleEpochsIterator(base, epochs=3)
+    it.reset()
+    n = 0
+    while it.has_next():
+        n += it.next().features.shape[0]
+    assert n == 24  # 8 images x 3 epochs
+    assert it.batch_size() == 4
