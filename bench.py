@@ -15,29 +15,35 @@ benching can produce NO output at all. This driver therefore:
      measurements on host CPU and reports ``platform: "cpu-fallback"`` plus a
      ``diagnostics`` field.
 
-Headline metric: ResNet-50 synthetic-ImageNet train samples/sec/chip
-(ComputationGraph path — BASELINE.md row 1). Extra rows: native BERT
-encoder tokens/sec, TF-imported BERT-base tokens/sec (the BASELINE.json:10
-metric), GravesLSTM char-RNN chars/sec, LeNet-MNIST smoke, a matmul
-calibration row (measured peak + block-vs-fence timer check), the input
-pipeline images/sec vs the device step rate, and a ResNet batch-128
-scaling probe. All timed regions end with a host fetch of a
-result-dependent scalar (``_host_fence``) — block_until_ready does not
-reliably wait under axon. ``vs_baseline`` divides device throughput by
-host-CPU throughput measured in this run (the reference's designated
-baseline config is CPU; no published numbers exist — BASELINE.md), with
-``baseline_config`` recording what was compared and null when no valid
-baseline ran.
+Round-5 measurement discipline (VERDICT r4 asks 1-4):
+  * EVERY timed row is the MEDIAN of >= 3 repetitions, with
+    ``spread: {min, max, n}`` archived in the row (same unit as the value)
+    and all MFU gates applied to the median.
+  * Timed regions end with ONE host fence (D2H fetch of a result-dependent
+    scalar, ``_host_fence``) amortized over the whole rep —
+    block_until_ready does not reliably wait under axon, and a fence costs
+    ~65 ms over the tunnel, so per-call fencing would dominate (measured
+    round 5: per-call fencing misreports a 110 TFLOP/s matmul as 15).
+  * The conv roofline is measured on ResNet-50's OWN hot conv shapes
+    (exact table derived from the zoo graph, batch-matched), FLOPs-weighted
+    into a single achievable ceiling — not a single arbitrary conv.
+  * ``bert_tf_import_train`` is the literal BASELINE.json:10 metric:
+    import -> convert_to_variables -> sd.fit, full-graph HLO, tokens/s.
+  * ``resnet50_e2e_fit`` trains from DECODED FILES through the uint8
+    zero-host-math pipeline with on-device augmentation, to compare
+    against the synthetic-data step rate.
 """
 
 import json
 import os
+import statistics
 import subprocess
 import sys
 import time
 
 PROBE_TIMEOUT_S = 180
 MEASURE_TIMEOUT_S = 1500
+REPEATS = 3  # median-of-N for every timed row
 
 
 # --------------------------------------------------------------------------
@@ -58,14 +64,12 @@ def _host_fence(tree) -> float:
     data-depends on ``tree``.
 
     ``jax.block_until_ready`` returns without waiting under the axon PJRT
-    plugin (VERDICT.md round 3, verified live: a matmul chain "achieved"
-    1669 TFLOP/s block-timed vs ~34-38 TFLOP/s with a forced device->host
-    fetch), so a D2H copy of a result-dependent scalar is the only
-    trustworthy fence. Each training step is one jitted program whose
-    outputs all complete together, and step N's params depend on step
-    N-1's, so summing one leaf of the final params transitively fences the
-    whole timed chain.
-    """
+    plugin (VERDICT.md round 3, verified live), so a D2H copy of a
+    result-dependent scalar is the only trustworthy fence. Each training
+    step is one jitted program whose outputs all complete together, and
+    step N's params depend on step N-1's, so summing one leaf of the final
+    params transitively fences the whole timed chain. One fence costs
+    ~65 ms over the tunnel — always amortize it over a block of steps."""
     import jax
     import jax.numpy as jnp
 
@@ -73,10 +77,29 @@ def _host_fence(tree) -> float:
     return float(jnp.sum(jnp.asarray(leaf, jnp.float32)))
 
 
-def measure_lenet(batch: int = 256, warmup_iters: int = 12, bench_iters: int = 60) -> dict:
-    """LeNet-MNIST MultiLayerNetwork.fit() smoke row (BASELINE.json:7)."""
+def _fence_tree(tree) -> None:
     import jax
 
+    for leaf in jax.tree_util.tree_leaves(tree):
+        _host_fence(leaf)
+
+
+def _median_rate(run_block, units_per_block: float, repeats: int = REPEATS):
+    """``run_block()`` -> seconds for one fenced block of work. Returns
+    (median_rate, spread_dict) with min/max expressed as RATES."""
+    rates = []
+    for _ in range(repeats):
+        sec = run_block()
+        rates.append(units_per_block / sec)
+    return statistics.median(rates), {
+        "min": round(min(rates), 2), "max": round(max(rates), 2),
+        "n": repeats,
+    }
+
+
+def measure_lenet(batch: int = 256, warmup_iters: int = 12,
+                  bench_iters: int = 60) -> dict:
+    """LeNet-MNIST MultiLayerNetwork.fit() smoke row (BASELINE.json:7)."""
     from deeplearning4j_tpu.data.dataset import DataSet
     from deeplearning4j_tpu.data.iterators import ListDataSetIterator
     from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
@@ -89,18 +112,20 @@ def measure_lenet(batch: int = 256, warmup_iters: int = 12, bench_iters: int = 6
     def run(n_iters: int) -> float:
         epochs = max(1, n_iters // 8)
         it = ListDataSetIterator(data, batch)
-        _host_fence(model.params)  # drain pending work before starting the clock
+        _host_fence(model.params)  # drain pending work
         start = time.perf_counter()
         model.fit(it, epochs=epochs)
         _host_fence(model.params)
-        return (time.perf_counter() - start) / (epochs * 8)
+        return time.perf_counter() - start
 
     run(warmup_iters)
-    per_iter = run(bench_iters)
-    return {"samples_per_sec": batch / per_iter, "batch": batch}
+    rate, spread = _median_rate(
+        lambda: run(bench_iters), batch * max(1, bench_iters // 8) * 8)
+    return {"samples_per_sec": rate, "spread": spread, "batch": batch}
 
 
-def measure_resnet50(batch: int = 64, warmup_iters: int = 3, bench_iters: int = 20,
+def measure_resnet50(batch: int = 64, warmup_iters: int = 3,
+                     bench_iters: int = 20,
                      compute_dtype: str = "bfloat16") -> dict:
     """ResNet-50 synthetic-ImageNet train samples/sec/chip + MFU
     (BASELINE.md row 1; the reference's ComputationGraph.fit path)."""
@@ -117,7 +142,6 @@ def measure_resnet50(batch: int = 64, warmup_iters: int = 3, bench_iters: int = 
     model = ResNet50(seed=42, num_classes=1000, compute_dtype=cd).init()
     solver = GraphSolver(model)
     rng = np.random.RandomState(0)
-    # synthetic ImageNet at shape, NCHW (the framework's CNN convention)
     x = jnp.asarray(rng.rand(batch, 3, 224, 224), model.dtype)
     y_np = np.zeros((batch, 1000), np.float32)
     y_np[np.arange(batch), rng.randint(0, 1000, batch)] = 1.0
@@ -126,28 +150,166 @@ def measure_resnet50(batch: int = 64, warmup_iters: int = 3, bench_iters: int = 
     for _ in range(warmup_iters):
         solver.fit_batch((x,), (y,))
     _host_fence(model.params)
-    start = time.perf_counter()
-    for _ in range(bench_iters):
-        solver.fit_batch((x,), (y,))
-    _host_fence(model.params)
-    sec_per_step = (time.perf_counter() - start) / bench_iters
 
-    sps = batch / sec_per_step
+    def block():
+        start = time.perf_counter()
+        for _ in range(bench_iters):
+            solver.fit_batch((x,), (y,))
+        _host_fence(model.params)
+        return time.perf_counter() - start
+
+    sps, spread = _median_rate(block, batch * bench_iters)
     flops_per_ex = resnet50_train_flops_per_example()
     achieved = sps * flops_per_ex
     peak = chip_peak_flops(jax.devices()[0], compute_dtype)
     return {
         "samples_per_sec": sps,
+        "spread": spread,
         "batch": batch,
         "compute_dtype": compute_dtype,
-        "step_ms": sec_per_step * 1e3,
+        "step_ms": batch / sps * 1e3,
         "model_tflops_per_sec": achieved / 1e12,
         "mfu": (achieved / peak) if peak else None,
     }
 
 
+def measure_resnet50_b128() -> dict:
+    """Batch-scaling probe: larger per-chip batch lifts conv MFU on v5e."""
+    return measure_resnet50(batch=128, warmup_iters=3, bench_iters=15)
+
+
+def measure_resnet50_e2e_fit(batch: int = 128, n_images: int = 512,
+                             raw: int = 256, out: int = 224,
+                             bench_steps: int = 12) -> dict:
+    """End-to-end ResNet-50 training FROM FILES (VERDICT r4 ask 2's 'done'
+    row): ppm files on disk -> uint8 decode (header parse + frombuffer
+    views, zero per-pixel host math) -> async prefetch + device_put of raw
+    bytes -> jitted ON-DEVICE augment (random crop + flip + NCHW + f32/255)
+    -> ComputationGraph train step. Compare samples/sec against the
+    synthetic-data row: the gap is the real input-pipeline cost."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.data.image_transform import (
+        batch_random_crop, batch_random_flip,
+    )
+    from deeplearning4j_tpu.data.iterators import (
+        AsyncDataSetIterator, MappedDataSetIterator, device_put_dataset,
+    )
+    from deeplearning4j_tpu.data.records import (
+        ImageRecordReader, RecordReaderDataSetIterator,
+    )
+    from deeplearning4j_tpu.model.zoo import ResNet50
+    from deeplearning4j_tpu.train.graph_solver import GraphSolver
+
+    tmp = tempfile.mkdtemp(prefix="bench_e2e_")
+    try:
+        rng = np.random.RandomState(0)
+        header = f"P6 {raw} {raw} 255\n".encode()
+        n_classes = 8
+        for c in range(n_classes):
+            os.makedirs(os.path.join(tmp, f"c{c}"), exist_ok=True)
+        for i in range(n_images):
+            body = rng.randint(0, 256, (raw, raw, 3), np.uint8).tobytes()
+            with open(os.path.join(tmp, f"c{i % n_classes}", f"{i}.ppm"),
+                      "wb") as f:
+                f.write(header + body)
+
+        model = ResNet50(seed=42, num_classes=n_classes,
+                         compute_dtype="bfloat16").init()
+        solver = GraphSolver(model)
+        key = jax.random.PRNGKey(0)
+
+        def prep(features):  # [b, raw, raw, 3] u8 -> [b, 3, out, out] f32
+            x = jnp.transpose(jnp.asarray(features), (0, 3, 1, 2))
+            x = x.astype(jnp.float32) * (1.0 / 255.0)
+            x = batch_random_crop(x, key, out, out)
+            return batch_random_flip(x, key)
+
+        prep_j = jax.jit(prep)
+
+        def make_iter():
+            reader = ImageRecordReader(raw, raw, 3, root=tmp,
+                                       output_dtype="uint8")
+            base = RecordReaderDataSetIterator(
+                reader, batch_size=batch, label_index=1,
+                num_classes=n_classes)
+            return MappedDataSetIterator(
+                AsyncDataSetIterator(base, device_put_fn=device_put_dataset),
+                feature_fn=prep_j)
+
+        # warmup: compile prep + train step, warm the page cache
+        it = make_iter()
+        for i, ds in enumerate(it):
+            if ds.features.shape[0] != batch:
+                continue
+            solver.fit_batch((ds.features,), (ds.labels,))
+            if i >= 1:
+                break
+        _host_fence(model.params)
+
+        def block():
+            steps = 0
+            start = time.perf_counter()
+            while steps < bench_steps:
+                for ds in make_iter():
+                    if ds.features.shape[0] != batch:
+                        continue
+                    solver.fit_batch((ds.features,), (ds.labels,))
+                    steps += 1
+                    if steps >= bench_steps:
+                        break
+            _host_fence(model.params)
+            return time.perf_counter() - start
+
+        rate, spread = _median_rate(block, batch * bench_steps)
+
+        # H2D bandwidth probe: through the axon tunnel device_put moves
+        # ~55 MB/s (vs GB/s over local PCIe), so the from-files rate is
+        # TRANSFER-bound, not pipeline-bound — record the evidence and the
+        # projected rate were the transfer free (host decode + device
+        # compute overlap via the async iterator).
+        probe = np.random.RandomState(1).randint(
+            0, 256, (16 * 1024 * 1024,), np.uint8)
+        jax.device_put(probe)
+        bws = []
+        for _ in range(3):
+            start = time.perf_counter()
+            d = jax.device_put(np.ascontiguousarray(probe))
+            _host_fence(d)  # result-dependent: sums the transferred bytes
+            bws.append(16.0 / (time.perf_counter() - start))
+        h2d_mb_s = statistics.median(bws)
+        bytes_per_img = raw * raw * 3
+        transfer_s_per_img = bytes_per_img / (h2d_mb_s * 1e6)
+        compute_s_per_img = 1.0 / rate - transfer_s_per_img
+        return {
+            "samples_per_sec": rate, "spread": spread, "batch": batch,
+            "n_images": n_images, "raw_size": raw, "crop": out,
+            "h2d_bandwidth_mb_s": round(h2d_mb_s, 1),
+            "transfer_bound": transfer_s_per_img > 1.0 / max(rate, 1e-9) * 0.5,
+            "samples_per_sec_excl_transfer_wall": round(
+                1.0 / compute_s_per_img, 1) if compute_s_per_img > 1e-6
+            else None,
+            "pipeline": "ppm files -> u8 views -> async device_put -> "
+                        "on-device crop/flip/normalize (host touches no "
+                        "float pixel)",
+            "note": "through the axon tunnel, device_put sustains "
+                    "~55 MB/s — the from-files rate is H2D-transfer-bound "
+                    "(a remote-PJRT artifact); on a local-PCIe TPU host "
+                    "the same pipeline feeds the chip at full step rate "
+                    "(host side sustains >10k img/s, see input_pipeline)",
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def measure_bert(batch: int = 16, seq: int = 128, warmup_iters: int = 3,
-                 bench_iters: int = 20, compute_dtype: str = "bfloat16") -> dict:
+                 bench_iters: int = 20,
+                 compute_dtype: str = "bfloat16") -> dict:
     """BERT-base-shaped encoder train tokens/sec + MFU (BASELINE.md row 2)."""
     import jax
     import jax.numpy as jnp
@@ -164,38 +326,45 @@ def measure_bert(batch: int = 16, seq: int = 128, warmup_iters: int = 3,
     solver = GraphSolver(model)
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, bert.vocab_size, (batch, seq)), jnp.int32)
-    labels = jnp.asarray(rng.randint(0, bert.vocab_size, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, bert.vocab_size, (batch, seq)),
+                         jnp.int32)
 
     for _ in range(warmup_iters):
         solver.fit_batch((ids,), (labels,))
     _host_fence(model.params)
-    start = time.perf_counter()
-    for _ in range(bench_iters):
-        solver.fit_batch((ids,), (labels,))
-    _host_fence(model.params)
-    sec_per_step = (time.perf_counter() - start) / bench_iters
 
-    tokens_per_sec = batch * seq / sec_per_step
+    def block():
+        start = time.perf_counter()
+        for _ in range(bench_iters):
+            solver.fit_batch((ids,), (labels,))
+        _host_fence(model.params)
+        return time.perf_counter() - start
+
+    tokens_per_sec, spread = _median_rate(block, batch * seq * bench_iters)
     flops_per_tok = bert_train_flops_per_token(bert, seq)
     achieved = tokens_per_sec * flops_per_tok
     peak = chip_peak_flops(jax.devices()[0], compute_dtype)
     return {
         "tokens_per_sec": tokens_per_sec,
+        "spread": spread,
         "batch": batch,
         "seq": seq,
         "compute_dtype": compute_dtype,
-        "step_ms": sec_per_step * 1e3,
+        "step_ms": batch * seq / tokens_per_sec * 1e3,
         "model_tflops_per_sec": achieved / 1e12,
         "mfu": (achieved / peak) if peak else None,
     }
 
 
+def measure_bert_b64() -> dict:
+    """Batch-scaling probe: b=16 is dispatch/latency-bound on this chip."""
+    return measure_bert(batch=64, warmup_iters=2, bench_iters=10)
+
+
 def measure_lstm(batch: int = 32, seq: int = 200, vocab: int = 77,
                  hidden: int = 200, warmup_iters: int = 2,
                  bench_iters: int = 10) -> dict:
-    """GravesLSTM char-RNN train chars/sec (BASELINE.json:9: 'GravesLSTM
-    char-RNN, recurrent cuDNN helper -> XLA while_loop'). One-hot chars
-    [b, vocab, t], TBPTT-configured TextGenerationLSTM, host-fence timed."""
+    """GravesLSTM char-RNN train chars/sec (BASELINE.json:9)."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -214,40 +383,29 @@ def measure_lstm(batch: int = 32, seq: int = 200, vocab: int = 77,
     for _ in range(warmup_iters):
         solver.fit_batch(x, y)
     _host_fence(model.params)
-    start = time.perf_counter()
-    for _ in range(bench_iters):
-        solver.fit_batch(x, y)
-    _host_fence(model.params)
-    sec_per_step = (time.perf_counter() - start) / bench_iters
+
+    def block():
+        start = time.perf_counter()
+        for _ in range(bench_iters):
+            solver.fit_batch(x, y)
+        _host_fence(model.params)
+        return time.perf_counter() - start
+
+    rate, spread = _median_rate(block, batch * seq * bench_iters)
     return {
-        "chars_per_sec": batch * seq / sec_per_step,
+        "chars_per_sec": rate, "spread": spread,
         "batch": batch, "seq": seq, "vocab": vocab, "hidden": hidden,
-        "step_ms": sec_per_step * 1e3,
+        "step_ms": batch * seq / rate * 1e3,
         "model": "TextGenerationLSTM (GravesLSTM x2, peepholes, TBPTT 50)",
     }
 
 
-def measure_bert_import(batch: int = 16, seq: int = 128, warmup_iters: int = 2,
-                        bench_iters: int = 10, hidden: int = 768, layers: int = 12,
-                        heads: int = 12, vocab: int = 30522) -> dict:
-    """THE BASELINE.json:10 metric: BERT-base via SameDiff TF import,
-    full-graph HLO compile, inference tokens/sec. A random-initialized
-    TFBertModel is frozen in-process (no network), imported with
-    TFGraphMapper, compiled to ONE XLA program, and timed with the host
-    fence. This is the imported graph, not the native BertEncoder zoo model
-    (that one is the separate "bert" row)."""
-    import numpy as np
-
-    try:
-        import tensorflow as tf  # noqa: F401
-        from transformers import BertConfig, TFBertModel
-        from tensorflow.python.framework.convert_to_constants import (
-            convert_variables_to_constants_v2,
-        )
-    except Exception as e:  # pragma: no cover - env-dependent
-        return {"error": f"tf/transformers unavailable: {e}"}
-
-    from deeplearning4j_tpu.samediff.tf_import import TFGraphMapper
+def _frozen_bert(batch, seq, hidden, layers, heads, vocab):
+    import tensorflow as tf
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+    from transformers import BertConfig, TFBertModel
 
     cfg = BertConfig(
         vocab_size=vocab, hidden_size=hidden, num_hidden_layers=layers,
@@ -261,13 +419,31 @@ def measure_bert_import(batch: int = 16, seq: int = 128, warmup_iters: int = 2,
         return model(input_ids, training=False).last_hidden_state
 
     cf = fwd.get_concrete_function(tf.TensorSpec((batch, seq), tf.int32))
-    frozen = convert_variables_to_constants_v2(cf)
+    return convert_variables_to_constants_v2(cf)
+
+
+def measure_bert_import(batch: int = 16, seq: int = 128, warmup_iters: int = 2,
+                        bench_iters: int = 10, hidden: int = 768,
+                        layers: int = 12, heads: int = 12,
+                        vocab: int = 30522) -> dict:
+    """BASELINE.json:10, inference leg: BERT-base via SameDiff TF import,
+    full-graph HLO compile, inference tokens/sec."""
+    import numpy as np
+
+    try:
+        frozen = _frozen_bert(batch, seq, hidden, layers, heads, vocab)
+    except Exception as e:  # pragma: no cover - env-dependent
+        return {"error": f"tf/transformers unavailable: {e}"}
+
+    from deeplearning4j_tpu.samediff.tf_import import TFGraphMapper
+
     gd = frozen.graph.as_graph_def()
     in_name = frozen.inputs[0].name.split(":")[0]
     out_name = frozen.outputs[0].name.split(":")[0]
 
     sd = TFGraphMapper.import_graph(gd, outputs=[out_name])
-    ids = np.random.default_rng(0).integers(0, vocab, (batch, seq)).astype(np.int32)
+    ids = np.random.default_rng(0).integers(0, vocab, (batch, seq)).astype(
+        np.int32)
     compiled = sd.compile({in_name: ids}, [out_name])
     values = dict(sd._values)
 
@@ -278,26 +454,103 @@ def measure_bert_import(batch: int = 16, seq: int = 128, warmup_iters: int = 2,
     for _ in range(warmup_iters):
         out = step()
     _host_fence(out)
-    start = time.perf_counter()
-    for _ in range(bench_iters):
-        out = step()
-    _host_fence(out)
-    sec_per_step = (time.perf_counter() - start) / bench_iters
 
+    def block():
+        start = time.perf_counter()
+        o = None
+        for _ in range(bench_iters):
+            o = step()
+        _host_fence(o)
+        return time.perf_counter() - start
+
+    rate, spread = _median_rate(block, batch * seq * bench_iters)
     return {
-        "tokens_per_sec": batch * seq / sec_per_step,
-        "batch": batch, "seq": seq, "step_ms": sec_per_step * 1e3,
-        "model": f"TF-imported BERT-base (L={layers}, H={hidden}, vocab={vocab})",
+        "tokens_per_sec": rate, "spread": spread,
+        "batch": batch, "seq": seq,
+        "step_ms": batch * seq / rate * 1e3,
+        "model": f"TF-imported BERT-base (L={layers}, H={hidden}, "
+                 f"vocab={vocab})",
         "mode": "inference full-graph HLO",
     }
 
 
-def measure_input_pipeline(n_images: int = 256, height: int = 224,
-                           width: int = 224) -> dict:
-    """ImageNet-shaped input-path throughput (decode + augment + resize +
-    batch), host-side — the number to compare against the ResNet-50 device
-    step rate for the input-bound-vs-compute-bound statement
-    (SURVEY.md:124 'the ImageNet input path')."""
+def measure_bert_import_train(batch: int = 16, seq: int = 128,
+                              bench_iters: int = 16, hidden: int = 768,
+                              layers: int = 12, heads: int = 12,
+                              vocab: int = 30522) -> dict:
+    """THE literal BASELINE.json:10 metric (VERDICT r4 ask 4): SameDiff
+    BERT *training* via TF import — import the frozen graph, convert the
+    imported constants to trainable variables, attach a classification
+    head, and time ``sd.fit`` (one full-graph HLO train step: loss + grads
+    through all imported encoder weights + Adam). tokens/sec."""
+    import numpy as np
+
+    try:
+        frozen = _frozen_bert(batch, seq, hidden, layers, heads, vocab)
+    except Exception as e:  # pragma: no cover - env-dependent
+        return {"error": f"tf/transformers unavailable: {e}"}
+
+    from deeplearning4j_tpu.samediff import TrainingConfig
+    from deeplearning4j_tpu.samediff.tf_import import TFGraphMapper
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    gd = frozen.graph.as_graph_def()
+    in_name = frozen.inputs[0].name.split(":")[0]
+    out_name = frozen.outputs[0].name.split(":")[0]
+    sd = TFGraphMapper.import_graph(gd, outputs=[out_name])
+    converted = sd.convert_to_variables()
+
+    hidden_var = sd.get_variable(out_name)                # [b, t, h]
+    pooled = sd._op("reduce_mean", hidden_var, axis=[1])
+    w = sd.var("cls_W", shape=(hidden, 2))
+    logits = sd._op("matmul", pooled, w, name="logits")
+    labels = sd.placeholder("labels", dtype="float32")
+    loss = sd._op("softmax_cross_entropy", labels, logits)
+    sd._op("reduce_mean", loss, name="loss")
+    sd.set_loss_variables("loss")
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, (batch, seq)).astype(np.int32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, batch)]
+    cfg = TrainingConfig(
+        updater=Adam(1e-5),
+        data_set_feature_mapping=[in_name],
+        data_set_label_mapping=["labels"],
+    )
+    # warmup fit compiles the full train-step HLO
+    sd.fit([(ids, y)] * 2, cfg, epochs=1)
+    probe = max(converted, key=lambda n: sd._values[sd._names[n]].size)
+    _host_fence(sd._values[sd._names[probe]])
+
+    def block():
+        start = time.perf_counter()
+        sd.fit([(ids, y)] * bench_iters, cfg, epochs=1)
+        _host_fence(sd._values[sd._names[probe]])
+        return time.perf_counter() - start
+
+    rate, spread = _median_rate(block, batch * seq * bench_iters)
+    return {
+        "tokens_per_sec": rate, "spread": spread,
+        "batch": batch, "seq": seq,
+        "step_ms": batch * seq / rate * 1e3,
+        "trainable_imported_vars": len(converted),
+        "model": f"TF-imported BERT-base (L={layers}, H={hidden}) + cls head",
+        "mode": "training full-graph HLO (import -> convert_to_variables "
+                "-> sd.fit, Adam)",
+    }
+
+
+def measure_input_pipeline(n_images: int = 384, raw: int = 256,
+                           out: int = 224) -> dict:
+    """Host input-path throughput in its three modes (decode + augment +
+    batch; SURVEY.md:124 'the ImageNet input path'), each median-of-3:
+      * float32 host-augment — the reference-shaped path (full float math
+        on host);
+      * uint8 host-augment — geometric transforms as u8 views;
+      * uint8 passthrough — zero per-pixel host math; augmentation runs
+        on device (see resnet50_e2e_fit).
+    Compare against the device step rate to decide input- vs
+    compute-bound."""
     import shutil
     import tempfile
 
@@ -313,134 +566,304 @@ def measure_input_pipeline(n_images: int = 256, height: int = 224,
     tmp = tempfile.mkdtemp(prefix="bench_imgs_")
     try:
         rng = np.random.RandomState(0)
-        raw_h, raw_w = height + 32, width + 32
+        header = f"P6 {raw} {raw} 255\n".encode()
         for cls in ("a", "b"):
             os.makedirs(os.path.join(tmp, cls), exist_ok=True)
-        header = f"P6 {raw_w} {raw_h} 255\n".encode()
         for i in range(n_images):
-            body = rng.randint(0, 256, (raw_h, raw_w, 3), np.uint8).tobytes()
+            body = rng.randint(0, 256, (raw, raw, 3), np.uint8).tobytes()
             with open(os.path.join(tmp, "ab"[i % 2], f"{i}.ppm"), "wb") as f:
                 f.write(header + body)
 
-        aug = PipelineImageTransform(
-            (FlipImageTransform(mode=1), 0.5),
-            RandomCropTransform(height=height, width=width),
-        )
-        reader = ImageRecordReader(height, width, 3, root=tmp, transform=aug)
-        it = RecordReaderDataSetIterator(reader, batch_size=32, label_index=1,
-                                         num_classes=2)
-        start = time.perf_counter()
-        n_seen = 0
-        for ds in it:
-            n_seen += ds.features.shape[0]
-        took = time.perf_counter() - start
-        return {"images_per_sec": n_seen / took, "n_images": n_seen,
-                "shape": [height, width, 3],
-                "augmentation": "flip(p=0.5) + random_crop"}
+        def run_mode(output_dtype, augment, size):
+            aug = None
+            if augment:
+                aug = PipelineImageTransform(
+                    (FlipImageTransform(mode=1), 0.5),
+                    RandomCropTransform(height=size, width=size))
+            reader = ImageRecordReader(size, size, 3, root=tmp,
+                                       transform=aug,
+                                       output_dtype=output_dtype)
+            it = RecordReaderDataSetIterator(reader, batch_size=32,
+                                             label_index=1, num_classes=2)
+
+            def block():
+                start = time.perf_counter()
+                n = 0
+                for ds in it:
+                    n += ds.features.shape[0]
+                assert n == n_images
+                return time.perf_counter() - start
+
+            block()  # warm page cache
+            rate, spread = _median_rate(block, n_images)
+            return {"images_per_sec": round(rate, 1), "spread": spread}
+
+        return {
+            "float32_host_augment": run_mode("float32", True, out),
+            "uint8_host_augment": run_mode("uint8", True, out),
+            "uint8_passthrough": run_mode("uint8", False, raw),
+            "n_images": n_images, "raw_size": raw, "crop": out,
+            "host_workers_available": os.cpu_count(),
+            "augmentation": "flip(p=0.5) + random_crop (host modes); "
+                            "device-side for passthrough",
+        }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
-def measure_calibration(n: int = 4096, chain: int = 20, iters: int = 10) -> dict:
-    """Measured-peak calibration row + timer self-check.
+# ResNet-50's hot conv shape table, derived from the zoo graph (see
+# tools/dump_resnet_shapes or ROUND5_NOTES.md). Grouped by (spatial, kind);
+# weight_gflops = per-image forward FLOPs of ALL convs the group represents
+# (counts folded in). Sum = 7.712 GFLOP/img fwd conv — consistent with the
+# canonical 3.86 GMAC figure for ResNet-50 at 224.
+_RESNET_CONV_GROUPS = [
+    # (name, kind, hw, ci, co, k, stride, weight_gflops)
+    ("conv1_7x7s2", "accum", 224, 3, 64, 7, 2, 0.236),
+    ("s1_3x3_64@56", "chain", 56, 64, 64, 3, 1, 0.694),
+    ("s2_3x3_128@28", "chain", 28, 128, 128, 3, 1, 0.925),
+    ("s3_3x3_256@14", "chain", 14, 256, 256, 3, 1, 1.387),
+    ("s4_3x3_512@7", "chain", 7, 512, 512, 3, 1, 0.694),
+    ("s1_1x1_64-256@56", "pair", 56, 64, 256, 1, 1, 0.643),
+    ("s2_1x1_128-512@28", "pair", 28, 128, 512, 1, 1, 0.720),
+    ("s3_1x1_256-1024@14", "pair", 14, 256, 1024, 1, 1, 1.131),
+    ("s4_1x1_512-2048@7", "pair", 7, 512, 2048, 1, 1, 0.514),
+    ("ds_1x1s2@56", "accum", 56, 256, 512, 1, 2, 0.514),
+    ("ds_1x1s2@14", "accum", 14, 1024, 2048, 1, 2, 0.257),
+]
 
-    Times a jitted chain of ``chain`` n*n bf16 matmuls two ways:
-      * ``fence``  — ends with a host fetch of a result-dependent scalar
-        (the trustworthy method; see _host_fence);
-      * ``block``  — ends with jax.block_until_ready (broken under axon).
-    ``measured_peak_tflops`` (fence-timed) is what the chip+plugin actually
-    sustains on pure MXU work — the honest MFU denominator ceiling.
-    ``timer_disagreement`` = block-method TFLOP/s / fence TFLOP/s; >2x means
-    block_until_ready is not waiting and any block-timed number is invalid.
-    """
+
+def measure_calibration(n: int = 4096, chain: int = 100,
+                        conv_batch: int = 64, tiny: bool = False) -> dict:
+    """Measured-peak calibration + timer self-check + ResNet conv roofline.
+
+    Matmul peak: a fori_loop of n*n bf16 matmuls timed at ``chain`` and
+    ``2*chain`` iterations, rate from the two-point delta (median-of-3) —
+    the honest MXU ceiling for matmul-shaped work. ``timer_disagreement`` compares
+    block_until_ready against the host fence (>2x means block timing lies,
+    expected under axon).
+
+    Conv roofline (VERDICT r4 ask 1): each ResNet-50 hot-shape GROUP is
+    timed as a chained jit program (stride-1 same-channel convs feed
+    forward; expand/reduce 1x1s alternate in pairs; strided shapes use an
+    input-perturbation accumulation chain), median-of-3 per shape with
+    spread. ``conv_ceiling_tflops`` is the FLOPs-weighted harmonic mean —
+    the throughput a model would see if it ran ONLY these convs
+    back-to-back. The ResNet MFU gate divides by this ceiling, which by
+    construction the full train step cannot exceed (it adds backward,
+    BN/ReLU and optimizer work at no-better efficiency)."""
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     from deeplearning4j_tpu.bench.peak import chip_peak_flops
 
-    @jax.jit
-    def chain_fn(x):
-        for _ in range(chain):
-            x = (x @ x) * (1.0 / n)  # rescale so values stay finite
-        return x
+    if tiny:  # CPU fallback: shrink everything, record that we did
+        n, chain, conv_batch = 512, 4, 2
 
-    x = jnp.ones((n, n), jnp.bfloat16)
-    flops_per_call = 2.0 * n * n * n * chain
+    # TWO-POINT ASYMPTOTIC FIT (round-5 finding): every fenced dispatch
+    # through the axon tunnel costs a FIXED ~64 ms round-trip, so any
+    # single-length measurement understates the hardware rate (a fori-loop
+    # of 200 matmuls reads as 132 TF/s; 400 reads as 156; the slope says
+    # 191 — 97% of the 197 spec). Timing the same program at N and 2N
+    # iterations and dividing the flop delta by the time delta cancels the
+    # fixed cost exactly. Both the matmul peak and every conv shape use
+    # this estimator; ``fixed_dispatch_ms`` reports the intercept.
+    def asymptotic_rate(make_prog, flops_per_iter, n1=None, repeats=REPEATS,
+                        tiny_cfg=tiny):
+        """make_prog(n_iters) -> jitted fn(x)->y with ``example_input``;
+        returns (rate_flops_per_s, spread_dict, fixed_ms).
 
-    _host_fence(chain_fn(x))  # compile + drain the warmup execution itself
+        When ``n1`` is None, a pilot run sizes the base length so the
+        N-vs-2N time DELTA is ~80 ms of pure compute — large against the
+        few-ms run-to-run noise, so the pairwise quotients stay sane."""
+        if n1 is None:
+            n_p = max(8, int((2e8 if tiny_cfg else 5e11) / flops_per_iter))
+            pp = make_prog(n_p)
+            _host_fence(pp(pp.example_input))
+            start = time.perf_counter()
+            _host_fence(pp(pp.example_input))
+            t_p = time.perf_counter() - start
+            # subtract the ~64 ms fixed cost (conservatively floored)
+            rate_p = flops_per_iter * n_p / max(t_p - 0.055, t_p / 5)
+            target_s = 0.01 if tiny_cfg else 0.08
+            n1 = max(8, int(target_s * rate_p / flops_per_iter))
+        min_delta_s = 0.002 if tiny_cfg else 0.04
+        for attempt in range(3):
+            p1, p2 = make_prog(n1), make_prog(2 * n1)
+            t1s, t2s = [], []
+            for p, ts in ((p1, t1s), (p2, t2s)):
+                xin = p.example_input
+                _host_fence(p(xin))  # compile + drain
+                for _ in range(repeats):
+                    start = time.perf_counter()
+                    _host_fence(p(xin))
+                    ts.append(time.perf_counter() - start)
+            delta = statistics.median(t2s) - statistics.median(t1s)
+            if delta >= min_delta_s or attempt == 2:
+                break
+            # delta lost in dispatch noise: double the base length so the
+            # pairwise quotients are measuring compute, not jitter
+            # (n1 is what t1s/t2s were measured at — only grow BEFORE a
+            # remeasure, never after the last one)
+            n1 *= 2
+        d_flops = flops_per_iter * n1
+        rates = [d_flops / max(t2 - t1, 1e-9)
+                 for t1, t2 in zip(sorted(t1s), sorted(t2s))]
+        med = statistics.median(rates)
+        fixed_ms = (statistics.median(t1s)
+                    - flops_per_iter * n1 / med) * 1e3
+        return med, {
+            "min": round(min(rates) / 1e12, 2),
+            "max": round(max(rates) / 1e12, 2), "n": repeats,
+            "n_iter_base": n1,
+        }, round(fixed_ms, 1)
 
+    x_mm = jnp.ones((n, n), jnp.bfloat16)
+
+    def make_mm(iters):
+        fn = jax.jit(lambda x: lax.fori_loop(
+            0, iters, lambda i, x: (x @ x) * (1.0 / n), x))
+        fn.example_input = x_mm
+        return fn
+
+    mm_flops_iter = 2.0 * n * n * n
+    mm_rate, mm_spread, mm_fixed_ms = asymptotic_rate(
+        make_mm, mm_flops_iter, chain)
+
+    # block_until_ready comparison (single shot: it exists to prove the
+    # disagreement, not to be a measurement)
+    p = make_mm(chain)
+    _host_fence(p(x_mm))  # warm: exclude trace+compile from the probe
     start = time.perf_counter()
-    y = x
-    for _ in range(iters):
-        y = chain_fn(y)
-    _host_fence(y)
-    fence_s = time.perf_counter() - start
-
-    start = time.perf_counter()
-    y = x
-    for _ in range(iters):
-        y = chain_fn(y)
+    y = p(x_mm)
     jax.block_until_ready(y)
-    block_s = time.perf_counter() - start
-    _host_fence(y)  # drain whatever block_until_ready failed to wait for
-
-    fence_tflops = flops_per_call * iters / fence_s / 1e12
-    block_tflops = flops_per_call * iters / block_s / 1e12
-    peak = chip_peak_flops(jax.devices()[0], "bfloat16")
-
-    # conv roofline: XLA convs sustain far less than matmul on v5e through
-    # this plugin (~20-25 vs ~164 TFLOP/s measured in round 4), so conv
-    # models must be judged against the CONV ceiling, not the MXU one
-    from jax import lax
-    if n >= 4096:  # device config
-        cb, cc = 64, 256
-        conv_chain_n = 24  # big enough that the ~4 ms per-dispatch tunnel
-        # latency (measured round 4) is <20% of the call's compute time
-    else:  # CPU fallback: shrink with the same n knob the caller shrank
-        cb, cc = 4, 32
-        conv_chain_n = 4
-    cx = jnp.ones((cb, 14, 14, cc), jnp.bfloat16)
-    cw = jnp.ones((3, 3, cc, cc), jnp.bfloat16) * 0.01
-
-    @jax.jit
-    def conv_chain(x):
-        for _ in range(conv_chain_n):
-            x = lax.conv_general_dilated(
-                x, cw, (1, 1), "SAME",
-                dimension_numbers=("NHWC", "HWIO", "NHWC")) * 0.02
-        return x
-
-    _host_fence(conv_chain(cx))
-    start = time.perf_counter()
-    y = conv_chain(cx)
-    for _ in range(max(iters // 2, 1) - 1):
-        y = conv_chain(cx)
+    block_tflops = mm_flops_iter * chain / (time.perf_counter() - start) / 1e12
     _host_fence(y)
-    conv_s = (time.perf_counter() - start) / max(iters // 2, 1)
-    conv_flops = 2 * cb * 14 * 14 * 3 * 3 * cc * cc * conv_chain_n
 
+    # ---- conv roofline on ResNet-50's own shapes -----------------------
+    # Repetition runs ON DEVICE via lax.fori_loop (one dispatch, one
+    # fence): round-5 measurement found each dispatched call costs ~2 ms
+    # through the axon tunnel, so host-looped chains of 40 convs measured
+    # the OVERHEAD (24 TF/s) rather than the conv rate (~190 TF/s for the
+    # same shape once the loop moved on-device). Strided/channel-changing
+    # shapes pair the conv with its conv_transpose (the dgrad shape from
+    # training) to keep the loop carry static — the pair rate is what a
+    # train step actually sees for those layers.
+    def norm(key, shape):
+        return jax.random.normal(jax.random.PRNGKey(key), shape,
+                                 jnp.bfloat16) * 0.05
+
+    dn = ("NCHW", "OIHW", "NCHW")
+
+    def conv(x, w, s):
+        return lax.conv_general_dilated(
+            x, w, window_strides=(s, s), padding="SAME",
+            dimension_numbers=lax.conv_dimension_numbers(x.shape, w.shape,
+                                                         dn))
+
+    def convT(y, w, s):
+        # transposed conv (dgrad shape): kernel [ci, co, k, k] flipped use
+        return lax.conv_transpose(
+            y, w, strides=(s, s), padding="SAME",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    per_shape = {}
+    total_w = 0.0
+    total_time_per_gf = 0.0  # sum(weight_i / rate_i)
+    for name, kind, hw, ci, co, k, s, weight in _RESNET_CONV_GROUPS:
+        b = conv_batch
+        oh = -(-hw // s)
+        if kind == "chain":
+            w = norm(1, (ci, ci, k, k))
+            f_iter = 2.0 * b * oh * oh * k * k * ci * ci
+
+            def body(i, xx, w=w, s=s):
+                return conv(xx, w, s) * 0.05
+        elif kind == "pair":
+            w1 = norm(1, (co, ci, 1, 1))
+            w2 = norm(2, (ci, co, 1, 1))
+            f_iter = 2.0 * b * hw * hw * ci * co * 2
+
+            def body(i, xx, w1=w1, w2=w2):
+                return conv(conv(xx, w1, 1) * 0.05, w2, 1) * 0.05
+        else:  # strided/channel-changing: fwd conv + its dgrad transpose
+            w = norm(1, (co, ci, k, k))
+            wT = norm(2, (ci, co, k, k))  # transpose kernel: I = co
+            f_iter = 2.0 * b * oh * oh * k * k * ci * co * 2
+
+            def body(i, xx, w=w, wT=wT, s=s):
+                yy = conv(xx, w, s) * 0.05          # [b, co, oh, ow]
+                return convT(yy, wT, s) * 0.05      # back to [b, ci, hw, hw]
+        xin = norm(3, (b, ci, hw, hw))
+
+        def make_prog(iters, body=body, xin=xin):
+            fn = jax.jit(lambda xx: lax.fori_loop(0, iters, body, xx))
+            fn.example_input = xin
+            return fn
+
+        rate, spread, fixed_ms = asymptotic_rate(make_prog, f_iter)
+        tfl = rate / 1e12
+        per_shape[name] = {
+            "tflops": round(tfl, 2),
+            "spread_tflops": spread,
+            "weight_gflops_per_img": weight,
+            "fixed_dispatch_ms": fixed_ms,
+            "shape": f"b{b} {ci}->{co} k{k} s{s} @{hw}" + (
+                " (+conv_transpose dgrad pair)" if kind == "accum" else ""),
+        }
+        total_w += weight
+        total_time_per_gf += weight / max(tfl, 1e-9)
+
+    conv_ceiling = total_w / total_time_per_gf  # FLOPs-weighted harmonic
+
+    peak = chip_peak_flops(jax.devices()[0], "bfloat16")
     return {
-        "measured_peak_tflops": round(fence_tflops, 2),
-        "measured_conv_peak_tflops": round(conv_flops / conv_s / 1e12, 2),
+        "measured_peak_tflops": round(mm_rate / 1e12, 2),
+        "matmul_spread_tflops": mm_spread,
+        "fixed_dispatch_ms": mm_fixed_ms,
+        "estimator": "two-point asymptotic fit (N vs 2N fori_loop iters); "
+                     "cancels the ~64 ms fixed tunnel round-trip per "
+                     "fenced dispatch",
+        "conv_ceiling_tflops": round(conv_ceiling, 2),
+        "conv_per_shape": per_shape,
+        "conv_batch": conv_batch,
+        "conv_fwd_gflops_per_img": round(total_w, 3),
         "block_timed_tflops": round(block_tflops, 2),
-        "timer_disagreement": round(block_tflops / fence_tflops, 2),
+        "timer_disagreement": round(block_tflops / (mm_rate / 1e12), 2),
         "spec_peak_tflops": round(peak / 1e12, 1) if peak else None,
-        "matmul_n": n, "chain": chain, "iters": iters,
+        "matmul_n": n, "chain_base": chain,
+        "tiny_cpu_config": tiny,
     }
 
 
-def measure_resnet50_b128() -> dict:
-    """Batch-scaling probe: larger per-chip batch usually lifts conv MFU
-    on v5e (batch 64 measured 0.112 in round 4)."""
-    return measure_resnet50(batch=128, warmup_iters=3, bench_iters=15)
+def _timed_calls_ms(fn, args, n_iters, repeats: int = REPEATS):
+    """Median ms per call of ``fn(*args)`` over ``repeats`` fenced blocks
+    of ``n_iters`` queued calls each (single amortized fence per block).
+    Returns (median_ms, spread_ms_dict)."""
+    out = fn(*args)
+    _fence_tree(out)
+
+    def block():
+        start = time.perf_counter()
+        o = None
+        for _ in range(n_iters):
+            o = fn(*args)
+        _fence_tree(o)
+        return time.perf_counter() - start
+
+    rate, spread = _median_rate(block, n_iters)  # calls/sec
+    return 1e3 / rate, {"min_ms": round(1e3 / spread["max"], 2),
+                        "max_ms": round(1e3 / spread["min"], 2),
+                        "n": spread["n"]}
 
 
 def measure_flash_attention_8k(b: int = 1, h: int = 8, t: int = 8192,
-                               d: int = 64, iters: int = 10) -> dict:
-    """Long-context attention row (SURVEY §5.7): compiled Pallas flash
-    kernel vs the XLA dense reference at t=8192 bf16, both host-fenced.
-    This is where flash earns its keep — the dense path materializes the
-    [t, t] score matrix in HBM."""
+                               d: int = 64, iters: int = 8) -> dict:
+    """Long-context attention rows (SURVEY §5.7): compiled Pallas flash
+    kernel vs the XLA dense reference, forward and backward, median-of-3
+    with spread. Also times the backward at 16k/32k where the memory
+    story dominates (dense materializes t^2: ~2x slower at 16k and fails
+    to compile at 32k; flash is O(t*d))."""
     import jax
     import jax.numpy as jnp
 
@@ -449,80 +872,144 @@ def measure_flash_attention_8k(b: int = 1, h: int = 8, t: int = 8192,
 
     q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (b, h, t, d),
                                  jnp.bfloat16) for i in range(3))
-    flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, interpret=False))
-    dense = jax.jit(mha_attention_reference)
-    flash_c = jax.jit(
-        lambda q, k, v: flash_attention(q, k, v, causal=True,
-                                        interpret=False))
-    dense_c = jax.jit(
-        lambda q, k, v: mha_attention_reference(q, k, v, causal=True))
 
-    def timed(fn):
-        _host_fence(fn(q, k, v))
-        start = time.perf_counter()
-        out = None
-        for _ in range(iters):
-            out = fn(q, k, v)
-        _host_fence(out)
-        return (time.perf_counter() - start) / iters
+    def timed(fn, args, n_iters=iters):
+        return _timed_calls_ms(fn, args, n_iters)
 
-    t_flash, t_dense = timed(flash), timed(dense)
-    t_flash_c, t_dense_c = timed(flash_c), timed(dense_c)
-
-    # training path: gradient through the kernel (blockwise O(t*d) backward)
     def bwd(fn):
         return jax.jit(jax.grad(
             lambda q, k, v: jnp.sum(jnp.square(
                 fn(q, k, v).astype(jnp.float32))), argnums=(0, 1, 2)))
 
-    def timed_tree(fn):
-        def fence_tree(tree):
-            for leaf in jax.tree_util.tree_leaves(tree):
-                _host_fence(leaf)
-        fence_tree(fn(q, k, v))
-        start = time.perf_counter()
-        out = None
-        for _ in range(max(iters // 2, 2)):
-            out = fn(q, k, v)
-        fence_tree(out)
-        return (time.perf_counter() - start) / max(iters // 2, 2)
+    flash = jax.jit(lambda q, k, v: flash_attention(q, k, v,
+                                                    interpret=False))
+    dense = jax.jit(mha_attention_reference)
+    flash_c = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, interpret=False))
+    dense_c = jax.jit(lambda q, k, v: mha_attention_reference(
+        q, k, v, causal=True))
 
-    t_fb = timed_tree(bwd(lambda q, k, v: flash_attention(
-        q, k, v, interpret=False)))
-    t_db = timed_tree(bwd(mha_attention_reference))
+    rows = {"seq": t, "batch": b, "heads": h, "head_dim": d}
+    f_ms, f_sp = timed(flash, (q, k, v))
+    d_ms, d_sp = timed(dense, (q, k, v))
+    fc_ms, fc_sp = timed(flash_c, (q, k, v))
+    dc_ms, dc_sp = timed(dense_c, (q, k, v))
+    fb_ms, fb_sp = timed(bwd(lambda q, k, v: flash_attention(
+        q, k, v, interpret=False)), (q, k, v), max(iters // 2, 3))
+    db_ms, db_sp = timed(bwd(mha_attention_reference), (q, k, v),
+                         max(iters // 2, 3))
+    fcb_ms, fcb_sp = timed(bwd(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, interpret=False)), (q, k, v),
+        max(iters // 2, 3))
+    dcb_ms, dcb_sp = timed(bwd(lambda q, k, v: mha_attention_reference(
+        q, k, v, causal=True)), (q, k, v), max(iters // 2, 3))
+    rows.update({
+        "flash_ms": round(f_ms, 2), "flash_spread": f_sp,
+        "xla_dense_ms": round(d_ms, 2), "xla_spread": d_sp,
+        "speedup_vs_dense": round(d_ms / f_ms, 2),
+        "causal_flash_ms": round(fc_ms, 2), "causal_flash_spread": fc_sp,
+        "causal_xla_ms": round(dc_ms, 2), "causal_xla_spread": dc_sp,
+        "causal_speedup": round(dc_ms / fc_ms, 2),
+        "backward_flash_ms": round(fb_ms, 2), "backward_flash_spread": fb_sp,
+        "backward_xla_ms": round(db_ms, 2), "backward_xla_spread": db_sp,
+        "backward_speedup": round(db_ms / fb_ms, 2),
+        "causal_backward_flash_ms": round(fcb_ms, 2),
+        "causal_backward_xla_ms": round(dcb_ms, 2),
+        "causal_backward_speedup": round(dcb_ms / fcb_ms, 2),
+        "backward_impl": "Pallas dq+dkv kernels (bf16 operands, f32 "
+                         "accumulation, causal block skip)",
+    })
+
+    # long-context backward scaling: flash stays O(t*d); dense is O(t^2)
+    if t >= 8192:
+        long_rows = {}
+        for tl in (16384, 32768):
+            ql, kl, vl = (jax.random.normal(jax.random.PRNGKey(i),
+                                            (1, h, tl, d), jnp.bfloat16)
+                          for i in range(3))
+            fl_ms, fl_sp = timed(bwd(lambda q, k, v: flash_attention(
+                q, k, v, causal=True, interpret=False)), (ql, kl, vl), 2)
+            row = {"flash_causal_bwd_ms": round(fl_ms, 1),
+                   "flash_spread": fl_sp}
+            try:
+                dl_ms, _ = timed(bwd(lambda q, k, v: mha_attention_reference(
+                    q, k, v, causal=True)), (ql, kl, vl), 2)
+                row["dense_causal_bwd_ms"] = round(dl_ms, 1)
+                row["speedup"] = round(dl_ms / fl_ms, 2)
+            except Exception as e:
+                row["dense_causal_bwd_ms"] = None
+                row["dense_error"] = str(e)[:120]
+            long_rows[f"t{tl}"] = row
+        rows["long_context_backward"] = long_rows
+    return rows
+
+
+def measure_moe_dispatch(tokens: int = 8192, d: int = 768, experts: int = 8,
+                         top_k: int = 2, hidden: int = 1536,
+                         iters: int = 10) -> dict:
+    """MoE dispatch overhead (VERDICT r4 ask 10): one MixtureOfExperts
+    train step (fwd+bwd) vs a dense 2-layer FFN doing the SAME per-token
+    matmul FLOPs (dense hidden = top_k * expert hidden). The ratio is the
+    price of routing + one-hot dispatch/combine einsums."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.layers import MixtureOfExpertsLayer
+    from deeplearning4j_tpu.nn.layers.base import LayerContext
+
+    lay = MixtureOfExpertsLayer(
+        n_in=d, n_out=d, num_experts=experts, hidden=hidden, top_k=top_k,
+        capacity_factor=1.25)
+    params = lay.init(jax.random.PRNGKey(0), jnp.bfloat16)
+    state = lay.init_state(jnp.bfloat16)
+    x = jax.random.normal(jax.random.PRNGKey(1), (tokens, d), jnp.bfloat16)
+
+    def moe_loss(params, x):
+        y, _ = lay.apply(params, state, x, LayerContext())
+        return jnp.sum(jnp.square(y.astype(jnp.float32)))
+
+    moe_g = jax.jit(jax.grad(moe_loss))
+
+    dh = top_k * hidden
+    w1 = jax.random.normal(jax.random.PRNGKey(2), (d, dh), jnp.bfloat16) * .02
+    w2 = jax.random.normal(jax.random.PRNGKey(3), (dh, d), jnp.bfloat16) * .02
+
+    def dense_loss(ws, x):
+        w1, w2 = ws
+        y = jax.nn.relu(x @ w1) @ w2
+        return jnp.sum(jnp.square(y.astype(jnp.float32)))
+
+    dense_g = jax.jit(jax.grad(dense_loss))
+
+    moe_ms, moe_sp = _timed_calls_ms(moe_g, (params, x), iters)
+    dense_ms, dense_sp = _timed_calls_ms(dense_g, ((w1, w2), x), iters)
     return {
-        "seq": t, "batch": b, "heads": h, "head_dim": d,
-        "flash_ms": round(t_flash * 1e3, 2),
-        "xla_dense_ms": round(t_dense * 1e3, 2),
-        "speedup_vs_dense": round(t_dense / t_flash, 2),
-        "causal_flash_ms": round(t_flash_c * 1e3, 2),
-        "causal_xla_ms": round(t_dense_c * 1e3, 2),
-        "causal_speedup": round(t_dense_c / t_flash_c, 2),
-        "backward_flash_ms": round(t_fb * 1e3, 2),
-        "backward_xla_ms": round(t_db * 1e3, 2),
-        "backward_speedup": round(t_db / t_fb, 2),
+        "tokens": tokens, "d_model": d, "experts": experts, "top_k": top_k,
+        "expert_hidden": hidden,
+        "moe_grad_step_ms": round(moe_ms, 2),
+        "moe_spread_ms": moe_sp,
+        "dense_equal_flops_grad_step_ms": round(dense_ms, 2),
+        "dense_spread_ms": dense_sp,
+        "dispatch_overhead_ratio": round(moe_ms / dense_ms, 2),
+        "note": "dense hidden = top_k*expert_hidden so per-token matmul "
+                "FLOPs match; ratio > 1 is routing + dispatch/combine cost",
     }
-
-
-def measure_bert_b64() -> dict:
-    """Batch-scaling probe: b=16 is dispatch/latency-bound on this chip
-    (b=32 and b=64 take the SAME step time, measured round 4 — ~52 ms),
-    so b=64 roughly doubles tokens/sec to ~156k (~103 TFLOP/s, 0.63 of
-    the measured matmul peak)."""
-    return measure_bert(batch=64, warmup_iters=2, bench_iters=10)
 
 
 _MEASUREMENTS = {
     "lenet": measure_lenet,
     "resnet50": measure_resnet50,
     "resnet50_b128": measure_resnet50_b128,
+    "resnet50_e2e_fit": measure_resnet50_e2e_fit,
     "bert": measure_bert,
     "bert_b64": measure_bert_b64,
     "bert_import": measure_bert_import,
+    "bert_import_train": measure_bert_import_train,
     "lstm": measure_lstm,
     "calibration": measure_calibration,
     "input_pipeline": measure_input_pipeline,
     "flash_attention_8k": measure_flash_attention_8k,
+    "moe_dispatch": measure_moe_dispatch,
 }
 
 
@@ -551,7 +1038,8 @@ def _probe_tpu() -> dict:
                 if line.startswith("PLATFORM:"):
                     plat = line.split(":", 1)[1]
                     if plat not in ("cpu",):
-                        return {"ok": True, "platform": plat, "attempts": attempt + 1}
+                        return {"ok": True, "platform": plat,
+                                "attempts": attempt + 1}
                     last_err = f"probe resolved to {plat}, not a TPU"
             if not last_err:
                 last_err = (out.stderr or "no PLATFORM line").strip()[-500:]
@@ -562,7 +1050,8 @@ def _probe_tpu() -> dict:
 
 def _run_measurement(name: str, platform: str) -> dict:
     """Run one measurement in a child process; returns its JSON or an error."""
-    argv = [sys.executable, os.path.abspath(__file__), "measure", name, platform]
+    argv = [sys.executable, os.path.abspath(__file__), "measure", name,
+            platform]
     try:
         out = subprocess.run(
             argv, capture_output=True, text=True, timeout=MEASURE_TIMEOUT_S,
@@ -572,7 +1061,8 @@ def _run_measurement(name: str, platform: str) -> dict:
             line = line.strip()
             if line.startswith("{"):
                 return json.loads(line)
-        return {"error": (out.stderr or f"rc={out.returncode}, no JSON").strip()[-500:]}
+        return {"error": (out.stderr or f"rc={out.returncode}, no JSON"
+                          ).strip()[-500:]}
     except subprocess.TimeoutExpired:
         return {"error": f"measurement timed out after {MEASURE_TIMEOUT_S}s"}
 
@@ -582,10 +1072,9 @@ def _child_measure(name: str, platform: str) -> None:
         _force_cpu_inprocess()
     kwargs = {}
     if platform == "cpu":
-        # Host CPU baseline (this box: ONE core, ~50 GFLOP/s): shrink batch +
-        # iters so the denominator finishes inside the timeout, and use f32
-        # (CPUs emulate bf16 — it would understate the baseline). Throughput
-        # is normalized per sample/token, so the ratio stays comparable.
+        # Host CPU baseline (this box: ONE core, ~50 GFLOP/s): shrink batch
+        # + iters so the denominator finishes inside the timeout, and use
+        # f32 (CPUs emulate bf16). Throughput normalizes per sample/token.
         kwargs = {
             "resnet50": {"batch": 8, "warmup_iters": 1, "bench_iters": 2,
                          "compute_dtype": "float32"},
@@ -595,10 +1084,17 @@ def _child_measure(name: str, platform: str) -> None:
             "bert_import": {"batch": 2, "seq": 32, "warmup_iters": 1,
                             "bench_iters": 2, "hidden": 128, "layers": 2,
                             "heads": 2, "vocab": 2000},
-            "calibration": {"n": 1024, "chain": 4, "iters": 2},
+            "bert_import_train": {"batch": 2, "seq": 16, "bench_iters": 2,
+                                  "hidden": 64, "layers": 2, "heads": 2,
+                                  "vocab": 500},
+            "calibration": {"tiny": True},
             "input_pipeline": {"n_images": 64},
             "lstm": {"batch": 4, "seq": 50, "warmup_iters": 1,
                      "bench_iters": 2},
+            "resnet50_e2e_fit": {"batch": 8, "n_images": 32, "raw": 64,
+                                 "out": 56, "bench_steps": 3},
+            "moe_dispatch": {"tokens": 256, "d": 64, "hidden": 128,
+                             "iters": 2},
         }.get(name, {})
     result = _MEASUREMENTS[name](**kwargs)
     print(json.dumps(result))
@@ -606,7 +1102,8 @@ def _child_measure(name: str, platform: str) -> None:
 
 def main() -> None:
     if len(sys.argv) > 2 and sys.argv[1] == "measure":
-        _child_measure(sys.argv[2], sys.argv[3] if len(sys.argv) > 3 else "tpu")
+        _child_measure(sys.argv[2], sys.argv[3] if len(sys.argv) > 3
+                       else "tpu")
         return
 
     probe = _probe_tpu()
@@ -614,8 +1111,8 @@ def main() -> None:
     platform = probe.get("platform", "cpu") if probe["ok"] else "cpu"
     diagnostics = {} if probe["ok"] else {"tpu_probe_error": probe["error"]}
 
-    # calibration first: it is cheap, validates the timer, and gives the
-    # measured-peak MFU denominator for everything that follows
+    # calibration first: cheap, validates the timer, and yields the
+    # measured matmul peak + conv ceiling MFU denominators
     calibration = _run_measurement("calibration", platform)
     if "error" in calibration and not fallback:
         diagnostics["tpu_calibration_error"] = calibration["error"]
@@ -625,70 +1122,79 @@ def main() -> None:
 
     device = _run_measurement("resnet50", platform)
     if "error" in device and not fallback:
-        # chip passed the probe but died mid-bench: fall back BEFORE the
-        # extras so a dead chip doesn't cost extra child timeouts, and the
-        # artifact still parses
         diagnostics["tpu_bench_error"] = device["error"]
         fallback = True
         platform = "cpu"
         device = _run_measurement("resnet50", "cpu")
-        # the TPU-measured calibration peak must not denominate CPU rows
         calibration = _run_measurement("calibration", "cpu")
 
-    # extras run on the platform that actually worked
     extras = {
         "bert": _run_measurement("bert", platform),
         "bert_tf_import": _run_measurement("bert_import", platform),
+        "bert_tf_import_train": _run_measurement("bert_import_train",
+                                                 platform),
         "lstm_char_rnn": _run_measurement("lstm", platform),
         "lenet_smoke": _run_measurement("lenet", platform),
         "calibration": calibration,
         "input_pipeline": _run_measurement("input_pipeline", platform),
+        "resnet50_e2e_fit": _run_measurement("resnet50_e2e_fit", platform),
     }
-    if not fallback:  # chip-only rows: batch scaling + long-context kernel
+    if not fallback:  # chip-only rows
         extras["resnet50_b128"] = _run_measurement("resnet50_b128", platform)
         extras["bert_b64"] = _run_measurement("bert_b64", platform)
         extras["flash_attention_8k"] = _run_measurement(
             "flash_attention_8k", platform)
+        extras["moe_dispatch"] = _run_measurement("moe_dispatch", platform)
 
-    # input-bound vs compute-bound: one host input pipeline vs the device
-    # step rate (SURVEY.md:124). > 1 means the single-threaded host path
-    # keeps up; < 1 quantifies how many parallel input workers are needed.
+    # input-bound vs compute-bound (VERDICT r4 ask 2): compare each host
+    # pipeline mode and the e2e-from-files fit against the device step rate
     ipl = extras["input_pipeline"]
-    if ipl.get("images_per_sec") and device.get("samples_per_sec"):
-        ipl["vs_resnet50_step"] = round(
-            ipl["images_per_sec"] / device["samples_per_sec"], 4)
+    dev_rate = (extras.get("resnet50_b128") or device).get("samples_per_sec") \
+        or device.get("samples_per_sec")
+    if dev_rate:
+        for mode in ("float32_host_augment", "uint8_host_augment",
+                     "uint8_passthrough"):
+            row = ipl.get(mode)
+            if isinstance(row, dict) and row.get("images_per_sec"):
+                row["vs_device_step"] = round(
+                    row["images_per_sec"] / dev_rate, 2)
+        e2e = extras.get("resnet50_e2e_fit", {})
+        if e2e.get("samples_per_sec"):
+            e2e["vs_synthetic_step"] = round(
+                e2e["samples_per_sec"] / dev_rate, 4)
 
     measured_peak = calibration.get("measured_peak_tflops")
-    conv_peak = calibration.get("measured_conv_peak_tflops")
-    for row in (device, extras["bert"], extras.get("resnet50_b128", {})):
+    conv_ceiling = calibration.get("conv_ceiling_tflops")
+    for row in (device, extras["bert"], extras.get("resnet50_b128", {}),
+                extras.get("bert_b64", {})):
         if row.get("model_tflops_per_sec") and measured_peak:
             row["mfu_vs_measured_peak"] = round(
                 row["model_tflops_per_sec"] / measured_peak, 4)
-    # conv models against the conv roofline (the achievable ceiling for
-    # conv work on this chip+plugin — see calibration docstring)
     for row in (device, extras.get("resnet50_b128", {})):
-        if row.get("model_tflops_per_sec") and conv_peak:
-            row["mfu_vs_conv_peak"] = round(
-                row["model_tflops_per_sec"] / conv_peak, 4)
+        if row.get("model_tflops_per_sec") and conv_ceiling:
+            row["mfu_vs_conv_ceiling"] = round(
+                row["model_tflops_per_sec"] / conv_ceiling, 4)
 
-    # timer self-check (VERDICT round 3 ask 1): MFU > 1 is physically
-    # impossible; >0.9 or a block-vs-fence disagreement >2x on the
-    # calibration matmul means the timing cannot be trusted
+    # timer self-checks on MEDIANS (VERDICT r4 ask 3)
     suspect = []
     for label, row in (("resnet50", device), ("bert", extras["bert"]),
-                       ("resnet50_b128", extras.get("resnet50_b128", {}))):
+                       ("resnet50_b128", extras.get("resnet50_b128", {})),
+                       ("bert_b64", extras.get("bert_b64", {}))):
         if row.get("mfu") and row["mfu"] > 0.9:
             suspect.append(f"{label} mfu={row['mfu']:.3f} > 0.9")
-    if calibration.get("timer_disagreement") and calibration["timer_disagreement"] > 2.0:
+    for label, row in (("resnet50", device),
+                       ("resnet50_b128", extras.get("resnet50_b128", {}))):
+        if row.get("mfu_vs_conv_ceiling") and row["mfu_vs_conv_ceiling"] > 1.0:
+            suspect.append(
+                f"{label} above conv ceiling "
+                f"({row['mfu_vs_conv_ceiling']:.2f}) — calibration broken")
+    if calibration.get("timer_disagreement") \
+            and calibration["timer_disagreement"] > 2.0:
         suspect.append(
-            f"block_until_ready vs host-fence disagree {calibration['timer_disagreement']}x "
-            "on calibration matmul (expected under axon; fence timing is authoritative)")
+            f"block_until_ready vs host-fence disagree "
+            f"{calibration['timer_disagreement']}x on calibration matmul "
+            "(expected under axon; fence timing is authoritative)")
 
-    # vs_baseline: same-metric CPU run. The denominator is a DIFFERENT
-    # config (batch 8, f32 — one slow host core can't run batch-64 bf16),
-    # so it is a cross-hardware indication, not a controlled comparison;
-    # baseline_config records exactly what was compared. Null (never a
-    # fake 1.0) when the baseline is missing or the device run fell back.
     value = device.get("samples_per_sec")
     vs_baseline = None
     baseline_config = None
@@ -716,15 +1222,15 @@ def main() -> None:
         "platform": "cpu-fallback" if fallback else platform,
         "mfu": round(device["mfu"], 4) if device.get("mfu") else None,
         "mfu_vs_measured_peak": device.get("mfu_vs_measured_peak"),
+        "mfu_vs_conv_ceiling": device.get("mfu_vs_conv_ceiling"),
         "timing_method": "host-fence (D2H scalar fetch; block_until_ready "
-                         "is a no-op under axon — see calibration row)",
+                         "is a no-op under axon — see calibration row); "
+                         f"every row = median of {REPEATS} with spread",
         "extras": extras,
     }
     if suspect:
-        # MFU>0.9 on a *model* bench means the timer lied; calibration
-        # disagreement alone is expected (that row exists to prove it) and
-        # only taints block-timed numbers, of which there are none left
-        result["timing_suspect"] = any("mfu" in s for s in suspect)
+        result["timing_suspect"] = any(
+            "mfu" in s or "ceiling" in s for s in suspect)
         result["timing_notes"] = suspect
     if diagnostics:
         result["diagnostics"] = diagnostics

@@ -10,10 +10,15 @@ from __future__ import annotations
 
 
 def resnet50_train_flops_per_example(height: int = 224, width: int = 224) -> float:
-    """ResNet-50 v1 at 224x224: 4.09 GFLOPs forward (2x MAC counting; the
-    widely used torchvision/fvcore figure is 4.09e9 for this architecture).
-    Scales with spatial area for other input sizes. Train = 3x forward."""
-    forward = 4.09e9 * (height * width) / (224.0 * 224.0)
+    """ResNet-50 v1 at 224x224: 7.75 GFLOPs forward at the file's stated
+    2-FLOPs-per-MAC convention — 7.712 GF of convolutions (summed exactly
+    over the zoo graph's conv shapes, = 3.86 GMACs) plus the fc layer and
+    change. The widely quoted torchvision/fvcore "4.09 GFLOPs" counts
+    MACs, i.e. HALF this convention; rounds 1-4 used it directly, which
+    undercounted achieved TFLOP/s and MFU by ~1.9x (fixed round 5 — see
+    ROUND5_NOTES.md). Scales with spatial area for other input sizes.
+    Train = 3x forward."""
+    forward = 7.75e9 * (height * width) / (224.0 * 224.0)
     return 3.0 * forward
 
 

@@ -233,18 +233,41 @@ class ImageRecordReader(RecordReader):
         if path.lower().endswith(self.NETPBM_EXTENSIONS):
             with open(path, "rb") as f:
                 buf = f.read()
-            # P5/P6 header: magic, width, height, maxval, single whitespace
-            parts = buf.split(maxsplit=4)
-            if len(parts) < 5 or parts[0] not in (b"P5", b"P6"):
+            # FRONT-anchored P5/P6 header parse (magic, width, height,
+            # maxval, ONE whitespace byte, raster), '#' comments skipped —
+            # matching the native decode_netpbm parser. Back-anchored
+            # slicing would silently shift pixels on files with trailing
+            # bytes after the raster.
+            if buf[:2] not in (b"P5", b"P6"):
                 raise ValueError(f"{path}: not a binary netpbm (P5/P6)")
-            w, h = int(parts[1]), int(parts[2])
-            if int(parts[3]) > 255:
+            c = 3 if buf[:2] == b"P6" else 1
+            pos = 2
+            fields = []
+            while len(fields) < 3:
+                while pos < len(buf) and buf[pos:pos + 1].isspace():
+                    pos += 1
+                if buf[pos:pos + 1] == b"#":  # comment to end of line
+                    while pos < len(buf) and buf[pos] not in (0x0A, 0x0D):
+                        pos += 1
+                    continue
+                start = pos
+                while pos < len(buf) and not buf[pos:pos + 1].isspace():
+                    pos += 1
+                fields.append(int(buf[start:pos]))
+            pos += 1  # exactly one whitespace byte separates maxval/raster
+            w, h, maxval = fields
+            if maxval > 255:
                 raise ValueError(
-                    f"{path}: 16-bit netpbm (maxval {int(parts[3])}) "
-                    "unsupported on the uint8 fast path")
-            c = 3 if parts[0] == b"P6" else 1
-            data = buf[len(buf) - h * w * c:]
-            return np.frombuffer(data, np.uint8).reshape(h, w, c)
+                    f"{path}: 16-bit netpbm (maxval {maxval}) unsupported "
+                    "on the uint8 fast path")
+            data = buf[pos: pos + h * w * c]
+            if len(data) != h * w * c:
+                raise ValueError(f"{path}: truncated netpbm raster")
+            arr = np.frombuffer(data, np.uint8).reshape(h, w, c)
+            if maxval != 255:
+                # rescale to the full byte range like the float path does
+                arr = (arr.astype(np.uint16) * 255 // maxval).astype(np.uint8)
+            return arr
         Image = _pil()
         if Image is None:
             raise ValueError(f"{path}: only netpbm decodable without Pillow")

@@ -299,3 +299,33 @@ def test_record_iterator_multi_epoch_reset(tmp_path):
         n += it.next().features.shape[0]
     assert n == 24  # 8 images x 3 epochs
     assert it.batch_size() == 4
+
+
+def test_uint8_netpbm_parser_comments_maxval_trailing(tmp_path):
+    """The u8 fast-path netpbm parser must match the native float parser's
+    front-anchored semantics: '#' comments, maxval rescale, and files with
+    trailing bytes after the raster."""
+    from deeplearning4j_tpu.data.records import ImageRecordReader
+
+    rng = np.random.RandomState(0)
+    px = rng.randint(0, 256, (8, 8, 3), np.uint8)
+    (tmp_path / "a").mkdir()
+    # comment line + trailing newline after raster
+    body = b"P6\n# a comment\n8 8\n255\n" + px.tobytes() + b"\n"
+    (tmp_path / "a" / "x.ppm").write_bytes(body)
+    r = ImageRecordReader(8, 8, 3, root=str(tmp_path), output_dtype="uint8")
+    got = next(iter(r))[0]
+    np.testing.assert_array_equal(got, px)
+    # maxval 127 rescales to the full byte range
+    px7 = (px // 2).astype(np.uint8)
+    (tmp_path / "a" / "x.ppm").write_bytes(
+        b"P6 8 8 127\n" + px7.tobytes())
+    r2 = ImageRecordReader(8, 8, 3, root=str(tmp_path), output_dtype="uint8")
+    got2 = next(iter(r2))[0]
+    assert got2.max() > 200  # rescaled toward 255
+    # 16-bit rejected loudly
+    (tmp_path / "a" / "x.ppm").write_bytes(
+        b"P6 8 8 65535\n" + (b"\0" * (8 * 8 * 3 * 2)))
+    r3 = ImageRecordReader(8, 8, 3, root=str(tmp_path), output_dtype="uint8")
+    with pytest.raises(ValueError, match="16-bit"):
+        next(iter(r3))
