@@ -242,14 +242,15 @@ def measure_resnet50_e2e_fit(batch: int = 128, n_images: int = 512,
                 AsyncDataSetIterator(base, device_put_fn=device_put_dataset),
                 feature_fn=prep_j)
 
-        # warmup: compile prep + train step, warm the page cache
-        it = make_iter()
-        for i, ds in enumerate(it):
-            if ds.features.shape[0] != batch:
-                continue
-            solver.fit_batch((ds.features,), (ds.labels,))
-            if i >= 1:
-                break
+        # warmup: compile prep + train step, warm the page cache; consume
+        # the FULL pass so the async worker's in-flight device_put
+        # transfers (H2D is the wall here) finish before the clock starts
+        trained = 0
+        for ds in make_iter():
+            if ds.features.shape[0] == batch and trained < 2:
+                solver.fit_batch((ds.features,), (ds.labels,))
+                trained += 1
+            _host_fence(ds.features)  # wait out the prefetched transfer
         _host_fence(model.params)
 
         def block():
@@ -274,7 +275,7 @@ def measure_resnet50_e2e_fit(batch: int = 128, n_images: int = 512,
         # projected rate were the transfer free (host decode + device
         # compute overlap via the async iterator).
         probe = np.random.RandomState(1).randint(
-            0, 256, (16 * 1024 * 1024,), np.uint8)
+            0, 256, (16_000_000,), np.uint8)  # 16 MB exactly (not MiB)
         jax.device_put(probe)
         bws = []
         for _ in range(3):
@@ -707,14 +708,20 @@ def measure_calibration(n: int = 4096, chain: int = 100,
             # remeasure, never after the last one)
             n1 *= 2
         d_flops = flops_per_iter * n1
-        rates = [d_flops / max(t2 - t1, 1e-9)
-                 for t1, t2 in zip(sorted(t1s), sorted(t2s))]
-        med = statistics.median(rates)
-        fixed_ms = (statistics.median(t1s)
-                    - flops_per_iter * n1 / med) * 1e3
+        delta_med = max(statistics.median(t2s) - statistics.median(t1s),
+                        1e-9)
+        med = d_flops / delta_med  # value from the MEDIAN delta
+        # spread from pairwise quotients, excluding pairs whose delta
+        # collapsed into timing noise (< 40% of the median delta) — those
+        # produce physically impossible rates, not information
+        deltas = [t2 - t1 for t1, t2 in zip(sorted(t1s), sorted(t2s))]
+        good = [d for d in deltas if d > 0.4 * delta_med]
+        rates = [d_flops / d for d in (good or [delta_med])]
+        fixed_ms = (statistics.median(t1s) - d_flops / med) * 1e3
         return med, {
             "min": round(min(rates) / 1e12, 2),
             "max": round(max(rates) / 1e12, 2), "n": repeats,
+            "n_pairs_used": len(good or [delta_med]),
             "n_iter_base": n1,
         }, round(fixed_ms, 1)
 

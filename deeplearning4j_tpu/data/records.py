@@ -233,29 +233,14 @@ class ImageRecordReader(RecordReader):
         if path.lower().endswith(self.NETPBM_EXTENSIONS):
             with open(path, "rb") as f:
                 buf = f.read()
-            # FRONT-anchored P5/P6 header parse (magic, width, height,
-            # maxval, ONE whitespace byte, raster), '#' comments skipped —
-            # matching the native decode_netpbm parser. Back-anchored
-            # slicing would silently shift pixels on files with trailing
-            # bytes after the raster.
-            if buf[:2] not in (b"P5", b"P6"):
+            # shared front-anchored header parse (native.py) — same
+            # semantics as the float decoder: '#' comments, exactly one
+            # whitespace byte before the raster; back-anchored slicing
+            # would silently shift pixels on trailing-byte files
+            try:
+                w, h, c, maxval, pos = native.parse_netpbm_header(buf)
+            except ValueError:
                 raise ValueError(f"{path}: not a binary netpbm (P5/P6)")
-            c = 3 if buf[:2] == b"P6" else 1
-            pos = 2
-            fields = []
-            while len(fields) < 3:
-                while pos < len(buf) and buf[pos:pos + 1].isspace():
-                    pos += 1
-                if buf[pos:pos + 1] == b"#":  # comment to end of line
-                    while pos < len(buf) and buf[pos] not in (0x0A, 0x0D):
-                        pos += 1
-                    continue
-                start = pos
-                while pos < len(buf) and not buf[pos:pos + 1].isspace():
-                    pos += 1
-                fields.append(int(buf[start:pos]))
-            pos += 1  # exactly one whitespace byte separates maxval/raster
-            w, h, maxval = fields
             if maxval > 255:
                 raise ValueError(
                     f"{path}: 16-bit netpbm (maxval {maxval}) unsupported "

@@ -164,8 +164,12 @@ class _Shape:
     conventions (conv: h/w/c over NCHW; rnn: features/timesteps)."""
 
     def __init__(self, input_shape: Tuple[Optional[int], ...]) -> None:
-        # keras input_shape excludes batch: (h, w, c) or (t, f) or (n,)
-        if len(input_shape) == 3:
+        # keras input_shape excludes batch: (d, h, w, c), (h, w, c),
+        # (t, f) or (n,)
+        if len(input_shape) == 4:
+            self.kind = "conv3d"
+            self.d, self.h, self.w, self.c = input_shape
+        elif len(input_shape) == 3:
             self.kind = "conv"
             self.h, self.w, self.c = input_shape
         elif len(input_shape) == 2:
@@ -725,6 +729,53 @@ class _SequentialImporter:
         self._add(ActivationLayer(name=conf["name"],
                                   activation=Activation.ELU,
                                   alpha=float(conf.get("alpha", 1.0))))
+
+    def _import_Conv3D(self, conf):
+        s = self.shape
+        if s.kind != "conv3d":
+            raise KerasImportError(
+                "Conv3D expects [batch, d, h, w, c] input")
+        if conf.get("data_format") not in (None, "channels_last"):
+            raise KerasImportError("only channels_last Keras models supported")
+        if conf.get("groups", 1) != 1:
+            raise KerasImportError("grouped Conv3D unsupported")
+        from ..nn.layers import Convolution3DLayer
+
+        mode = _pad_mode(conf.get("padding", "valid"))
+        kd, kh, kw = conf["kernel_size"]
+        sd_, sh, sw = conf.get("strides", (1, 1, 1))
+        dd, dh, dw = conf.get("dilation_rate", (1, 1, 1))
+        w = self._weights(conf)
+        # keras [kd, kh, kw, in, out] -> ours [out, in, kd, kh, kw]
+        params = {"W": w["kernel"].transpose(4, 3, 0, 1, 2)}
+        if conf.get("use_bias", True):
+            params["b"] = w["bias"]
+        self._add(Convolution3DLayer(
+            name=conf["name"], n_in=int(s.c), n_out=int(conf["filters"]),
+            kernel_size=(kd, kh, kw), stride=(sd_, sh, sw),
+            dilation=(dd, dh, dw), convolution_mode=mode,
+            activation=_map_activation(conf.get("activation")),
+            has_bias=conf.get("use_bias", True)), params)
+        s.d = _conv_out(s.d, kd, sd_, mode, dd)
+        s.h = _conv_out(s.h, kh, sh, mode, dh)
+        s.w = _conv_out(s.w, kw, sw, mode, dw)
+        s.c = conf["filters"]
+
+    def _import_GlobalAveragePooling3D(self, conf):
+        s = self.shape
+        if s.kind != "conv3d":
+            raise KerasImportError("GlobalAveragePooling3D needs 5D input")
+        self._add(GlobalPoolingLayer(name=conf["name"],
+                                     pooling_type=PoolingType.AVG))
+        s.kind, s.n = "ff", s.c
+
+    def _import_GlobalMaxPooling3D(self, conf):
+        s = self.shape
+        if s.kind != "conv3d":
+            raise KerasImportError("GlobalMaxPooling3D needs 5D input")
+        self._add(GlobalPoolingLayer(name=conf["name"],
+                                     pooling_type=PoolingType.MAX))
+        s.kind, s.n = "ff", s.c
 
     def _import_PReLU(self, conf):
         s = self.shape

@@ -318,6 +318,33 @@ def parse_idx(buf: bytes, scale: float = 1.0) -> np.ndarray:
     return (data.astype(np.float32) * scale).reshape(dims)
 
 
+def parse_netpbm_header(buf: bytes):
+    """Front-anchored P5/P6 header parse shared by the float decoder's
+    numpy fallback and the uint8 fast path (data.records): returns
+    (width, height, channels, maxval, raster_offset). Handles '#'
+    comments (to LF or CR) and enforces the single whitespace byte
+    between maxval and the raster."""
+    if not buf.startswith(b"P5") and not buf.startswith(b"P6"):
+        raise ValueError("bad netpbm data (code -1)")
+    channels = 1 if buf[:2] == b"P5" else 3
+    pos = 2
+    fields = []
+    while len(fields) < 3:
+        while pos < len(buf) and buf[pos:pos + 1].isspace():
+            pos += 1
+        if buf[pos:pos + 1] == b"#":
+            while pos < len(buf) and buf[pos] not in (0x0A, 0x0D):
+                pos += 1
+            continue
+        start = pos
+        while pos < len(buf) and not buf[pos:pos + 1].isspace():
+            pos += 1
+        fields.append(int(buf[start:pos]))
+    pos += 1  # single whitespace after maxval
+    w, h, maxval = fields
+    return w, h, channels, maxval, pos
+
+
 def decode_netpbm(buf: bytes) -> np.ndarray:
     """Decode P5 (gray) / P6 (RGB) netpbm into float32 HWC in [0, 1]."""
     raw = np.frombuffer(buf, np.uint8)
@@ -337,24 +364,7 @@ def decode_netpbm(buf: bytes) -> np.ndarray:
                                ctypes.byref(c))
         return out.reshape(h.value, w.value, c.value)
     # numpy fallback
-    if not buf.startswith(b"P5") and not buf.startswith(b"P6"):
-        raise ValueError("bad netpbm data (code -1)")
-    channels = 1 if buf[:2] == b"P5" else 3
-    pos = 2
-    fields = []
-    while len(fields) < 3:
-        while pos < len(buf) and buf[pos:pos + 1].isspace():
-            pos += 1
-        if buf[pos:pos + 1] == b"#":
-            while pos < len(buf) and buf[pos:pos + 1] != b"\n":
-                pos += 1
-            continue
-        start = pos
-        while pos < len(buf) and not buf[pos:pos + 1].isspace():
-            pos += 1
-        fields.append(int(buf[start:pos]))
-    pos += 1  # single whitespace after maxval
-    w, h, maxval = fields
+    w, h, channels, maxval, pos = parse_netpbm_header(buf)
     if maxval <= 0 or maxval > 255:  # 16-bit netpbm unsupported (as in C)
         raise ValueError("bad netpbm data (code -1)")
     total = h * w * channels

@@ -501,3 +501,17 @@ def test_functional_gru_and_upsampling_import(tmp_path):
     got = np.asarray(ours.output(xi.transpose(0, 3, 1, 2),
                                  xs.transpose(0, 2, 1)))
     np.testing.assert_allclose(got, expected, atol=1e-4, rtol=1e-3)
+
+
+def test_conv3d_import(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((6, 7, 8, 2)),  # (d, h, w, c)
+        keras.layers.Conv3D(4, 3, padding="same", activation="relu"),
+        keras.layers.Conv3D(3, (2, 3, 3), padding="valid",
+                            strides=(1, 2, 2)),
+        keras.layers.GlobalAveragePooling3D(),
+        keras.layers.Dense(2),
+    ])
+    x = np.random.RandomState(20).rand(2, 6, 7, 8, 2).astype(np.float32)
+    # ours takes NCDHW
+    _import_and_compare(tmp_path, m, x, lambda a: a.transpose(0, 4, 1, 2, 3))

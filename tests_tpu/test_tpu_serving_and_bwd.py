@@ -1,0 +1,100 @@
+"""Round-5 real-TPU additions.
+
+1. The serving use-case for UNBOUNDED while (VERDICT r4 ask 8,
+   SURVEY.md:243-245): a data-dependent tf.while_loop greedy decoder
+   imports to ``lax.while_loop`` and runs forward-only ON THE CHIP,
+   matching TF CPU exactly — the trip count depends on decoded tokens,
+   so no bounded lowering applies.
+2. The Pallas flash-attention BACKWARD kernels (dq + dkv, round 5)
+   compiled on real hardware: grads vs the XLA reference grads on-device.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _fence_tree(t) -> None:
+    for leaf in jax.tree_util.tree_leaves(t):
+        float(jnp.sum(jnp.asarray(leaf, jnp.float32)))
+
+
+def test_unbounded_while_greedy_decode_on_tpu(tpu_device):
+    tf = pytest.importorskip("tensorflow")
+    from deeplearning4j_tpu.samediff.tf_import import TFGraphMapper
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    V, L, EOS = 13, 16, 0
+    rng = np.random.RandomState(42)
+    w = (rng.randn(V, V) * 2.0).astype(np.float32)
+    w[:, EOS] -= 1.0
+
+    def fn(start):
+        def cond(i, tok, buf):
+            return tf.logical_and(i < L, tok[0] != EOS)
+
+        def body(i, tok, buf):
+            logits = tf.one_hot(tok, V) @ tf.constant(w)
+            nxt = tf.cast(tf.argmax(logits, axis=-1), tf.int32)
+            buf = buf + tf.one_hot(i, L, dtype=tf.int32)[None, :] \
+                * nxt[:, None]
+            return i + 1, nxt, buf
+
+        _, _, buf = tf.while_loop(
+            cond, body,
+            [tf.constant(0), start, tf.zeros([1, L], tf.int32)])
+        return buf
+
+    tfn = tf.function(fn)
+    cf = tfn.get_concrete_function(tf.TensorSpec((1,), tf.int32))
+    frozen = convert_variables_to_constants_v2(cf)
+    gd = frozen.graph.as_graph_def()
+    in_name = frozen.inputs[0].name.split(":")[0]
+    out_name = frozen.outputs[0].name.split(":")[0]
+    sd = TFGraphMapper.import_graph(gd, outputs=[out_name])
+
+    lens = set()
+    for start in (1, 5, 9):
+        x = np.asarray([start], np.int32)
+        expected = frozen(tf.constant(x))
+        expected = (expected[0] if isinstance(expected, (list, tuple))
+                    else expected).numpy()
+        got = np.asarray(sd.output({in_name: x}, [out_name])[out_name])
+        np.testing.assert_array_equal(got, expected)
+        lens.add(int((expected != 0).sum()))
+    # trip count must actually be data-dependent on the chip
+    assert len(lens) > 1, lens
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_backward_kernels_on_tpu(tpu_device, causal):
+    from deeplearning4j_tpu.ops.flash_attention import (
+        flash_attention, mha_attention_reference)
+
+    b, h, t, d = 2, 3, 1024, 64
+    q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (b, h, t, d),
+                                 jnp.float32) * 0.5 for i in range(3))
+    mask = (jnp.arange(t)[None, :] <
+            jnp.asarray([t, t // 2])[:, None]).astype(jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.square(flash_attention(
+            q, k, v, mask=mask, causal=causal, interpret=False,
+            bwd_block_q=256, bwd_block_k=512)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.square(mha_attention_reference(
+            q, k, v, mask=mask, causal=causal)))
+
+    gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    _fence_tree(gf)
+    _fence_tree(gr)
+    for name, a, bb in zip(("dq", "dk", "dv"), gf, gr):
+        rel = float(jnp.max(jnp.abs(a - bb)) /
+                    (jnp.max(jnp.abs(bb)) + 1e-9))
+        assert rel < 2e-2, (name, rel)
