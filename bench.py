@@ -721,7 +721,9 @@ def measure_calibration(n: int = 4096, chain: int = 100,
         return med, {
             "min": round(min(rates) / 1e12, 2),
             "max": round(max(rates) / 1e12, 2), "n": repeats,
-            "n_pairs_used": len(good or [delta_med]),
+            # 0 = no pairwise delta survived the noise filter; the spread
+            # then just echoes the median-delta rate (not a measured pair)
+            "n_pairs_used": len(good),
             "n_iter_base": n1,
         }, round(fixed_ms, 1)
 

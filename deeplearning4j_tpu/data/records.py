@@ -237,10 +237,12 @@ class ImageRecordReader(RecordReader):
             # semantics as the float decoder: '#' comments, exactly one
             # whitespace byte before the raster; back-anchored slicing
             # would silently shift pixels on trailing-byte files
+            if buf[:2] not in (b"P5", b"P6"):
+                raise ValueError(f"{path}: not a binary netpbm (P5/P6)")
             try:
                 w, h, c, maxval, pos = native.parse_netpbm_header(buf)
-            except ValueError:
-                raise ValueError(f"{path}: not a binary netpbm (P5/P6)")
+            except ValueError as e:
+                raise ValueError(f"{path}: malformed netpbm header") from e
             if maxval > 255:
                 raise ValueError(
                     f"{path}: 16-bit netpbm (maxval {maxval}) unsupported "

@@ -730,6 +730,115 @@ class _SequentialImporter:
                                   activation=Activation.ELU,
                                   alpha=float(conf.get("alpha", 1.0))))
 
+    def _import_Cropping2D(self, conf):
+        s = self.shape
+        if s.kind != "conv":
+            raise KerasImportError("Cropping2D on non-convolutional input")
+        if conf.get("data_format") not in (None, "channels_last"):
+            raise KerasImportError("only channels_last Keras models supported")
+        from ..nn.layers import Cropping2DLayer
+
+        crop = conf.get("cropping", ((0, 0), (0, 0)))
+        if isinstance(crop, int):
+            t = b = l = r = crop
+        else:
+            ch, cw = crop
+            t, b = (ch, ch) if isinstance(ch, int) else ch
+            l, r = (cw, cw) if isinstance(cw, int) else cw
+        self._add(Cropping2DLayer(name=conf["name"],
+                                  crop=(int(t), int(b), int(l), int(r))))
+        s.h = s.h - t - b
+        s.w = s.w - l - r
+
+    def _import_Conv2DTranspose(self, conf):
+        s = self.shape
+        if s.kind != "conv":
+            raise KerasImportError(
+                "Conv2DTranspose on non-convolutional input")
+        if conf.get("data_format") not in (None, "channels_last"):
+            raise KerasImportError("only channels_last Keras models supported")
+        if conf.get("output_padding") not in (None, (0, 0), [0, 0]):
+            raise KerasImportError("Conv2DTranspose output_padding "
+                                   "unsupported")
+        if tuple(conf.get("dilation_rate", (1, 1))) != (1, 1):
+            raise KerasImportError("dilated Conv2DTranspose unsupported")
+        from ..nn.layers import Deconvolution2DLayer
+
+        mode = _pad_mode(conf.get("padding", "valid"))
+        kh, kw = conf["kernel_size"]
+        sh, sw = conf.get("strides", (1, 1))
+        w = self._weights(conf)
+        # keras [kh, kw, out, in] -> ours [in, out, kh, kw]
+        params = {"W": w["kernel"].transpose(3, 2, 0, 1)}
+        if conf.get("use_bias", True):
+            params["b"] = w["bias"]
+        self._add(Deconvolution2DLayer(
+            name=conf["name"], n_in=int(s.c), n_out=int(conf["filters"]),
+            kernel_size=(kh, kw), stride=(sh, sw), convolution_mode=mode,
+            activation=_map_activation(conf.get("activation")),
+            has_bias=conf.get("use_bias", True)), params)
+        if mode is ConvolutionMode.SAME:
+            s.h, s.w = s.h * sh, s.w * sw
+        else:
+            s.h = (s.h - 1) * sh + kh
+            s.w = (s.w - 1) * sw + kw
+        s.c = conf["filters"]
+
+    def _import_LayerNormalization(self, conf):
+        s = self.shape
+        if s.kind not in ("rnn", "ff"):
+            raise KerasImportError(
+                "LayerNormalization supported on sequence/flat inputs only")
+        if conf.get("rms_scaling", False):
+            raise KerasImportError(
+                "LayerNormalization rms_scaling=True (RMSNorm) unsupported")
+        axis = conf.get("axis", -1)
+        if isinstance(axis, list):
+            axis = axis[0] if len(axis) == 1 else None
+        rank = 3 if s.kind == "rnn" else 2
+        if axis not in (-1, rank - 1):
+            raise KerasImportError(
+                "only last-axis LayerNormalization supported")
+        from ..nn.layers import LayerNormLayer
+
+        n = int(s.f if s.kind == "rnn" else s.n)
+        w = self._weights(conf)
+        params = {}
+        params["gamma"] = w["gamma"] if conf.get("scale", True) \
+            else np.ones((n,), np.float32)
+        params["beta"] = w["beta"] if conf.get("center", True) \
+            else np.zeros((n,), np.float32)
+        self._add(LayerNormLayer(
+            name=conf["name"], n_out=n,
+            eps=float(conf.get("epsilon", 1e-3))), params)
+
+    def _pool1d(self, conf, ptype):
+        s = self.shape
+        if s.kind != "rnn":
+            raise KerasImportError("1D pooling needs sequence input")
+        from ..nn.layers import Subsampling1DLayer
+
+        (k,) = conf.get("pool_size", (2,)) if isinstance(
+            conf.get("pool_size", (2,)), (list, tuple)) \
+            else (conf["pool_size"],)
+        st = conf.get("strides")
+        if st is None:
+            st = k
+        elif isinstance(st, (list, tuple)):
+            (st,) = st
+        mode = _pad_mode(conf.get("padding", "valid"))
+        self._add(Subsampling1DLayer(
+            name=conf["name"], pooling_type=ptype, kernel_size=int(k),
+            stride=int(st), convolution_mode=mode))
+        if s.t is not None:
+            s.t = _conv_out(s.t, int(k), int(st), mode)
+
+    def _import_MaxPooling1D(self, conf):
+        self._pool1d(conf, PoolingType.MAX)
+
+    def _import_AveragePooling1D(self, conf):
+        self._pool1d(conf, PoolingType.AVG)
+
     def _import_Conv3D(self, conf):
         s = self.shape
         if s.kind != "conv3d":

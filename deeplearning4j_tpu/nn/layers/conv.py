@@ -322,11 +322,25 @@ class Deconvolution2DLayer(Layer):
         if self.convolution_mode is ConvolutionMode.SAME:
             pad = "SAME"
         else:
-            pad = [(p, p) for p in self.padding]
+            # conv_transpose applies explicit pads to the lhs-DILATED
+            # input; gradient-of-conv semantics for forward padding p and
+            # effective kernel ek need (ek - 1 - p) each side, giving
+            # out = s*(in-1) + ek - 2p — the shape output_type() promises
+            # (p = 0 reduces to the "VALID" string's padding).
+            pad = []
+            for p_i, k_i, d_i in zip(self.padding, self.kernel_size,
+                                     self.dilation):
+                ek = (k_i - 1) * d_i + 1
+                pad.append((ek - 1 - p_i, ek - 1 - p_i))
+        # transpose_kernel=True: TRUE gradient-of-conv semantics (spatial
+        # flip + in/out swap) — torch ConvTranspose2d / Keras
+        # Conv2DTranspose / reference Deconvolution2D parity. W layout
+        # [nIn, nOut, kH, kW] is the transposed forward conv's OIHW.
         y = lax.conv_transpose(
             x, params["W"], strides=self.stride, padding=pad,
             rhs_dilation=self.dilation,
-            dimension_numbers=("NCHW", "IOHW", "NCHW"),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            transpose_kernel=True,
         )
         if self.has_bias:
             y = y + params["b"][None, :, None, None]

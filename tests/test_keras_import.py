@@ -99,13 +99,13 @@ def test_lstm_return_sequences(tmp_path):
 def test_unsupported_layer_raises(tmp_path):
     m = keras.Sequential([
         keras.layers.Input((4, 4, 1)),
-        keras.layers.Conv2DTranspose(2, 3),
+        keras.layers.GaussianNoise(0.1),  # train-time noise: no silent map
         keras.layers.Flatten(),
         keras.layers.Dense(2),
     ])
     path = str(tmp_path / "model.h5")
     m.save(path)
-    with pytest.raises(KerasImportError, match="Conv2DTranspose"):
+    with pytest.raises(KerasImportError, match="GaussianNoise"):
         KerasModelImport.import_keras_model_and_weights(path)
 
 
@@ -515,3 +515,48 @@ def test_conv3d_import(tmp_path):
     x = np.random.RandomState(20).rand(2, 6, 7, 8, 2).astype(np.float32)
     # ours takes NCDHW
     _import_and_compare(tmp_path, m, x, lambda a: a.transpose(0, 4, 1, 2, 3))
+
+
+def test_cropping_and_conv2d_transpose_import(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((8, 8, 3)),
+        keras.layers.Cropping2D(((1, 1), (2, 0))),
+        keras.layers.Conv2DTranspose(5, 3, strides=2, padding="same",
+                                     activation="relu"),
+        keras.layers.Conv2DTranspose(4, 2, strides=2, padding="valid"),
+        keras.layers.GlobalAveragePooling2D(),
+        keras.layers.Dense(2),
+    ])
+    x = np.random.RandomState(21).rand(2, 8, 8, 3).astype(np.float32)
+    _import_and_compare(tmp_path, m, x, lambda a: a.transpose(0, 3, 1, 2))
+
+
+def test_layer_normalization_import(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((6, 5)),
+        keras.layers.LayerNormalization(epsilon=1e-4),
+        keras.layers.GRU(4, return_sequences=False),
+        keras.layers.Dense(8),
+        keras.layers.LayerNormalization(),
+        keras.layers.Dense(2),
+    ])
+    # non-trivial gamma/beta
+    weights = m.get_weights()
+    rng = np.random.RandomState(22)
+    m.set_weights([w + 0.1 * rng.rand(*w.shape).astype(np.float32)
+                   for w in weights])
+    x = rng.randn(3, 6, 5).astype(np.float32)
+    _import_and_compare(tmp_path, m, x, lambda a: a.transpose(0, 2, 1))
+
+
+def test_pooling1d_import(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((12, 6)),
+        keras.layers.Conv1D(8, 3, padding="same", activation="relu"),
+        keras.layers.MaxPooling1D(2),
+        keras.layers.AveragePooling1D(3, strides=2, padding="same"),
+        keras.layers.GlobalMaxPooling1D(),
+        keras.layers.Dense(3),
+    ])
+    x = np.random.RandomState(23).randn(2, 12, 6).astype(np.float32)
+    _import_and_compare(tmp_path, m, x, lambda a: a.transpose(0, 2, 1))
