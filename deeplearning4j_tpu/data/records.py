@@ -252,8 +252,11 @@ class ImageRecordReader(RecordReader):
                 raise ValueError(f"{path}: truncated netpbm raster")
             arr = np.frombuffer(data, np.uint8).reshape(h, w, c)
             if maxval != 255:
-                # rescale to the full byte range like the float path does
-                arr = (arr.astype(np.uint16) * 255 // maxval).astype(np.uint8)
+                # rounded rescale to the full byte range so the uint8 fast
+                # path matches the float decoder within rounding (floor
+                # division diverged by up to 1 LSB)
+                arr = ((arr.astype(np.uint16) * 255 + maxval // 2)
+                       // maxval).astype(np.uint8)
             return arr
         Image = _pil()
         if Image is None:
@@ -278,10 +281,11 @@ class ImageRecordReader(RecordReader):
                         "transforms need the float32 path")
                 img = np.asarray(self.transform.call(img, rng))
             if img.shape[:2] != (self.height, self.width):
-                # resize needs float math; round back so output stays u8
-                img = np.clip(native.resize_bilinear(
+                # resize needs float math; round (not truncate) back so the
+                # uint8 output matches the float path within rounding
+                img = np.rint(np.clip(native.resize_bilinear(
                     img.astype(np.float32), self.height, self.width),
-                    0, 255).astype(np.uint8)
+                    0, 255)).astype(np.uint8)
         else:
             img = self._decode(path)
             if self.transform is not None:
@@ -295,7 +299,7 @@ class ImageRecordReader(RecordReader):
             elif self.channels == 1 and img.shape[2] == 3:
                 img = img.mean(axis=2, keepdims=True)
                 if self.output_dtype == "uint8":
-                    img = img.astype(np.uint8)
+                    img = np.rint(img).astype(np.uint8)
             else:
                 raise ValueError(
                     f"cannot adapt {img.shape[2]} channels to "

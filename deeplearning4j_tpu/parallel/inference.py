@@ -8,6 +8,13 @@ serves all threads — so the valuable part is the dynamic batcher:
 requests queue up, a worker drains up to ``batch_limit`` of them,
 pads to a bucketed batch size (stable shapes → no recompiles), runs one
 forward, and scatters results back to the callers' futures.
+
+Overload/failure story (core/resilience.py): admission is fail-fast
+(``AdmissionRejectedError`` instead of blocking on a full queue), each
+request carries a :class:`Deadline` that is checked before it costs a
+forward, the forward sits behind a :class:`CircuitBreaker` so a poisoned
+jit fails fast instead of burning a device dispatch per queued request,
+and :meth:`stats` exposes the counters a load balancer needs.
 """
 
 from __future__ import annotations
@@ -15,13 +22,26 @@ from __future__ import annotations
 import enum
 import queue
 import threading
+import time
 from concurrent.futures import Future
-from typing import List, Optional, Tuple
+from typing import Callable, List, Optional
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from ..core.resilience import (
+    AdmissionController,
+    CircuitBreaker,
+    CircuitOpenError,
+    CircuitState,
+    Deadline,
+    DeadlineExceededError,
+    get_fault_injector,
+)
+
+FORWARD_SITE = "parallel_inference.forward"  # FaultInjector site name
 
 
 class InferenceMode(enum.Enum):
@@ -36,6 +56,19 @@ def _bucket(n: int, limit: int) -> int:
     return min(b, limit)
 
 
+class _Request:
+    __slots__ = ("x", "fut", "deadline")
+
+    def __init__(self, x: np.ndarray, fut: Future, deadline: Deadline) -> None:
+        self.x = x
+        self.fut = fut
+        self.deadline = deadline
+
+    @property
+    def rows(self) -> int:
+        return self.x.shape[0] if self.x.ndim > 1 else 1
+
+
 class ParallelInference:
     def __init__(
         self,
@@ -45,12 +78,31 @@ class ParallelInference:
         batch_limit: int = 32,
         workers: int = 2,
         queue_limit: int = 256,
+        default_timeout: Optional[float] = None,
+        circuit_breaker: Optional[CircuitBreaker] = None,
+        admission: Optional[AdmissionController] = None,
+        clock: Callable[[], float] = time.monotonic,
+        fault_injector=None,
     ) -> None:
         self.model = model
         self.mode = inference_mode
         self.batch_limit = int(batch_limit)
-        self._queue: "queue.Queue[Optional[Tuple[np.ndarray, Future]]]" = queue.Queue(queue_limit)
+        self.default_timeout = default_timeout
+        self._clock = clock
+        self._fault_injector = fault_injector
+        # the queue itself is unbounded: backpressure is the admission
+        # controller's job, and it answers NOW instead of blocking the
+        # caller until a slot frees up
+        self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
+        self._admission = admission or AdmissionController(
+            max_pending=queue_limit, clock=clock)
+        self._breaker = circuit_breaker or CircuitBreaker(clock=clock)
         self._lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._counts = {"accepted": 0, "shed": 0, "timed_out": 0,
+                        "failed": 0, "completed": 0, "circuit_rejected": 0,
+                        "batches": 0, "batch_rows": 0, "max_batch": 0}
+        self._idle = threading.Condition(self._stats_lock)
 
         params, state = model.params, model.state
 
@@ -61,27 +113,79 @@ class ParallelInference:
         self._fwd = jax.jit(fwd)
         self._threads: List[threading.Thread] = []
         self._shutdown = False
+        self._draining = False
         for i in range(max(1, workers)):
             t = threading.Thread(target=self._worker, name=f"pi-worker-{i}", daemon=True)
             t.start()
             self._threads.append(t)
 
-    # ----- client side ------------------------------------------------
-    def output(self, x) -> np.ndarray:
-        """Blocking single-request inference (reference API shape)."""
-        return self.output_async(x).result()
+    def _inj(self):
+        return self._fault_injector or get_fault_injector()
 
-    def output_async(self, x) -> Future:
+    # ----- client side ------------------------------------------------
+    def output(self, x, *, timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking single-request inference (reference API shape)."""
+        return self.output_async(x, timeout=timeout).result()
+
+    def output_async(self, x, *, timeout: Optional[float] = None,
+                     deadline: Optional[Deadline] = None) -> Future:
+        """Fail-fast enqueue. Raises :class:`AdmissionRejectedError` when
+        the pending window is full (shed — retryable), and
+        :class:`CircuitOpenError` while the breaker is hard-open (the
+        forward is known-poisoned; don't queue work behind it)."""
+        if deadline is None:
+            deadline = Deadline.after(
+                timeout if timeout is not None else self.default_timeout,
+                clock=self._clock)
         fut: Future = Future()
         # The lock orders enqueues against shutdown's sentinel placement: no
         # request can land behind the sentinels and starve its Future.
         with self._lock:
-            if self._shutdown:
-                raise RuntimeError("ParallelInference is shut down")
-            self._queue.put((np.asarray(x), fut))
+            if self._shutdown or self._draining:
+                raise RuntimeError("ParallelInference is shut down" if
+                                   self._shutdown else
+                                   "ParallelInference is draining")
+            if self._breaker.state is CircuitState.OPEN:
+                with self._stats_lock:
+                    self._counts["circuit_rejected"] += 1
+                raise CircuitOpenError(retry_after=self._breaker.retry_after())
+            try:
+                self._admission.admit()
+            except Exception:
+                with self._stats_lock:
+                    self._counts["shed"] += 1
+                raise
+            with self._stats_lock:
+                self._counts["accepted"] += 1
+            self._queue.put(_Request(np.asarray(x), fut, deadline))
         return fut
 
-    def shutdown(self) -> None:
+    def _finish(self, n: int = 1) -> None:
+        """Admission + idle bookkeeping for ``n`` settled requests."""
+        for _ in range(n):
+            self._admission.release()
+        with self._idle:
+            if self._admission.pending == 0:
+                self._idle.notify_all()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop admitting, wait until every in-flight request settles.
+        Returns True when fully drained (False on timeout)."""
+        with self._lock:
+            self._draining = True
+        end = None if timeout is None else time.monotonic() + timeout
+        with self._idle:
+            while self._admission.pending > 0:
+                rem = None if end is None else end - time.monotonic()
+                if rem is not None and rem <= 0:
+                    return False
+                self._idle.wait(timeout=rem if rem is not None else 0.5)
+        return True
+
+    def shutdown(self, *, drain: bool = True,
+                 drain_timeout: Optional[float] = 30.0) -> None:
+        if drain and not self._shutdown:
+            self.drain(timeout=drain_timeout)
         with self._lock:
             if self._shutdown:
                 return
@@ -91,11 +195,43 @@ class ParallelInference:
         for t in self._threads:
             t.join(timeout=5)
 
+    def stats(self) -> dict:
+        """Snapshot for /stats and load-balancer decisions."""
+        with self._stats_lock:
+            counts = dict(self._counts)
+        batches = counts.pop("batches")
+        rows = counts.pop("batch_rows")
+        counts.update({
+            "queue_depth": self._admission.pending,
+            "circuit_state": self._breaker.state.value,
+            "batches": batches,
+            "mean_batch_size": (rows / batches) if batches else 0.0,
+            "max_batch_size": counts.pop("max_batch"),
+            "draining": self._draining,
+        })
+        return counts
+
+    @property
+    def circuit_state(self) -> CircuitState:
+        return self._breaker.state
+
     # ----- worker side ------------------------------------------------
-    def _drain(self, first) -> List[Tuple[np.ndarray, Future]]:
+    def _expire(self, req: _Request) -> bool:
+        """Settle an already-expired request without spending a forward."""
+        if req.deadline.expired():
+            if not req.fut.done():
+                req.fut.set_exception(DeadlineExceededError(
+                    "request expired in queue"))
+            with self._stats_lock:
+                self._counts["timed_out"] += 1
+            self._finish()
+            return True
+        return False
+
+    def _drain_batch(self, first: _Request) -> List[_Request]:
         items = [first]
         if self.mode is InferenceMode.BATCHED:
-            budget = self.batch_limit - first[0].shape[0] if first[0].ndim > 1 else self.batch_limit - 1
+            budget = self.batch_limit - first.rows
             while budget > 0:
                 try:
                     nxt = self._queue.get_nowait()
@@ -104,8 +240,10 @@ class ParallelInference:
                 if nxt is None:
                     self._queue.put(None)
                     break
+                if self._expire(nxt):
+                    continue
                 items.append(nxt)
-                budget -= nxt[0].shape[0] if nxt[0].ndim > 1 else 1
+                budget -= nxt.rows
         return items
 
     def _worker(self) -> None:
@@ -113,12 +251,23 @@ class ParallelInference:
             item = self._queue.get()
             if item is None:
                 return
-            batch = self._drain(item)
+            if self._expire(item):
+                continue
+            batch = self._drain_batch(item)
+            if not self._breaker.allow():
+                err = CircuitOpenError(retry_after=self._breaker.retry_after())
+                for req in batch:
+                    if not req.fut.done():
+                        req.fut.set_exception(err)
+                with self._stats_lock:
+                    self._counts["circuit_rejected"] += len(batch)
+                self._finish(len(batch))
+                continue
             try:
                 arrays = []
                 sizes = []
-                for x, _ in batch:
-                    a = x if x.ndim > 1 else x[None, ...]
+                for req in batch:
+                    a = req.x if req.x.ndim > 1 else req.x[None, ...]
                     arrays.append(a)
                     sizes.append(a.shape[0])
                 cat = np.concatenate(arrays, axis=0)
@@ -127,15 +276,27 @@ class ParallelInference:
                 if padded_n > n:
                     pad = np.repeat(cat[-1:], padded_n - n, axis=0)
                     cat = np.concatenate([cat, pad], axis=0)
+                self._inj().fire(FORWARD_SITE)
                 out = np.asarray(self._fwd(jnp.asarray(cat, self.model.dtype)))[:n]
+                self._breaker.record_success()
+                with self._stats_lock:
+                    self._counts["batches"] += 1
+                    self._counts["batch_rows"] += n
+                    self._counts["max_batch"] = max(self._counts["max_batch"], n)
+                    self._counts["completed"] += len(batch)
                 off = 0
-                for (x, fut), sz in zip(batch, sizes):
+                for req, sz in zip(batch, sizes):
                     res = out[off : off + sz]
-                    if x.ndim == out.ndim - 1 and sz == 1:
+                    if req.x.ndim == out.ndim - 1 and sz == 1:
                         res = res[0]
-                    fut.set_result(res)
+                    req.fut.set_result(res)
                     off += sz
             except Exception as e:  # propagate to all waiting callers
-                for _, fut in batch:
-                    if not fut.done():
-                        fut.set_exception(e)
+                self._breaker.record_failure()
+                with self._stats_lock:
+                    self._counts["failed"] += len(batch)
+                for req in batch:
+                    if not req.fut.done():
+                        req.fut.set_exception(e)
+            finally:
+                self._finish(len(batch))

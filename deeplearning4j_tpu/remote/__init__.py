@@ -1,3 +1,3 @@
-from .server import JsonModelServer, JsonRemoteInference
+from .server import JsonModelServer, JsonRemoteInference, ServiceUnavailableError
 
-__all__ = ["JsonModelServer", "JsonRemoteInference"]
+__all__ = ["JsonModelServer", "JsonRemoteInference", "ServiceUnavailableError"]

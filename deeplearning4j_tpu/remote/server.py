@@ -7,64 +7,148 @@ matching ``JsonRemoteInference`` client. Serving goes through
 concurrent requests dynamically batch into one jitted forward (the
 reference's worker-pool + BatchedInferenceObservable collapses to that).
 
+Status-code contract (see README.md "Serving resilience"):
+
+  200  success
+  400  malformed input (bad JSON, missing "data", non-numeric) — never retry
+  404  unknown path
+  503  overloaded (load shed), circuit open, or draining — retry after
+       the ``Retry-After`` header (seconds)
+  504  request deadline exceeded (client sets ``deadline_ms`` in the
+       payload or the ``X-Deadline-Ms`` header; server default otherwise)
+  500  internal error (bug — not retryable by policy)
+
 Endpoints:
-  POST <path>   {"data": [[...]]}  → {"output": [[...]]}
-  GET  /health  → {"status": "ok"}
+  POST <path>    {"data": [[...]], "deadline_ms": 250?} → {"output": [[...]]}
+  GET  /health   → {"status": "ok" | "degraded" | "draining", ...}
+                   (200 when ok, 503 otherwise — load balancers key off
+                   the code, humans off the body)
+  GET  /stats    → ParallelInference counters snapshot
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib import request as urllib_request
+from urllib.error import HTTPError, URLError
 
 import numpy as np
 
+from ..core.resilience import (
+    AdmissionRejectedError,
+    CircuitOpenError,
+    CircuitState,
+    Deadline,
+    DeadlineExceededError,
+    ResilienceError,
+    RetryPolicy,
+)
 from ..parallel.inference import InferenceMode, ParallelInference
+
+
+class ServiceUnavailableError(ResilienceError):
+    """Client-side image of a 503: retryable, with the server's
+    Retry-After hint attached (RetryPolicy honors ``retry_after``)."""
+
+    def __init__(self, msg: str, retry_after: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after = retry_after
 
 
 class JsonModelServer:
     def __init__(self, model, *, port: int = 0, path: str = "/v1/serving",
-                 batch_limit: int = 32, workers: int = 2) -> None:
+                 batch_limit: int = 32, workers: int = 2,
+                 queue_limit: int = 256,
+                 default_deadline: float = 30.0,
+                 circuit_breaker=None, admission=None,
+                 clock=time.monotonic, fault_injector=None) -> None:
         self.model = model
         self.path = path
+        self.default_deadline = float(default_deadline)
+        self._clock = clock
+        self._draining = False
         self._pi = ParallelInference(
             model, inference_mode=InferenceMode.BATCHED,
-            batch_limit=batch_limit, workers=workers)
+            batch_limit=batch_limit, workers=workers,
+            queue_limit=queue_limit, circuit_breaker=circuit_breaker,
+            admission=admission, clock=clock, fault_injector=fault_injector)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):  # silent by default
                 pass
 
-            def _send(self, code: int, payload: dict) -> None:
+            def _send(self, code: int, payload: dict,
+                      headers: Optional[dict] = None) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_unavailable(self, reason: str, retry_after: float) -> None:
+                self._send(503, {"error": reason, "retryable": True},
+                           {"Retry-After": f"{max(retry_after, 0.001):.3f}"})
+
             def do_GET(self):
                 if self.path == "/health":
-                    self._send(200, {"status": "ok"})
+                    status, code = outer.health()
+                    self._send(code, status)
+                elif self.path == "/stats":
+                    self._send(200, outer.stats())
                 else:
                     self._send(404, {"error": f"unknown path {self.path}"})
+
+            def _deadline(self, payload: dict) -> Deadline:
+                ms = payload.get("deadline_ms")
+                if ms is None:
+                    ms = self.headers.get("X-Deadline-Ms")
+                seconds = (float(ms) / 1000.0 if ms is not None
+                           else outer.default_deadline)
+                return Deadline.after(seconds, clock=outer._clock)
 
             def do_POST(self):
                 if self.path != outer.path:
                     self._send(404, {"error": f"unknown path {self.path}"})
                     return
+                # ---- parse: any failure here is the CLIENT's fault -> 400
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(length))
                     data = np.asarray(payload["data"], np.float32)
-                    out = outer._pi.output(data)
-                    self._send(200, {"output": np.asarray(out).tolist()})
+                    deadline = self._deadline(payload)
                 except Exception as e:
-                    self._send(400, {"error": str(e)})
+                    self._send(400, {"error": f"malformed request: {e}"})
+                    return
+                # ---- serve: failures here are the SERVER's state -> 5xx
+                try:
+                    if outer._draining:
+                        raise RuntimeError("draining")
+                    fut = outer._pi.output_async(data, deadline=deadline)
+                    out = fut.result(timeout=deadline.remaining())
+                    self._send(200, {"output": np.asarray(out).tolist()})
+                except AdmissionRejectedError as e:
+                    self._send_unavailable(
+                        f"overloaded: {e}", outer._pi._admission.retry_after())
+                except CircuitOpenError as e:
+                    self._send_unavailable(f"circuit open: {e}", e.retry_after)
+                except (DeadlineExceededError, FutureTimeoutError):
+                    self._send(504, {"error": "deadline exceeded"})
+                except RuntimeError as e:
+                    if "drain" in str(e) or "shut down" in str(e):
+                        self._send_unavailable("draining", 1.0)
+                    else:
+                        self._send(500, {"error": f"internal error: {e}"})
+                except Exception as e:
+                    self._send(500, {"error": f"internal error: {e}"})
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self._thread: Optional[threading.Thread] = None
@@ -72,6 +156,25 @@ class JsonModelServer:
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
+
+    def health(self) -> tuple:
+        """({"status": ...}, http_code). Truthful: draining while stopping,
+        degraded while the breaker is not closed, ok otherwise."""
+        circuit = self._pi.circuit_state
+        if self._draining:
+            status = "draining"
+        elif circuit is not CircuitState.CLOSED:
+            status = "degraded"
+        else:
+            status = "ok"
+        payload = {"status": status, "circuit": circuit.value,
+                   "queue_depth": self._pi.stats()["queue_depth"]}
+        return payload, (200 if status == "ok" else 503)
+
+    def stats(self) -> dict:
+        s = self._pi.stats()
+        s["draining"] = self._draining
+        return s
 
     def start(self) -> "JsonModelServer":
         if self._thread is None:
@@ -81,29 +184,79 @@ class JsonModelServer:
             self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, *, drain: bool = True,
+             drain_timeout: Optional[float] = 30.0) -> None:
+        """Graceful by default: flip to draining (new POSTs get 503 +
+        Retry-After), let in-flight requests finish, then tear down."""
+        self._draining = True
+        if drain:
+            self._pi.drain(timeout=drain_timeout)
         self._httpd.shutdown()
         self._httpd.server_close()
-        self._pi.shutdown()
+        self._pi.shutdown(drain=False)
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
 
 
 class JsonRemoteInference:
-    """Client helper (reference: JsonRemoteInference)."""
+    """Client helper (reference: JsonRemoteInference) with deadline-aware
+    retries: 503s and connection errors back off (exponential + seeded
+    jitter, honoring Retry-After) under the request's total deadline;
+    400s never retry — resending malformed input cannot help."""
 
-    def __init__(self, endpoint: str, timeout: float = 30.0) -> None:
+    def __init__(self, endpoint: str, timeout: float = 30.0,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 sleep=time.sleep, clock=time.monotonic) -> None:
         self.endpoint = endpoint
         self.timeout = timeout
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_retries=3, initial_backoff=0.05, max_backoff=2.0)
+        self._sleep = sleep
+        self._clock = clock
+        self.retries = 0  # attempts beyond the first, across calls
 
-    def predict(self, data) -> np.ndarray:
+    def _call_once(self, body: bytes, deadline: Deadline) -> dict:
+        rem = deadline.remaining()
+        if rem is not None and rem <= 0:
+            raise DeadlineExceededError("client deadline exceeded")
+        headers = {"Content-Type": "application/json"}
+        if rem is not None:
+            headers["X-Deadline-Ms"] = str(int(rem * 1000))
+        req = urllib_request.Request(self.endpoint, data=body, headers=headers)
+        try:
+            with urllib_request.urlopen(req, timeout=rem) as resp:
+                return json.loads(resp.read())
+        except HTTPError as e:
+            detail = ""
+            try:
+                detail = json.loads(e.read()).get("error", "")
+            except Exception:
+                pass
+            if e.code == 503:
+                ra = e.headers.get("Retry-After")
+                raise ServiceUnavailableError(
+                    detail or "service unavailable",
+                    retry_after=float(ra) if ra else None) from e
+            if e.code == 504:
+                raise DeadlineExceededError(detail or "deadline exceeded") from e
+            if e.code == 400:
+                raise ValueError(detail or "bad request") from e
+            raise RuntimeError(f"HTTP {e.code}: {detail}") from e
+
+    def predict(self, data, *, timeout: Optional[float] = None) -> np.ndarray:
         body = json.dumps({"data": np.asarray(data).tolist()}).encode()
-        req = urllib_request.Request(
-            self.endpoint, data=body,
-            headers={"Content-Type": "application/json"})
-        with urllib_request.urlopen(req, timeout=self.timeout) as resp:
-            payload = json.loads(resp.read())
+        deadline = Deadline.after(
+            timeout if timeout is not None else self.timeout,
+            clock=self._clock)
+
+        def note_retry(attempt, exc, delay):
+            self.retries += 1
+
+        payload = self.retry_policy.execute(
+            lambda: self._call_once(body, deadline),
+            retry_on=(ServiceUnavailableError, URLError, ConnectionError),
+            deadline=deadline, sleep=self._sleep, on_retry=note_retry)
         if "error" in payload:
             raise RuntimeError(payload["error"])
         return np.asarray(payload["output"], np.float32)

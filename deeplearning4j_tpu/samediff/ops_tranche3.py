@@ -117,36 +117,35 @@ def _mergemaxindex(*xs):
 @sd_op("dynamic_stitch")
 def _dynamic_stitch(indices, *data, size=None):
     """TF dynamic_stitch with equal-rank parts: result[indices[i][j]] =
-    data[i][j]. XLA-honest form: output length is static — pass ``size``
-    (= TF's max(indices)+1) or it defaults to the total index count
-    (correct whenever the index lists are a permutation, the common
-    interleave/departition case). Later lists overwrite earlier ones at
-    duplicate indices, matching TF's last-wins across inputs."""
+    data[i][j]. XLA-honest form: output length is static — with concrete
+    index lists it is TF's max(indices)+1 (gaps stay zero, duplicates
+    last-wins across inputs); with traced indices pass ``size``
+    explicitly. Later lists overwrite earlier ones at duplicate indices,
+    matching TF's last-wins across inputs."""
     idx_list = list(indices) if isinstance(indices, (list, tuple)) \
         else [indices]
     ind_ndim = idx_list[0].ndim
     if size is not None:
         n = int(size)
     else:
-        n = sum(int(np.prod(i.shape)) for i in idx_list)
-        # TF semantics are max(indices)+1; with no ``size`` given we can only
-        # honour that when the index lists form a permutation of range(n).
-        # Validate when indices are concrete so out-of-range updates raise
-        # loudly instead of being silently dropped by the clamping scatter.
+        # TF semantics are max(indices)+1, computable whenever the indices
+        # are concrete (TF-imported graphs legally use gaps and duplicates
+        # and the importer cannot pass size=; duplicates keep TF's
+        # last-wins because updates apply in list order below).
         try:
-            concrete = np.sort(np.concatenate(
-                [np.asarray(i).ravel() for i in idx_list]))
-        except Exception:  # traced values: cannot check, document-only
+            concrete = np.concatenate(
+                [np.asarray(i).ravel() for i in idx_list])
+        except Exception:  # traced values: cannot compute max(indices)
             concrete = None
-        if concrete is not None and (
-                len(concrete) != n or not np.array_equal(
-                    concrete, np.arange(n, dtype=concrete.dtype))):
+        if concrete is not None:
+            n = int(concrete.max()) + 1 if len(concrete) else 0
+        else:
+            # Traced indices: the output length must be static under jit
+            # and cannot be derived from traced values — demand size=.
             raise ValueError(
-                "dynamic_stitch without size= requires the index lists to "
-                "form a permutation of range(total); got max index "
-                f"{int(concrete.max()) if len(concrete) else -1} over "
-                f"{n} total indices. Pass size=max(indices)+1 for TF "
-                "semantics with gaps/duplicates.")
+                "dynamic_stitch with traced indices requires size= "
+                "(= max(indices)+1): the output length must be static "
+                "and cannot be derived from traced index values.")
     rest = data[0].shape[ind_ndim:]
     out = jnp.zeros((n,) + rest, data[0].dtype)
     for i, d in zip(idx_list, data):

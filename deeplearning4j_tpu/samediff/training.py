@@ -52,6 +52,9 @@ class TrainingSession:
         ]
         self.opt_state = None
         self._step = None
+        # the most recent fit()'s History — still holds the flushed loss
+        # curve when fit() is interrupted mid-run (robust telemetry)
+        self.last_history: Optional[History] = None
 
     def _build_step(self):
         sd = self.sd
@@ -93,34 +96,43 @@ class TrainingSession:
         if self._step is None:
             self._step = self._build_step()
         history = History()
+        self.last_history = history
         from ..data.dataset import DataSet, MultiDataSet
 
         device_losses = []
-        for _ in range(epochs):
-            for item in iterator:
-                if isinstance(item, MultiDataSet):
-                    feats, labs = list(item.features), list(item.labels)
-                elif isinstance(item, DataSet):
-                    feats, labs = [item.features], [item.labels]
-                else:
-                    feats, labs = [item[0]], [item[1]]
-                feeds = {}
-                feeds.update(zip(cfg.data_set_feature_mapping, feats))
-                feeds.update(zip(cfg.data_set_label_mapping, labs))
-                feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
-                rng = sd._rng.next_key()
-                var_vals, self.opt_state, loss = self._step(var_vals, self.opt_state, feeds, rng)
-                # keep the loss ON DEVICE: a float() here would force a
-                # host sync per step (~64 ms through the axon tunnel —
-                # measured round 5: it tripled the imported-BERT train
-                # step). One stacked fetch after the loop costs one sync.
-                device_losses.append(loss)
-        if device_losses:
-            import numpy as np
 
-            # ONE stacked D2H fetch (iterating a jax array would fetch
-            # per element — a tunnel round-trip each)
-            history.loss_curve.extend(
-                np.asarray(jnp.stack(device_losses), np.float64).tolist())
+        def flush_losses():
+            if device_losses:
+                # ONE stacked D2H fetch (iterating a jax array would fetch
+                # per element — a tunnel round-trip each)
+                history.loss_curve.extend(
+                    np.asarray(jnp.stack(device_losses), np.float64).tolist())
+                device_losses.clear()
+
+        try:
+            for _ in range(epochs):
+                for item in iterator:
+                    if isinstance(item, MultiDataSet):
+                        feats, labs = list(item.features), list(item.labels)
+                    elif isinstance(item, DataSet):
+                        feats, labs = [item.features], [item.labels]
+                    else:
+                        feats, labs = [item[0]], [item[1]]
+                    feeds = {}
+                    feeds.update(zip(cfg.data_set_feature_mapping, feats))
+                    feeds.update(zip(cfg.data_set_label_mapping, labs))
+                    feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
+                    rng = sd._rng.next_key()
+                    var_vals, self.opt_state, loss = self._step(var_vals, self.opt_state, feeds, rng)
+                    # keep the loss ON DEVICE: a float() here would force a
+                    # host sync per step (~64 ms through the axon tunnel —
+                    # measured round 5: it tripled the imported-BERT train
+                    # step). One stacked fetch per epoch costs one sync.
+                    device_losses.append(loss)
+                flush_losses()
+        finally:
+            # an exception / KeyboardInterrupt mid-epoch must not lose the
+            # curve recorded so far — flush whatever is still on device
+            flush_losses()
         sd._values.update(var_vals)
         return history

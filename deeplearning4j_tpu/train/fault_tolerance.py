@@ -124,39 +124,83 @@ def _child_main() -> None:
     _resolve(ref)(resume, checkpoint_dir)
 
 
+def _spawn_child(entry_ref: str, checkpoint_dir: str, stall_timeout: float,
+                 env: Optional[dict]) -> int:
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from deeplearning4j_tpu.train.fault_tolerance import "
+         "_child_main; _child_main()",
+         "child", entry_ref, checkpoint_dir, str(stall_timeout)],
+        env={**os.environ, **(env or {})},
+    )
+    return proc.returncode
+
+
 def elastic_fit(entry_ref: str, checkpoint_dir: str, *,
                 max_restarts: int = 3, stall_timeout: float = 300.0,
                 env: Optional[dict] = None,
-                log_fn: Callable[[str], None] = print) -> dict:
+                retry_policy: Optional["RetryPolicy"] = None,
+                crash_loop_window: float = 600.0,
+                crash_loop_budget: Optional[int] = None,
+                log_fn: Callable[[str], None] = print,
+                spawn_fn: Optional[Callable[[], int]] = None,
+                sleep: Callable[[float], None] = time.sleep,
+                clock: Callable[[], float] = time.monotonic) -> dict:
     """Supervised training: run ``entry_ref`` ("module:function") in a child
     process; restart from the latest checkpoint on crash or stall.
+
+    Restart discipline (core/resilience.py): restarts back off
+    exponentially with seeded jitter (``retry_policy``) so a flaky fleet
+    doesn't hammer checkpoint storage, and a restart-budget-per-window
+    crash-loop detector (more than ``crash_loop_budget`` restarts inside
+    ``crash_loop_window`` seconds) gives up early — a child that dies
+    instantly on every boot must not burn all ``max_restarts`` at full
+    speed. ``spawn_fn``/``sleep``/``clock`` are injectable and the
+    ``elastic_fit.spawn`` FaultInjector site fires before every child
+    launch, so the whole recovery path is testable without subprocesses.
 
     Returns {"restarts": n, "events": [...], "ok": bool}. The entry function
     must attach CheckpointListener(checkpoint_dir, ...) and
     HeartbeatListener(checkpoint_dir) itself — it owns the model and data.
     """
+    from ..core.resilience import RetryPolicy, get_fault_injector
+
+    policy = retry_policy or RetryPolicy(
+        max_retries=max_restarts, initial_backoff=1.0, max_backoff=60.0)
+    budget = crash_loop_budget if crash_loop_budget is not None \
+        else max(2, max_restarts)
     os.makedirs(checkpoint_dir, exist_ok=True)
     events: List[dict] = []
+    restart_times: List[float] = []
     restarts = 0
     while True:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "from deeplearning4j_tpu.train.fault_tolerance import "
-             "_child_main; _child_main()",
-             "child", entry_ref, checkpoint_dir, str(stall_timeout)],
-            env={**os.environ, **(env or {})},
-        )
-        if proc.returncode == 0:
+        get_fault_injector().fire("elastic_fit.spawn")
+        rc = (spawn_fn or (lambda: _spawn_child(
+            entry_ref, checkpoint_dir, stall_timeout, env)))()
+        if rc == 0:
             events.append({"event": "completed", "restarts": restarts})
             return {"ok": True, "restarts": restarts, "events": events}
-        kind = "stall" if proc.returncode == STALL_EXIT_CODE else "crash"
+        kind = "stall" if rc == STALL_EXIT_CODE else "crash"
         hb = read_heartbeat(checkpoint_dir)
-        events.append({"event": kind, "rc": proc.returncode,
-                       "last_heartbeat": hb})
-        log_fn(f"elastic_fit: child {kind} (rc={proc.returncode}), "
-               f"last iteration "
+        events.append({"event": kind, "rc": rc, "last_heartbeat": hb})
+        log_fn(f"elastic_fit: child {kind} (rc={rc}), last iteration "
                f"{hb['iteration'] if hb else 'none'}")
         if restarts >= max_restarts:
             events.append({"event": "gave_up", "restarts": restarts})
             return {"ok": False, "restarts": restarts, "events": events}
+        now = clock()
+        restart_times = [t for t in restart_times
+                         if now - t <= crash_loop_window]
+        if len(restart_times) >= budget:
+            events.append({"event": "crash_loop", "restarts": restarts,
+                           "window_s": crash_loop_window, "budget": budget})
+            log_fn(f"elastic_fit: crash loop — {len(restart_times) + 1} "
+                   f"failures within {crash_loop_window}s, giving up")
+            return {"ok": False, "restarts": restarts, "events": events}
+        restart_times.append(now)
+        delay = policy.backoff(restarts)
+        events.append({"event": "backoff", "delay_s": delay})
+        log_fn(f"elastic_fit: restarting in {delay:.2f}s "
+               f"(restart {restarts + 1}/{max_restarts})")
+        sleep(delay)
         restarts += 1

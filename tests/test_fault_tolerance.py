@@ -102,11 +102,14 @@ _ENTRY = textwrap.dedent('''
 
 
 def test_elastic_fit_resumes_after_crash(tmp_path):
+    from deeplearning4j_tpu.core.resilience import RetryPolicy
+
     target = tmp_path / "elastic_target.py"
     target.write_text(_ENTRY)
     ckpt = str(tmp_path / "ckpt")
     result = elastic_fit(
         "elastic_target:train", ckpt, max_restarts=2, stall_timeout=120.0,
+        retry_policy=RetryPolicy(max_retries=2, initial_backoff=0.01),
         env={"PYTHONPATH": str(tmp_path) + os.pathsep
              + os.environ.get("PYTHONPATH", ""),
              "JAX_PLATFORMS": "cpu"},
@@ -114,12 +117,110 @@ def test_elastic_fit_resumes_after_crash(tmp_path):
     assert result["ok"], result
     assert result["restarts"] == 1  # crashed once, resumed, completed
     kinds = [e["event"] for e in result["events"]]
-    assert kinds == ["crash", "completed"]
+    assert kinds == ["crash", "backoff", "completed"]
     # the resumed run really continued past the crash point
     hb = read_heartbeat(ckpt)
     assert hb["iteration"] >= 30
     # and it resumed FROM the checkpoint (crash at >=12, checkpoints every 5)
     assert result["events"][0]["last_heartbeat"]["iteration"] >= 10
+
+
+class TestElasticRestartDiscipline:
+    """Restart backoff + crash-loop detection, fully deterministic: the
+    child is a ``spawn_fn`` stub, the clock is fake, sleeps are recorded.
+    No subprocesses, no wall-clock waits."""
+
+    @staticmethod
+    def _clock_sleep():
+        t = [0.0]
+        slept = []
+
+        def clock():
+            return t[0]
+
+        def sleep(dt):
+            slept.append(dt)
+            t[0] += dt
+
+        return t, slept, clock, sleep
+
+    def test_backoff_between_restarts_is_exponential(self, tmp_path):
+        from deeplearning4j_tpu.core.resilience import RetryPolicy
+
+        _, slept, clock, sleep = self._clock_sleep()
+        result = elastic_fit(
+            "unused:train", str(tmp_path), max_restarts=3,
+            retry_policy=RetryPolicy(max_retries=3, initial_backoff=1.0,
+                                     multiplier=2.0, jitter=0.0),
+            crash_loop_window=0.0,      # window disabled: nothing ever counts
+            spawn_fn=lambda: 1, sleep=sleep, clock=clock,
+            log_fn=lambda m: None)
+        assert not result["ok"]
+        assert result["events"][-1]["event"] == "gave_up"
+        assert slept == [1.0, 2.0, 4.0]
+
+    def test_crash_loop_gives_up_before_max_restarts(self, tmp_path):
+        spawns = []
+        _, slept, clock, sleep = self._clock_sleep()
+        result = elastic_fit(
+            "unused:train", str(tmp_path), max_restarts=50,
+            crash_loop_window=600.0, crash_loop_budget=3,
+            spawn_fn=lambda: spawns.append(1) or 1, sleep=sleep, clock=clock,
+            log_fn=lambda m: None)
+        assert not result["ok"]
+        assert result["events"][-1]["event"] == "crash_loop"
+        assert result["restarts"] == 3      # budget, nowhere near 50
+        assert len(spawns) == 4             # initial + 3 restarts
+
+    def test_slow_failures_outside_window_use_full_budget(self, tmp_path):
+        t, _, clock, _ = self._clock_sleep()
+
+        def slow_sleep(dt):  # each restart lands outside the loop window
+            t[0] += 1000.0
+
+        result = elastic_fit(
+            "unused:train", str(tmp_path), max_restarts=4,
+            crash_loop_window=600.0, crash_loop_budget=2,
+            spawn_fn=lambda: 1, sleep=slow_sleep, clock=clock,
+            log_fn=lambda m: None)
+        assert not result["ok"]
+        # failures were spread out -> no crash loop, the full restart
+        # budget was spent before giving up
+        assert result["events"][-1]["event"] == "gave_up"
+        assert result["restarts"] == 4
+
+    def test_recovery_after_transient_crashes(self, tmp_path):
+        rcs = iter([1, 86, 0])  # crash, stall, then success
+        _, slept, clock, sleep = self._clock_sleep()
+        result = elastic_fit(
+            "unused:train", str(tmp_path), max_restarts=5,
+            spawn_fn=lambda: next(rcs), sleep=sleep, clock=clock,
+            log_fn=lambda m: None)
+        assert result["ok"] and result["restarts"] == 2
+        kinds = [e["event"] for e in result["events"]]
+        assert kinds == ["crash", "backoff", "stall", "backoff", "completed"]
+        assert len(slept) == 2
+
+    def test_fault_injector_spawn_site_is_live(self, tmp_path):
+        from deeplearning4j_tpu.core.resilience import (
+            FaultInjector, set_fault_injector)
+
+        inj = FaultInjector()
+        inj.inject_error("elastic_fit.spawn",
+                         lambda: RuntimeError("injected supervisor fault"),
+                         times=1)
+        prev = set_fault_injector(inj)
+        try:
+            with pytest.raises(RuntimeError, match="injected supervisor"):
+                elastic_fit("unused:train", str(tmp_path),
+                            spawn_fn=lambda: 0, log_fn=lambda m: None)
+        finally:
+            set_fault_injector(prev)
+        assert inj.fired("elastic_fit.spawn") == 1
+        # with the plan exhausted the supervisor runs normally
+        result = elastic_fit("unused:train", str(tmp_path),
+                             spawn_fn=lambda: 0, log_fn=lambda m: None)
+        assert result["ok"]
 
 
 def test_watchdog_ignores_stale_heartbeat_on_restart(tmp_path):

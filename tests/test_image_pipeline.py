@@ -323,6 +323,13 @@ def test_uint8_netpbm_parser_comments_maxval_trailing(tmp_path):
     r2 = ImageRecordReader(8, 8, 3, root=str(tmp_path), output_dtype="uint8")
     got2 = next(iter(r2))[0]
     assert got2.max() > 200  # rescaled toward 255
+    # ROUNDED rescale: the uint8 fast path must match the float decoder
+    # within rounding (ADVICE round-5 item 2 — floor division diverged
+    # by up to 1 LSB)
+    rf = ImageRecordReader(8, 8, 3, root=str(tmp_path),
+                           output_dtype="float32")
+    fgot = next(iter(rf))[0]  # [0,1] floats
+    np.testing.assert_array_equal(got2, np.rint(fgot * 255).astype(np.uint8))
     # 16-bit rejected loudly
     (tmp_path / "a" / "x.ppm").write_bytes(
         b"P6 8 8 65535\n" + (b"\0" * (8 * 8 * 3 * 2)))

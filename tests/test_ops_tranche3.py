@@ -567,6 +567,35 @@ def test_dynamic_stitch_last_wins():
     np.testing.assert_allclose(np.asarray(got), [[9.0], [2.0]])
 
 
+def test_dynamic_stitch_concrete_gaps_and_duplicates_no_size():
+    """TF semantics without size=: n = max(indices)+1, gaps stay zero,
+    duplicates keep last-wins (ADVICE round-5 item 1 — TF-imported graphs
+    legally use gaps/duplicates and the importer cannot pass size=)."""
+    op = get_sd_op("dynamic_stitch")
+    # gap: index 1 never written -> zero row, length = max+1 = 4
+    got = op([jnp.asarray([0, 2]), jnp.asarray([3])],
+             jnp.asarray([[1.0], [3.0]]), jnp.asarray([[7.0]]))
+    np.testing.assert_allclose(np.asarray(got), [[1.0], [0.0], [3.0], [7.0]])
+    # duplicate across lists: later list wins
+    got = op([jnp.asarray([0, 1]), jnp.asarray([0])],
+             jnp.asarray([[1.0], [2.0]]), jnp.asarray([[9.0]]))
+    np.testing.assert_allclose(np.asarray(got), [[9.0], [2.0]])
+
+
+def test_dynamic_stitch_traced_indices_require_size():
+    op = get_sd_op("dynamic_stitch")
+
+    def stitched(idx):
+        return op([idx], jnp.asarray([[1.0], [2.0]]))
+
+    with pytest.raises(ValueError, match="traced indices"):
+        jax.jit(stitched)(jnp.asarray([0, 1]))
+    # with size= the traced form works
+    out = jax.jit(lambda idx: op([idx], jnp.asarray([[1.0], [2.0]]),
+                                 size=2))(jnp.asarray([1, 0]))
+    np.testing.assert_allclose(np.asarray(out), [[2.0], [1.0]])
+
+
 def test_fake_quant_vars_jittable():
     f = jax.jit(lambda x, lo, hi:
                 get_sd_op("fake_quant_with_min_max_vars")(x, lo, hi))

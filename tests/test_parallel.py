@@ -225,6 +225,134 @@ class TestFitRechunking:
         assert trainer.dropped_rows == 0
 
 
+class TestParallelInferenceResilience:
+    """Overload + failure paths (core/resilience.py), all deterministic:
+    the worker is parked on an Event via injected latency, the breaker
+    runs on a fake clock, and faults come from a seeded FaultInjector."""
+
+    def _pi(self, **kw):
+        from deeplearning4j_tpu.core.resilience import FaultInjector
+        import threading
+
+        entered = threading.Event()   # worker reached the forward site
+        release = threading.Event()   # test lets the worker proceed
+
+        def gate_sleep(_seconds):
+            entered.set()
+            assert release.wait(timeout=10), "test never released the worker"
+
+        inj = FaultInjector(sleep=gate_sleep)
+        kw.setdefault("workers", 1)
+        kw.setdefault("batch_limit", 1)
+        pi = ParallelInference(_mlp(), fault_injector=inj, **kw)
+        return pi, inj, entered, release
+
+    def test_queue_full_sheds_fail_fast(self):
+        from deeplearning4j_tpu.core.resilience import AdmissionRejectedError
+        from deeplearning4j_tpu.parallel.inference import FORWARD_SITE
+
+        pi, inj, entered, release = self._pi(queue_limit=2)
+        inj.inject_latency(FORWARD_SITE, 1.0, times=1)
+        x, _ = _data(4)
+        try:
+            f1 = pi.output_async(x[0])          # worker parks on this one
+            assert entered.wait(timeout=10)
+            f2 = pi.output_async(x[1])          # fills the pending window
+            with pytest.raises(AdmissionRejectedError):
+                pi.output_async(x[2])           # shed NOW, no blocking
+        finally:
+            release.set()
+        f1.result(timeout=10)
+        f2.result(timeout=10)
+        s = pi.stats()
+        assert s["accepted"] == 2 and s["shed"] == 1
+        assert s["completed"] == 2
+        pi.shutdown()
+
+    def test_deadline_expiry_in_queue_skips_forward(self):
+        from deeplearning4j_tpu.core.resilience import (
+            Deadline, DeadlineExceededError)
+        from deeplearning4j_tpu.parallel.inference import FORWARD_SITE
+
+        clk_t = [0.0]
+        pi, inj, entered, release = self._pi(
+            queue_limit=8, clock=lambda: clk_t[0])
+        inj.inject_latency(FORWARD_SITE, 1.0, times=1)
+        x, _ = _data(4)
+        try:
+            f1 = pi.output_async(x[0])
+            assert entered.wait(timeout=10)
+            f2 = pi.output_async(x[1], timeout=0.5)  # waits behind f1
+            clk_t[0] += 1.0                          # expires f2 in-queue
+        finally:
+            release.set()
+        f1.result(timeout=10)
+        with pytest.raises(DeadlineExceededError):
+            f2.result(timeout=10)
+        s = pi.stats()
+        assert s["timed_out"] == 1
+        assert s["batches"] == 1  # the expired request never cost a forward
+        pi.shutdown()
+
+    def test_circuit_opens_on_poisoned_forward_then_recovers(self):
+        from deeplearning4j_tpu.core.resilience import (
+            CircuitBreaker, CircuitOpenError, CircuitState, FaultInjector)
+        from deeplearning4j_tpu.parallel.inference import FORWARD_SITE
+
+        clk_t = [0.0]
+        clock = lambda: clk_t[0]  # noqa: E731
+        inj = FaultInjector()
+        inj.inject_error(FORWARD_SITE, lambda: RuntimeError("poisoned jit"),
+                         times=3)
+        breaker = CircuitBreaker(failure_threshold=1.0, min_calls=3,
+                                 window=8, open_timeout=5.0, clock=clock)
+        pi = ParallelInference(_mlp(), workers=1, batch_limit=1,
+                               circuit_breaker=breaker, clock=clock,
+                               fault_injector=inj)
+        x, _ = _data(4)
+        # three poisoned forwards trip the breaker at the threshold
+        for i in range(3):
+            with pytest.raises(RuntimeError, match="poisoned"):
+                pi.output(x[i])
+        assert pi.circuit_state is CircuitState.OPEN
+        with pytest.raises(CircuitOpenError) as ei:
+            pi.output_async(x[0])  # rejected at the door, nothing queued
+        assert ei.value.retry_after > 0
+        assert pi.stats()["circuit_rejected"] == 1
+        # after the open timeout one probe goes through and closes it
+        clk_t[0] += 5.0
+        assert pi.circuit_state is CircuitState.HALF_OPEN
+        out = pi.output(x[0])
+        assert np.all(np.isfinite(np.asarray(out)))
+        assert pi.circuit_state is CircuitState.CLOSED
+        assert pi.stats()["failed"] == 3
+        pi.shutdown()
+
+    def test_graceful_drain(self):
+        pi = ParallelInference(_mlp(), workers=2, batch_limit=4)
+        x, _ = _data(8)
+        futs = [pi.output_async(x[i]) for i in range(8)]
+        assert pi.drain(timeout=30)
+        assert all(f.done() for f in futs)
+        with pytest.raises(RuntimeError, match="draining"):
+            pi.output_async(x[0])
+        assert pi.stats()["draining"]
+        pi.shutdown()
+
+    def test_stats_snapshot_shape(self):
+        pi = ParallelInference(_mlp(), workers=1, batch_limit=8)
+        x, _ = _data(4)
+        pi.output(x)
+        s = pi.stats()
+        assert s["accepted"] == s["completed"] == 1
+        assert s["shed"] == s["timed_out"] == s["failed"] == 0
+        assert s["batches"] == 1 and s["max_batch_size"] == 4
+        assert s["mean_batch_size"] == pytest.approx(4.0)
+        assert s["circuit_state"] == "closed"
+        assert s["queue_depth"] == 0
+        pi.shutdown()
+
+
 class TestParallelInference:
     def test_batched_matches_direct(self):
         model = _mlp()

@@ -81,3 +81,244 @@ def test_unknown_path(server):
         urllib_request.urlopen(
             f"http://127.0.0.1:{srv.port}/nope", timeout=10)
     assert ei.value.code == 404
+
+
+# ---------------------------------------------------------------------------
+# Resilience: the status-code contract under overload, poison and drain
+# (README.md "Serving resilience"). All failure timing is deterministic —
+# the worker parks on an Event via injected latency, the breaker runs on a
+# fake clock.
+# ---------------------------------------------------------------------------
+def _small_model(seed=5):
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _gated_injector():
+    from deeplearning4j_tpu.core.resilience import FaultInjector
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    def gate_sleep(_seconds):
+        entered.set()
+        assert release.wait(timeout=10), "test never released the worker"
+
+    return FaultInjector(sleep=gate_sleep), entered, release
+
+
+def _post(port, payload, timeout=10):
+    req = urllib_request.Request(
+        f"http://127.0.0.1:{port}/v1/serving",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib_request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_overload_sheds_503_with_retry_after():
+    from deeplearning4j_tpu.parallel.inference import FORWARD_SITE
+
+    inj, entered, release = _gated_injector()
+    inj.inject_latency(FORWARD_SITE, 1.0, times=1)
+    srv = JsonModelServer(_small_model(), port=0, workers=1, batch_limit=1,
+                          queue_limit=2, fault_injector=inj).start()
+    try:
+        results = {}
+
+        def call(name):
+            try:
+                results[name] = _post(srv.port, {"data": [[1, 2, 3, 4]]})
+            except HTTPError as e:
+                results[name] = (e.code, dict(e.headers))
+
+        t1 = threading.Thread(target=call, args=("a",))
+        t1.start()
+        assert entered.wait(timeout=10)   # worker parked on request a
+        t2 = threading.Thread(target=call, args=("b",))
+        t2.start()                        # fills the pending window
+        # the window (2) is full: shed instantly, not queued behind a
+        import time as _time
+        for _ in range(100):              # b must be admitted first
+            if srv.stats()["accepted"] >= 2:
+                break
+            _time.sleep(0.01)
+        with pytest.raises(HTTPError) as ei:
+            _post(srv.port, {"data": [[1, 2, 3, 4]]})
+        assert ei.value.code == 503
+        assert float(ei.value.headers["Retry-After"]) > 0
+        body = json.loads(ei.value.read())
+        assert body["retryable"] is True
+        release.set()
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+        assert results["a"][0] == 200 and results["b"][0] == 200
+        assert srv.stats()["shed"] == 1
+    finally:
+        release.set()
+        srv.stop()
+
+
+def test_deadline_exceeded_maps_to_504():
+    from deeplearning4j_tpu.parallel.inference import FORWARD_SITE
+
+    inj, entered, release = _gated_injector()
+    inj.inject_latency(FORWARD_SITE, 1.0, times=1)
+    srv = JsonModelServer(_small_model(), port=0, workers=1, batch_limit=1,
+                          fault_injector=inj).start()
+    try:
+        t = threading.Thread(
+            target=lambda: _post(srv.port, {"data": [[1, 2, 3, 4]]}))
+        t.start()
+        assert entered.wait(timeout=10)
+        with pytest.raises(HTTPError) as ei:  # parked behind the first
+            _post(srv.port, {"data": [[1, 2, 3, 4]], "deadline_ms": 100})
+        assert ei.value.code == 504
+        release.set()
+        t.join(timeout=10)
+    finally:
+        release.set()
+        srv.stop()
+
+
+def test_poisoned_forward_opens_circuit_health_degrades_then_recovers():
+    from deeplearning4j_tpu.core.resilience import CircuitBreaker, FaultInjector
+    from deeplearning4j_tpu.parallel.inference import FORWARD_SITE
+
+    clk_t = [0.0]
+    inj = FaultInjector()
+    inj.inject_error(FORWARD_SITE, lambda: RuntimeError("poisoned jit"),
+                     times=2)
+    breaker = CircuitBreaker(failure_threshold=1.0, min_calls=2, window=4,
+                             open_timeout=60.0, clock=lambda: clk_t[0])
+    srv = JsonModelServer(_small_model(), port=0, workers=1, batch_limit=1,
+                          circuit_breaker=breaker, fault_injector=inj).start()
+    try:
+        # two poisoned forwards -> 500 each, which trips the breaker
+        for _ in range(2):
+            with pytest.raises(HTTPError) as ei:
+                _post(srv.port, {"data": [[1, 2, 3, 4]]})
+            assert ei.value.code == 500
+        # truthful health: degraded, 503 so load balancers rotate away
+        with pytest.raises(HTTPError) as ei:
+            urllib_request.urlopen(
+                f"http://127.0.0.1:{srv.port}/health", timeout=10)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["status"] == "degraded"
+        # requests fail fast with Retry-After while open
+        with pytest.raises(HTTPError) as ei:
+            _post(srv.port, {"data": [[1, 2, 3, 4]]})
+        assert ei.value.code == 503
+        assert float(ei.value.headers["Retry-After"]) > 0
+        # after the open timeout the next request is the probe and closes it
+        clk_t[0] += 60.0
+        code, body = _post(srv.port, {"data": [[1, 2, 3, 4]]})
+        assert code == 200 and len(body["output"][0]) == 3
+        with urllib_request.urlopen(
+                f"http://127.0.0.1:{srv.port}/health", timeout=10) as r:
+            payload = json.loads(r.read())
+        assert r.status == 200 and payload["status"] == "ok"
+    finally:
+        srv.stop()
+
+
+def test_client_retries_on_503_then_succeeds():
+    from deeplearning4j_tpu.core.resilience import RetryPolicy
+    from deeplearning4j_tpu.parallel.inference import FORWARD_SITE
+
+    inj, entered, release = _gated_injector()
+    inj.inject_latency(FORWARD_SITE, 1.0, times=1)
+    srv = JsonModelServer(_small_model(), port=0, workers=1, batch_limit=1,
+                          queue_limit=1, fault_injector=inj).start()
+    done = threading.Event()
+    try:
+        def first():
+            try:
+                _post(srv.port, {"data": [[1, 2, 3, 4]]})
+            finally:
+                done.set()
+
+        t = threading.Thread(target=first)
+        t.start()
+        assert entered.wait(timeout=10)  # window of 1 is now full
+
+        def unblocking_sleep(_seconds):
+            release.set()                # the "backoff" frees the server
+            assert done.wait(timeout=10)
+
+        client = JsonRemoteInference(
+            f"http://127.0.0.1:{srv.port}/v1/serving",
+            retry_policy=RetryPolicy(max_retries=3, initial_backoff=0.01,
+                                     seed=0),
+            sleep=unblocking_sleep)
+        out = client.predict(np.ones((1, 4), np.float32))
+        assert out.shape == (1, 3)
+        assert client.retries >= 1  # first attempt was shed with 503
+        t.join(timeout=10)
+    finally:
+        release.set()
+        srv.stop()
+
+
+def test_client_never_retries_400():
+    srv = JsonModelServer(_small_model(), port=0, workers=1).start()
+    try:
+        client = JsonRemoteInference(
+            f"http://127.0.0.1:{srv.port}/v1/serving")
+        with pytest.raises(ValueError):
+            # a string serializes fine client-side but cannot become a
+            # float32 array on the server -> 400, which must not retry
+            client.predict("not-a-tensor")
+        assert client.retries == 0
+    finally:
+        srv.stop()
+
+
+def test_stats_endpoint(server):
+    srv, _ = server
+    with urllib_request.urlopen(
+            f"http://127.0.0.1:{srv.port}/stats", timeout=10) as r:
+        s = json.loads(r.read())
+    assert s["circuit_state"] == "closed"
+    assert {"accepted", "shed", "timed_out", "failed",
+            "queue_depth"} <= set(s)
+
+
+def test_graceful_drain_on_stop():
+    from deeplearning4j_tpu.parallel.inference import FORWARD_SITE
+
+    inj, entered, release = _gated_injector()
+    inj.inject_latency(FORWARD_SITE, 1.0, times=1)
+    srv = JsonModelServer(_small_model(), port=0, workers=1, batch_limit=1,
+                          fault_injector=inj).start()
+    results = {}
+
+    def call():
+        results["inflight"] = _post(srv.port, {"data": [[1, 2, 3, 4]]})
+
+    t = threading.Thread(target=call)
+    t.start()
+    assert entered.wait(timeout=10)   # request accepted, worker parked
+    stopper = threading.Thread(target=srv.stop)
+    stopper.start()
+    import time as _time
+    for _ in range(100):              # wait until stop() flips to draining
+        if srv._draining:
+            break
+        _time.sleep(0.01)
+    with pytest.raises(HTTPError) as ei:  # health is truthful mid-drain
+        urllib_request.urlopen(
+            f"http://127.0.0.1:{srv.port}/health", timeout=10)
+    assert ei.value.code == 503
+    assert json.loads(ei.value.read())["status"] == "draining"
+    release.set()                     # in-flight work finishes, then teardown
+    stopper.join(timeout=15)
+    t.join(timeout=10)
+    assert results["inflight"][0] == 200
+    from urllib.error import URLError
+    with pytest.raises(URLError):     # fully stopped: connection refused
+        urllib_request.urlopen(
+            f"http://127.0.0.1:{srv.port}/health", timeout=2)

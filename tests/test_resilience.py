@@ -1,0 +1,300 @@
+"""core/resilience.py unit tests — every state machine driven by a fake
+clock and the seeded FaultInjector, no wall-clock sleeps."""
+
+import pytest
+
+from deeplearning4j_tpu.core.resilience import (
+    AdmissionController,
+    AdmissionRejectedError,
+    CircuitBreaker,
+    CircuitOpenError,
+    CircuitState,
+    Deadline,
+    DeadlineExceededError,
+    FaultInjector,
+    RetryPolicy,
+    get_fault_injector,
+    set_fault_injector,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+    def sleep(self, dt: float) -> None:  # a sleep that only moves the clock
+        self.t += dt
+
+
+# ---------------------------------------------------------------- Deadline
+class TestDeadline:
+    def test_remaining_and_expiry(self):
+        clk = FakeClock()
+        dl = Deadline.after(2.0, clock=clk)
+        assert dl.remaining() == pytest.approx(2.0)
+        assert not dl.expired()
+        clk.advance(2.5)
+        assert dl.expired()
+        assert dl.remaining() == pytest.approx(-0.5)
+        with pytest.raises(DeadlineExceededError):
+            dl.check("probe")
+
+    def test_unbounded(self):
+        dl = Deadline.never()
+        assert dl.remaining() is None
+        assert not dl.expired()
+        dl.check()  # never raises
+
+    def test_deadline_exceeded_is_timeout(self):
+        # ParallelInference contract: expired requests surface TimeoutError
+        assert issubclass(DeadlineExceededError, TimeoutError)
+
+
+# ------------------------------------------------------------- RetryPolicy
+class TestRetryPolicy:
+    def test_backoff_exponential_and_capped(self):
+        p = RetryPolicy(initial_backoff=0.1, multiplier=2.0, max_backoff=0.5,
+                        jitter=0.0)
+        assert [p.backoff(i) for i in range(4)] == \
+            pytest.approx([0.1, 0.2, 0.4, 0.5])
+
+    def test_seeded_jitter_deterministic_and_bounded(self):
+        a = [RetryPolicy(jitter=0.5, seed=7).backoff(i) for i in range(5)]
+        b = [RetryPolicy(jitter=0.5, seed=7).backoff(i) for i in range(5)]
+        assert a == b  # same seed -> same delays
+        for i, d in enumerate(a):
+            base = min(10.0, 0.1 * 2.0 ** i)
+            assert base * 0.5 <= d <= base
+
+    def test_execute_retries_then_succeeds(self):
+        clk = FakeClock()
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("down")
+            return "ok"
+
+        p = RetryPolicy(max_retries=3, initial_backoff=0.1, jitter=0.0)
+        assert p.execute(flaky, retry_on=(ConnectionError,),
+                         sleep=clk.sleep) == "ok"
+        assert len(calls) == 3
+        assert clk.t == pytest.approx(0.1 + 0.2)
+
+    def test_execute_exhausts_and_reraises(self):
+        p = RetryPolicy(max_retries=2, initial_backoff=0.01, jitter=0.0)
+        calls = []
+
+        def always():
+            calls.append(1)
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            p.execute(always, retry_on=(ConnectionError,),
+                      sleep=FakeClock().sleep)
+        assert len(calls) == 3  # 1 + 2 retries
+
+    def test_execute_never_retries_unlisted(self):
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise ValueError("malformed")
+
+        with pytest.raises(ValueError):
+            RetryPolicy().execute(bad, retry_on=(ConnectionError,),
+                                  sleep=FakeClock().sleep)
+        assert len(calls) == 1
+
+    def test_execute_respects_deadline(self):
+        clk = FakeClock()
+        p = RetryPolicy(max_retries=5, initial_backoff=2.0, jitter=0.0)
+
+        def always():
+            raise ConnectionError("down")
+
+        # 1s budget, 2s backoff: the retry cannot fit -> immediate re-raise
+        with pytest.raises(ConnectionError):
+            p.execute(always, retry_on=(ConnectionError,),
+                      deadline=Deadline.after(1.0, clock=clk),
+                      sleep=clk.sleep)
+        assert clk.t == 0.0  # never slept
+
+    def test_execute_honors_retry_after_hint(self):
+        clk = FakeClock()
+        delays = []
+
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 2:
+                raise CircuitOpenError(retry_after=3.0)
+            return "ok"
+
+        p = RetryPolicy(max_retries=2, initial_backoff=0.1, jitter=0.0)
+        p.execute(flaky, retry_on=(CircuitOpenError,), sleep=clk.sleep,
+                  on_retry=lambda a, e, d: delays.append(d))
+        assert delays == [3.0]  # server hint overrides the smaller backoff
+
+
+# ---------------------------------------------------------- CircuitBreaker
+def _breaker(clk, **kw):
+    kw.setdefault("failure_threshold", 0.5)
+    kw.setdefault("min_calls", 4)
+    kw.setdefault("open_timeout", 10.0)
+    return CircuitBreaker(clock=clk, **kw)
+
+
+class TestCircuitBreaker:
+    def test_stays_closed_below_min_calls(self):
+        cb = _breaker(FakeClock())
+        for _ in range(3):
+            cb.record_failure()
+        assert cb.state is CircuitState.CLOSED
+        assert cb.allow()
+
+    def test_opens_at_failure_rate(self):
+        cb = _breaker(FakeClock())
+        cb.record_success()
+        cb.record_success()
+        cb.record_failure()
+        assert cb.state is CircuitState.CLOSED
+        cb.record_failure()  # 2/4 = threshold
+        assert cb.state is CircuitState.OPEN
+        assert not cb.allow()
+        with pytest.raises(CircuitOpenError) as ei:
+            cb.check()
+        assert 0.0 < ei.value.retry_after <= 10.0
+
+    def test_half_open_probe_then_close(self):
+        clk = FakeClock()
+        cb = _breaker(clk)
+        for _ in range(4):
+            cb.record_failure()
+        assert cb.state is CircuitState.OPEN
+        clk.advance(10.0)
+        assert cb.state is CircuitState.HALF_OPEN
+        assert cb.allow()        # the single probe
+        assert not cb.allow()    # concurrent second call rejected
+        cb.record_success()
+        assert cb.state is CircuitState.CLOSED
+        # the window was reset: one failure must not instantly re-trip
+        cb.record_failure()
+        assert cb.state is CircuitState.CLOSED
+
+    def test_half_open_probe_failure_reopens(self):
+        clk = FakeClock()
+        cb = _breaker(clk)
+        for _ in range(4):
+            cb.record_failure()
+        clk.advance(10.0)
+        assert cb.allow()
+        cb.record_failure()
+        assert cb.state is CircuitState.OPEN
+        # fresh timeout: not half-open again until another full open_timeout
+        clk.advance(5.0)
+        assert cb.state is CircuitState.OPEN
+        clk.advance(5.0)
+        assert cb.state is CircuitState.HALF_OPEN
+
+    def test_call_wrapper_records(self):
+        cb = _breaker(FakeClock(), min_calls=2, failure_threshold=1.0)
+        assert cb.call(lambda: 42) == 42
+        with pytest.raises(RuntimeError):
+            cb.call(lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+        assert cb.state is CircuitState.CLOSED  # 1/2 failures < 1.0
+
+
+# ----------------------------------------------------- AdmissionController
+class TestAdmissionController:
+    def test_pending_cap_sheds(self):
+        ac = AdmissionController(max_pending=2)
+        ac.admit()
+        ac.admit()
+        with pytest.raises(AdmissionRejectedError):
+            ac.admit()
+        ac.release()
+        ac.admit()  # slot freed
+        assert ac.stats() == {"pending": 2, "admitted": 3, "shed": 1}
+
+    def test_token_bucket_rate_limit(self):
+        clk = FakeClock()
+        ac = AdmissionController(max_pending=100, rate=2.0, burst=2.0,
+                                 clock=clk)
+        assert ac.try_admit() and ac.try_admit()
+        assert not ac.try_admit()  # bucket empty
+        clk.advance(0.5)           # refills one token at 2/s
+        assert ac.try_admit()
+        assert not ac.try_admit()
+        assert ac.retry_after() == pytest.approx(0.5)
+
+    def test_burst_caps_refill(self):
+        clk = FakeClock()
+        ac = AdmissionController(max_pending=100, rate=10.0, burst=3.0,
+                                 clock=clk)
+        clk.advance(100.0)  # long idle must not bank unlimited tokens
+        got = sum(ac.try_admit() for _ in range(10))
+        assert got == 3
+
+
+# ------------------------------------------------------------ FaultInjector
+class TestFaultInjector:
+    def test_inert_by_default(self):
+        FaultInjector().fire("anywhere")  # no plan -> no-op
+
+    def test_error_times_budget(self):
+        inj = FaultInjector()
+        inj.inject_error("site", lambda: RuntimeError("boom"), times=2)
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                inj.fire("site")
+        inj.fire("site")  # exhausted -> inert
+        assert inj.fired("site") == 2
+
+    def test_probability_seeded_deterministic(self):
+        def run(seed):
+            inj = FaultInjector(seed=seed)
+            inj.inject_error("s", lambda: RuntimeError("x"), times=None,
+                             probability=0.5)
+            fired = []
+            for _ in range(20):
+                try:
+                    inj.fire("s")
+                    fired.append(0)
+                except RuntimeError:
+                    fired.append(1)
+            return fired
+
+        assert run(3) == run(3)           # replayable
+        assert 0 < sum(run(3)) < 20       # actually probabilistic
+
+    def test_latency_uses_injected_sleep(self):
+        slept = []
+        inj = FaultInjector(sleep=slept.append)
+        inj.inject_latency("slow", 0.25, times=1)
+        inj.fire("slow")
+        inj.fire("slow")
+        assert slept == [0.25]
+
+    def test_clear_site(self):
+        inj = FaultInjector()
+        inj.inject_error("a", lambda: RuntimeError("x"), times=None)
+        inj.clear("a")
+        inj.fire("a")
+
+    def test_global_injector_swap_and_restore(self):
+        mine = FaultInjector()
+        prev = set_fault_injector(mine)
+        try:
+            assert get_fault_injector() is mine
+        finally:
+            set_fault_injector(prev)
+        assert get_fault_injector() is prev

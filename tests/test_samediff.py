@@ -105,6 +105,45 @@ class TestTraining:
             np.asarray(sd._values[sd._names["w"]]), true_w, atol=0.1
         )
 
+    def test_loss_curve_survives_midfit_exception(self):
+        """An exception mid-fit must not lose the loss curve recorded so
+        far (ADVICE round-5 item 3): losses flush per epoch and in a
+        finally, and the session keeps the partial History."""
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(8, 3)).astype(np.float32)
+        Y = rng.normal(size=(8, 1)).astype(np.float32)
+
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (None, 3))
+        label = sd.placeholder("label", (None, 1))
+        w = sd.var("w", np.zeros((3, 1), np.float32))
+        pred = (x @ w).rename("pred")
+        sd.loss.mean_squared_error(label, pred).rename("loss")
+        sd.set_loss_variables("loss")
+        cfg = TrainingConfig(
+            updater=Adam(1e-2),
+            data_set_feature_mapping=("x",),
+            data_set_label_mapping=("label",),
+        )
+
+        class ExplodingIterator:
+            """Yields 3 good batches, then simulates a data-source death."""
+
+            def __iter__(self):
+                def gen():
+                    for i in range(3):
+                        yield DataSet(X, Y)
+                    raise KeyboardInterrupt("data source died")
+
+                return gen()
+
+        with pytest.raises(KeyboardInterrupt):
+            sd.fit(ExplodingIterator(), cfg, epochs=1)
+        hist = sd._training.last_history
+        assert hist is not None
+        assert len(hist.loss_curve) == 3  # the 3 completed steps survived
+        assert all(np.isfinite(v) for v in hist.loss_curve)
+
 
 class TestSerde:
     def test_save_load_round_trip(self, tmp_path):

@@ -1,0 +1,17 @@
+"""Tier-1 wiring for tools/check_serving_contract.py: the serving
+status-code contract (README.md "Serving resilience") is enforced on
+every test run, not just when someone remembers to run the tool."""
+
+import os
+import sys
+
+_TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+
+
+def test_serving_contract_smoke():
+    sys.path.insert(0, _TOOLS)
+    try:
+        import check_serving_contract
+    finally:
+        sys.path.remove(_TOOLS)
+    assert check_serving_contract.main(log=lambda m: None) == 0

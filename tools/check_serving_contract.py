@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Serving status-code contract smoke check (README.md "Serving resilience").
+
+Boots a JsonModelServer on CPU, drives success, malformed input, overload,
+deadline expiry, a poisoned forward (circuit breaker), recovery and
+graceful drain, and asserts the HTTP contract:
+
+    200 success · 400 malformed · 503 shed/circuit-open/draining with
+    Retry-After · 504 deadline exceeded · truthful /health
+
+Deterministic: the worker parks on an Event via injected latency and the
+circuit breaker runs on a fake clock — no sleeps beyond scheduler noise.
+Runs standalone (``python tools/check_serving_contract.py``) and as a
+tier-1 pytest via tests/test_serving_contract.py, so the contract is
+enforced on every run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+from urllib import request as urllib_request
+from urllib.error import HTTPError, URLError
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def _post(port, payload, timeout=10):
+    req = urllib_request.Request(
+        f"http://127.0.0.1:{port}/v1/serving",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib_request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(port, path, timeout=10):
+    with urllib_request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _expect_http_error(fn, code, log, what):
+    try:
+        fn()
+    except HTTPError as e:
+        assert e.code == code, f"{what}: expected {code}, got {e.code}"
+        return e
+    raise AssertionError(f"{what}: expected HTTP {code}, request succeeded")
+
+
+def main(log=print) -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from deeplearning4j_tpu.core.resilience import CircuitBreaker, FaultInjector
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel.inference import FORWARD_SITE
+    from deeplearning4j_tpu.remote import JsonModelServer
+
+    conf = (NeuralNetConfiguration.builder().seed(5).list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    def gate_sleep(_seconds):
+        entered.set()
+        assert release.wait(timeout=10), "worker never released"
+
+    inj = FaultInjector(sleep=gate_sleep)
+    clk_t = [0.0]
+    # threshold 0.5 over a 4-call window: the two earlier successful
+    # forwards stay in the window, so two poisoned calls (2/4) trip it
+    breaker = CircuitBreaker(failure_threshold=0.5, min_calls=2, window=4,
+                             open_timeout=60.0, clock=lambda: clk_t[0])
+    srv = JsonModelServer(model, port=0, workers=1, batch_limit=1,
+                          queue_limit=2, circuit_breaker=breaker,
+                          fault_injector=inj).start()
+    port = srv.port
+    ok = [[1.0, 2.0, 3.0, 4.0]]
+    try:
+        # 1. healthy: 200 on POST, 200 ok on /health
+        code, body = _post(port, {"data": ok})
+        assert code == 200 and len(body["output"][0]) == 3, body
+        code, body = _get(port, "/health")
+        assert code == 200 and body["status"] == "ok", body
+        log("PASS success -> 200, /health ok")
+
+        # 2. malformed input: 400, body explains
+        e = _expect_http_error(
+            lambda: _post(port, {"wrong": 1}), 400, log, "missing data key")
+        e = _expect_http_error(
+            lambda: _post(port, {"data": "not-a-tensor"}), 400, log,
+            "non-numeric data")
+        log("PASS malformed -> 400")
+
+        # 3. deadline: park the worker; a queued request whose deadline
+        # cannot be met answers 504 (and keeps holding its queue slot
+        # until the worker expires it)
+        entered.clear()
+        release.clear()
+        inj.inject_latency(FORWARD_SITE, 1.0, times=1)
+        results = {}
+
+        def call(name):
+            try:
+                results[name] = _post(port, {"data": ok})
+            except HTTPError as err:
+                results[name] = (err.code, {})
+
+        t1 = threading.Thread(target=call, args=("a",))
+        t1.start()
+        assert entered.wait(timeout=10), "worker never reached forward"
+        _expect_http_error(
+            lambda: _post(port, {"data": ok, "deadline_ms": 100}), 504,
+            log, "deadline exceeded")
+
+        # 4. overload: the window (2) is now full (a + the expired
+        # request still queued) -> shed instantly with Retry-After
+        e = _expect_http_error(
+            lambda: _post(port, {"data": ok}), 503, log, "overload shed")
+        assert float(e.headers["Retry-After"]) > 0, "503 without Retry-After"
+        release.set()
+        t1.join(timeout=10)
+        assert results["a"][0] == 200, results
+        import time as _time
+        for _ in range(200):  # worker expires the dead request off-thread
+            if srv.stats()["timed_out"] >= 1:
+                break
+            _time.sleep(0.01)
+        assert srv.stats()["shed"] >= 1 and srv.stats()["timed_out"] >= 1
+        log("PASS deadline -> 504, overload -> 503 + Retry-After")
+
+        # 5. poisoned forward: circuit opens, health degrades, then recovers
+        inj.inject_error(FORWARD_SITE, lambda: RuntimeError("poisoned"),
+                         times=2)
+        for _ in range(2):
+            _expect_http_error(
+                lambda: _post(port, {"data": ok}), 500, log,
+                "poisoned forward")
+        e = _expect_http_error(
+            lambda: _get(port, "/health"), 503, log, "degraded health")
+        assert json.loads(e.read())["status"] == "degraded"
+        e = _expect_http_error(
+            lambda: _post(port, {"data": ok}), 503, log, "circuit open")
+        assert float(e.headers["Retry-After"]) > 0
+        clk_t[0] += 60.0  # open timeout elapses -> probe closes the breaker
+        code, _ = _post(port, {"data": ok})
+        assert code == 200, "probe after open timeout should succeed"
+        code, body = _get(port, "/health")
+        assert code == 200 and body["status"] == "ok", body
+        log("PASS poisoned forward -> circuit open 503, degraded health, "
+            "recovery observed")
+
+        # 6. graceful drain: in-flight completes, draining answers 503
+        entered.clear()
+        release.clear()
+        inj.inject_latency(FORWARD_SITE, 1.0, times=1)
+        t3 = threading.Thread(target=call, args=("inflight",))
+        t3.start()
+        assert entered.wait(timeout=10)
+        stopper = threading.Thread(target=srv.stop)
+        stopper.start()
+        for _ in range(200):
+            if srv._draining:
+                break
+            _time.sleep(0.01)
+        e = _expect_http_error(
+            lambda: _get(port, "/health"), 503, log, "draining health")
+        assert json.loads(e.read())["status"] == "draining"
+        release.set()
+        stopper.join(timeout=15)
+        t3.join(timeout=10)
+        assert results["inflight"][0] == 200, \
+            "in-flight request must finish during drain"
+        try:
+            _get(port, "/health", timeout=2)
+            raise AssertionError("server still answering after stop()")
+        except URLError:
+            pass
+        log("PASS drain -> in-flight 200, draining 503, then closed")
+    finally:
+        release.set()
+        try:
+            srv.stop()
+        except Exception:
+            pass
+    log("serving contract OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
