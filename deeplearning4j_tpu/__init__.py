@@ -10,6 +10,6 @@ all architected TPU-first rather than translated (see SURVEY.md §7).
 
 __version__ = "0.1.0"
 
-from . import core
+from . import core, obs
 
-__all__ = ["core", "__version__"]
+__all__ = ["core", "obs", "__version__"]

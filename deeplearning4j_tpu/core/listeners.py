@@ -30,6 +30,13 @@ class TrainingListener:
     # (forces them to be fetched; keep False for scalar-only listeners).
     requires_arrays: bool = False
 
+    # Whether this listener needs the per-iteration score. Loops that keep
+    # the loss on device (samediff TrainingSession, DistributedTrainer)
+    # only pay the per-step device→host fetch when some attached listener
+    # requires it; otherwise they pass NaN. MetricsListener (obs/) sets
+    # this False — step latency and examples/sec need no loss value.
+    requires_score: bool = True
+
 
 class ListenerBus:
     def __init__(self, listeners: Optional[Sequence[TrainingListener]] = None) -> None:
@@ -47,6 +54,10 @@ class ListenerBus:
     @property
     def requires_arrays(self) -> bool:
         return any(l.requires_arrays for l in self.listeners)
+
+    @property
+    def requires_score(self) -> bool:
+        return any(getattr(l, "requires_score", True) for l in self.listeners)
 
     def epoch_start(self, model: Any) -> None:
         for l in self.listeners:

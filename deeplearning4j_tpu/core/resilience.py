@@ -125,6 +125,10 @@ class RetryPolicy:
         self.max_backoff = float(max_backoff)
         self.jitter = float(jitter)
         self._rng = random.Random(seed)
+        # standing retry observer: fn(attempt, exc, delay) on every retry
+        # (in addition to any per-execute on_retry). Lets obs wiring count
+        # attempts without threading a callback through each call site.
+        self.observer: Optional[Callable[[int, BaseException, float], None]] = None
 
     def backoff(self, attempt: int) -> float:
         """Delay before retry ``attempt`` (0-based)."""
@@ -159,6 +163,8 @@ class RetryPolicy:
                     rem = deadline.remaining()
                     if rem is not None and delay >= rem:
                         raise  # the retry cannot complete in time
+                if self.observer is not None:
+                    self.observer(attempt, e, delay)
                 if on_retry is not None:
                     on_retry(attempt, e, delay)
                 sleep(delay)
@@ -201,17 +207,51 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._half_open_inflight = 0
         self._lock = threading.Lock()
+        # state-transition observers: fn(old_state, new_state). Transitions
+        # happen under self._lock, so notifications are buffered and fired
+        # AFTER release — an observer may safely call back into the breaker
+        # (e.g. to read retry_after) without deadlocking. No behavior
+        # change when no observer is registered.
+        self._observers: List[Callable[[CircuitState, CircuitState], None]] = []
+        self._pending_transitions: List[tuple] = []
+
+    def add_observer(
+            self, fn: Callable[[CircuitState, CircuitState], None]) -> None:
+        """Register ``fn(old_state, new_state)`` for every transition."""
+        self._observers.append(fn)
+
+    def remove_observer(self, fn) -> None:
+        try:
+            self._observers.remove(fn)
+        except ValueError:
+            pass
+
+    def _set_state(self, new: CircuitState) -> None:
+        """Transition under the lock; queue the observer notification."""
+        old = self._state
+        self._state = new
+        if self._observers and old is not new:
+            self._pending_transitions.append((old, new))
+
+    def _notify(self) -> None:
+        """Drain queued transitions — call with the lock RELEASED."""
+        while self._pending_transitions:
+            old, new = self._pending_transitions.pop(0)
+            for fn in list(self._observers):
+                fn(old, new)
 
     @property
     def state(self) -> CircuitState:
         with self._lock:
             self._maybe_half_open()
-            return self._state
+            s = self._state
+        self._notify()
+        return s
 
     def _maybe_half_open(self) -> None:
         if (self._state is CircuitState.OPEN
                 and self._clock() - self._opened_at >= self.open_timeout):
-            self._state = CircuitState.HALF_OPEN
+            self._set_state(CircuitState.HALF_OPEN)
             self._half_open_inflight = 0
 
     def retry_after(self) -> float:
@@ -226,13 +266,15 @@ class CircuitBreaker:
         with self._lock:
             self._maybe_half_open()
             if self._state is CircuitState.CLOSED:
-                return True
-            if self._state is CircuitState.HALF_OPEN:
-                if self._half_open_inflight < self.half_open_max_calls:
+                ok = True
+            elif self._state is CircuitState.HALF_OPEN:
+                ok = self._half_open_inflight < self.half_open_max_calls
+                if ok:
                     self._half_open_inflight += 1
-                    return True
-                return False
-            return False
+            else:
+                ok = False
+        self._notify()
+        return ok
 
     def check(self) -> None:
         if not self.allow():
@@ -244,25 +286,26 @@ class CircuitBreaker:
                 self._half_open_inflight = max(0, self._half_open_inflight - 1)
                 # probe succeeded -> close with a clean window (old
                 # failures must not instantly re-trip the breaker)
-                self._state = CircuitState.CLOSED
+                self._set_state(CircuitState.CLOSED)
                 self._outcomes.clear()
             self._outcomes.append(True)
+        self._notify()
 
     def record_failure(self) -> None:
         with self._lock:
             self._outcomes.append(False)
             if self._state is CircuitState.HALF_OPEN:
                 self._trip()
-                return
-            if self._state is CircuitState.CLOSED:
+            elif self._state is CircuitState.CLOSED:
                 n = len(self._outcomes)
                 if n >= self.min_calls:
                     failures = sum(1 for ok in self._outcomes if not ok)
                     if failures / n >= self.failure_threshold:
                         self._trip()
+        self._notify()
 
     def _trip(self) -> None:
-        self._state = CircuitState.OPEN
+        self._set_state(CircuitState.OPEN)
         self._opened_at = self._clock()
         self._half_open_inflight = 0
 
@@ -302,6 +345,20 @@ class AdmissionController:
         self._shed = 0
         self._admitted = 0
         self._lock = threading.Lock()
+        # decision observers: fn(decision, pending) with decision in
+        # {"admitted", "shed"}, called AFTER the lock is released (an
+        # observer may read .pending/.stats()). No behavior change unset.
+        self._observers: List[Callable[[str, int], None]] = []
+
+    def add_observer(self, fn: Callable[[str, int], None]) -> None:
+        """Register ``fn(decision, pending)`` for every admit/shed call."""
+        self._observers.append(fn)
+
+    def remove_observer(self, fn) -> None:
+        try:
+            self._observers.remove(fn)
+        except ValueError:
+            pass
 
     @property
     def pending(self) -> int:
@@ -321,15 +378,20 @@ class AdmissionController:
             self._refill()
             if self._pending >= self.max_pending:
                 self._shed += 1
-                return False
-            if self.rate is not None:
-                if self._tokens < 1.0:
-                    self._shed += 1
-                    return False
-                self._tokens -= 1.0
-            self._pending += 1
-            self._admitted += 1
-            return True
+                admitted = False
+            elif self.rate is not None and self._tokens < 1.0:
+                self._shed += 1
+                admitted = False
+            else:
+                if self.rate is not None:
+                    self._tokens -= 1.0
+                self._pending += 1
+                self._admitted += 1
+                admitted = True
+            pending = self._pending
+        for fn in list(self._observers):
+            fn("admitted" if admitted else "shed", pending)
+        return admitted
 
     def admit(self) -> None:
         if not self.try_admit():

@@ -10,13 +10,18 @@ the jitted train step never waits on host→HBM transfer (double buffering).
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
+import time
 from typing import Callable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
 from .dataset import DataSet
+from ..obs.metrics import MetricsRegistry, get_registry
+
+_prefetch_seq = itertools.count()
 
 
 class DataSetIterator:
@@ -86,7 +91,19 @@ class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch with a bounded queue (reference:
     AsyncDataSetIterator; queue_size = reference's default 8). Optionally
     applies ``device_put_fn`` on the worker thread so batches land on device
-    before the consumer asks for them."""
+    before the consumer asks for them.
+
+    Observability (obs/): ``dl4j_tpu_data_*`` series with an ``instance``
+    label — prefetch queue depth + high-water mark, producer blocked time
+    (queue full: compute is the bottleneck, good) and consumer starvation
+    time (queue empty: INPUT is the bottleneck — the I/O↔compute overlap
+    signal the TPU-pod reports scrape fleet-wide). :meth:`stats` is the
+    per-instance view over the same children.
+
+    Shutdown: a consumer abandoning iteration mid-epoch calls
+    :meth:`close` (``reset`` does it implicitly) which stops and JOINS the
+    prefetch thread instead of leaking it behind a full queue.
+    """
 
     _SENTINEL = object()
 
@@ -95,37 +112,98 @@ class AsyncDataSetIterator(DataSetIterator):
         underlying: DataSetIterator,
         queue_size: int = 8,
         device_put_fn: Optional[Callable[[DataSet], DataSet]] = None,
+        registry: Optional[MetricsRegistry] = None,
+        name: Optional[str] = None,
     ) -> None:
         self.underlying = underlying
         self.queue_size = queue_size
         self.device_put_fn = device_put_fn
+        self.name = name or f"prefetch-{next(_prefetch_seq)}"
         self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._next_item = None
         self._started = False
+        self._stop = threading.Event()
+        reg = registry if registry is not None else get_registry()
+        self.registry = reg
+        inst = self.name
+        self._g_depth = reg.gauge(
+            "dl4j_tpu_data_prefetch_queue_depth",
+            "Prefetched batches waiting for the consumer",
+            ("instance",)).labels(inst)
+        self._g_hwm = reg.gauge(
+            "dl4j_tpu_data_prefetch_queue_high_water",
+            "Prefetch queue depth high-water mark", ("instance",)).labels(inst)
+        self._c_batches = reg.counter(
+            "dl4j_tpu_data_prefetch_batches_total",
+            "Batches produced by the prefetch thread", ("instance",)).labels(inst)
+        self._c_blocked = reg.counter(
+            "dl4j_tpu_data_producer_blocked_seconds_total",
+            "Time the prefetch thread waited on a full queue "
+            "(compute-bound — the healthy direction)", ("instance",)).labels(inst)
+        self._c_starved = reg.counter(
+            "dl4j_tpu_data_consumer_starvation_seconds_total",
+            "Time the consumer waited on an empty queue "
+            "(input-bound — the I/O bottleneck signal)", ("instance",)).labels(inst)
 
-    def _worker(self) -> None:
+    def _put(self, item, stop: threading.Event) -> bool:
+        """Bounded put that gives up when ``stop`` is set (an abandoned
+        consumer never drains the queue, so a plain put() would park the
+        thread forever). Returns False when aborted."""
+        q = self._queue
         try:
-            while self.underlying.has_next():
+            q.put_nowait(item)
+            return True
+        except queue.Full:
+            pass
+        t0 = time.perf_counter()
+        try:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+        finally:
+            self._c_blocked.inc(time.perf_counter() - t0)
+
+    def _worker(self, stop: threading.Event) -> None:
+        try:
+            while not stop.is_set() and self.underlying.has_next():
                 item = self.underlying.next()
                 if self.device_put_fn is not None:
                     item = self.device_put_fn(item)
-                self._queue.put(item)
+                if not self._put(item, stop):
+                    return
+                self._c_batches.inc()
+                depth = self._queue.qsize()
+                self._g_depth.set(depth)
+                self._g_hwm.set_max(depth)
         except BaseException as e:  # propagate to consumer
             self._error = e
         finally:
-            self._queue.put(self._SENTINEL)
+            self._put(self._SENTINEL, stop)
 
     def _ensure_started(self) -> None:
         if not self._started:
-            self._thread = threading.Thread(target=self._worker, daemon=True)
+            self._thread = threading.Thread(
+                target=self._worker, args=(self._stop,),
+                name=f"dsi-{self.name}", daemon=True)
             self._thread.start()
             self._started = True
             self._advance()
 
     def _advance(self) -> None:
-        item = self._queue.get()
+        q = self._queue
+        try:
+            item = q.get_nowait()
+        except queue.Empty:
+            t0 = time.perf_counter()
+            item = q.get()
+            self._c_starved.inc(time.perf_counter() - t0)
+        self._g_depth.set(q.qsize())
         if item is self._SENTINEL:
             if self._error is not None:
                 raise self._error
@@ -145,17 +223,45 @@ class AsyncDataSetIterator(DataSetIterator):
         self._advance()
         return item
 
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop and join the prefetch thread WITHOUT consuming the rest of
+        the epoch. Safe to call any time; idempotent. The old behavior
+        (drain-to-exhaustion on reset) both leaked the thread behind a
+        full queue and forced the whole underlying epoch to be produced."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            deadline = time.monotonic() + timeout
+            while t.is_alive() and time.monotonic() < deadline:
+                try:
+                    self._queue.get_nowait()  # unblock a parked put
+                except queue.Empty:
+                    pass
+                t.join(timeout=0.05)
+        self._thread = None
+        self._started = False
+        self._next_item = None
+        self._g_depth.set(0)
+
     def reset(self) -> None:
-        if self._thread is not None and self._thread.is_alive():
-            # drain so the worker can exit
-            while self._next_item is not None:
-                self._advance()
-            self._thread.join(timeout=5)
+        self.close()
         self.underlying.reset()
         self._queue = queue.Queue(maxsize=self.queue_size)
+        self._stop = threading.Event()
         self._error = None
         self._started = False
         self._next_item = None
+
+    def stats(self) -> dict:
+        """Per-instance view over the registry children (one source of
+        truth; see README "Observability")."""
+        return {
+            "queue_depth": self._queue.qsize(),
+            "queue_high_water": int(self._g_hwm.value),
+            "batches": int(self._c_batches.value),
+            "producer_blocked_s": float(self._c_blocked.value),
+            "consumer_starvation_s": float(self._c_starved.value),
+        }
 
     def batch_size(self) -> int:
         return self.underlying.batch_size()

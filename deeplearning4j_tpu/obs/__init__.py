@@ -1,0 +1,43 @@
+"""Process-wide observability: metrics registry, Prometheus exposition,
+trace spans, and the training-listener bridge.
+
+One registry (default process-global, injectable everywhere) is the single
+source of truth for serving (``ParallelInference``, ``JsonModelServer``),
+resilience (circuit/admission/retry/elastic_fit), training
+(:class:`MetricsListener`), and data (``AsyncDataSetIterator``) signals;
+``GET /metrics`` on ``JsonModelServer`` and ``UIServer`` exposes it in
+Prometheus text format 0.0.4. See README "Observability" for the metric
+naming convention and the ``stats()`` ↔ metrics mapping.
+"""
+
+from .listener import MetricsListener
+from .metrics import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricError,
+    MetricsRegistry,
+    Span,
+    get_registry,
+    set_registry,
+    trace,
+)
+from .prom import CONTENT_TYPE as PROM_CONTENT_TYPE
+from .prom import render_prometheus
+
+__all__ = [
+    "Counter",
+    "DEFAULT_BUCKETS",
+    "Gauge",
+    "Histogram",
+    "MetricError",
+    "MetricsListener",
+    "MetricsRegistry",
+    "PROM_CONTENT_TYPE",
+    "Span",
+    "get_registry",
+    "render_prometheus",
+    "set_registry",
+    "trace",
+]

@@ -1,0 +1,82 @@
+"""MetricsListener — the training-loop → metrics-registry bridge.
+
+Built on the :class:`~deeplearning4j_tpu.core.listeners.TrainingListener`
+SPI (the framework's one metrics bus), so it attaches to anything that
+drives a ``ListenerBus``: ``MultiLayerNetwork.fit``,
+``DistributedTrainer.fit``, and samediff ``TrainingSession.fit``.
+
+It declares ``requires_score = False``: step latency and examples/sec need
+no loss value, so attaching ONLY this listener must not force the per-step
+device→host loss fetch the training loops otherwise avoid (measured round
+5: ~64 ms per sync through the axon tunnel). Loops that honor
+``ListenerBus.requires_score`` pass NaN instead, and the score gauge
+simply skips NaN.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from ..core.listeners import TrainingListener
+from .metrics import MetricsRegistry, get_registry
+
+# Training steps range from sub-ms (tiny CPU tests) to seconds (pod-scale
+# BERT), so the default latency buckets fit; examples/sec is derived by
+# the scraper as rate(examples_total)/rate(step_latency_count).
+_STEP_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+class MetricsListener(TrainingListener):
+    """Feeds ``dl4j_tpu_training_*`` series from iteration callbacks.
+
+    Series: ``iterations_total``, ``examples_total`` (from the model's
+    ``last_batch_size``), ``epochs_total``, ``step_latency_seconds``
+    (wall time between consecutive ``iteration_done`` calls — the full
+    step including data wait, which is the fleet-level signal), and a
+    ``score`` gauge updated whenever a real (non-NaN) score arrives.
+    """
+
+    requires_score = False
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+        reg = registry if registry is not None else get_registry()
+        self.registry = reg
+        self._iterations = reg.counter(
+            "dl4j_tpu_training_iterations_total",
+            "Completed training iterations (optimizer steps)")
+        self._examples = reg.counter(
+            "dl4j_tpu_training_examples_total",
+            "Training examples consumed (rows across all iterations)")
+        self._epochs = reg.counter(
+            "dl4j_tpu_training_epochs_total", "Completed training epochs")
+        self._step_latency = reg.histogram(
+            "dl4j_tpu_training_step_latency_seconds",
+            "Wall time between consecutive training iterations",
+            buckets=_STEP_BUCKETS)
+        self._score = reg.gauge(
+            "dl4j_tpu_training_score", "Most recent training score (loss)")
+        self._last_t: Optional[float] = None
+
+    def on_epoch_start(self, model: Any) -> None:
+        # epoch boundaries include eval/checkpoint time; don't let that
+        # masquerade as one huge training step
+        self._last_t = None
+
+    def on_epoch_end(self, model: Any) -> None:
+        self._epochs.inc()
+        self._last_t = None
+
+    def iteration_done(self, model: Any, iteration: int, epoch: int,
+                       score: float) -> None:
+        now = time.perf_counter()
+        if self._last_t is not None:
+            self._step_latency.observe(now - self._last_t)
+        self._last_t = now
+        self._iterations.inc()
+        batch = getattr(model, "last_batch_size", None)
+        if batch:
+            self._examples.inc(batch)
+        if score == score:  # skip NaN (loop ran with requires_score=False)
+            self._score.set(float(score))

@@ -15,11 +15,20 @@ request carries a :class:`Deadline` that is checked before it costs a
 forward, the forward sits behind a :class:`CircuitBreaker` so a poisoned
 jit fails fast instead of burning a device dispatch per queued request,
 and :meth:`stats` exposes the counters a load balancer needs.
+
+Observability (obs/): every counter lives in a
+:class:`~deeplearning4j_tpu.obs.metrics.MetricsRegistry` (default: the
+process-global one, injectable for hermetic tests) under
+``dl4j_tpu_inference_*`` / ``dl4j_tpu_resilience_*`` with an ``instance``
+label, so N engines in one process scrape as distinct series while
+:meth:`stats` stays an exact per-instance view over the same registry —
+one source of truth, two read paths.
 """
 
 from __future__ import annotations
 
 import enum
+import itertools
 import queue
 import threading
 import time
@@ -40,8 +49,15 @@ from ..core.resilience import (
     DeadlineExceededError,
     get_fault_injector,
 )
+from ..obs.metrics import MetricsRegistry, Span, get_registry
 
 FORWARD_SITE = "parallel_inference.forward"  # FaultInjector site name
+
+_OUTCOMES = ("accepted", "shed", "timed_out", "failed", "completed",
+             "circuit_rejected")
+_CIRCUIT_CODE = {CircuitState.CLOSED: 0, CircuitState.OPEN: 1,
+                 CircuitState.HALF_OPEN: 2}
+_instance_seq = itertools.count()
 
 
 class InferenceMode(enum.Enum):
@@ -83,6 +99,8 @@ class ParallelInference:
         admission: Optional[AdmissionController] = None,
         clock: Callable[[], float] = time.monotonic,
         fault_injector=None,
+        registry: Optional[MetricsRegistry] = None,
+        name: Optional[str] = None,
     ) -> None:
         self.model = model
         self.mode = inference_mode
@@ -90,6 +108,7 @@ class ParallelInference:
         self.default_timeout = default_timeout
         self._clock = clock
         self._fault_injector = fault_injector
+        self.name = name or f"pi-{next(_instance_seq)}"
         # the queue itself is unbounded: backpressure is the admission
         # controller's job, and it answers NOW instead of blocking the
         # caller until a slot frees up
@@ -99,10 +118,8 @@ class ParallelInference:
         self._breaker = circuit_breaker or CircuitBreaker(clock=clock)
         self._lock = threading.Lock()
         self._stats_lock = threading.Lock()
-        self._counts = {"accepted": 0, "shed": 0, "timed_out": 0,
-                        "failed": 0, "completed": 0, "circuit_rejected": 0,
-                        "batches": 0, "batch_rows": 0, "max_batch": 0}
         self._idle = threading.Condition(self._stats_lock)
+        self._init_metrics(registry if registry is not None else get_registry())
 
         params, state = model.params, model.state
 
@@ -121,6 +138,61 @@ class ParallelInference:
 
     def _inj(self):
         return self._fault_injector or get_fault_injector()
+
+    # ----- metrics ----------------------------------------------------
+    def _init_metrics(self, reg: MetricsRegistry) -> None:
+        """Carve this instance's children out of the (shared) registry.
+        All outcome children are pre-created so every series exists at 0
+        from the first scrape, and increments are a held-reference
+        ``child.inc()`` — no name/label resolution on the hot path."""
+        self.registry = reg
+        inst = self.name
+        req = reg.counter(
+            "dl4j_tpu_inference_requests_total",
+            "ParallelInference requests by outcome", ("instance", "outcome"))
+        self._c = {o: req.labels(inst, o) for o in _OUTCOMES}
+        self._g_queue = reg.gauge(
+            "dl4j_tpu_inference_queue_depth",
+            "Requests admitted but not yet settled", ("instance",)).labels(inst)
+        self._c_batches = reg.counter(
+            "dl4j_tpu_inference_batches_total",
+            "Forward passes executed (dynamic batches)", ("instance",)).labels(inst)
+        self._c_rows = reg.counter(
+            "dl4j_tpu_inference_batch_rows_total",
+            "Rows served across all batches", ("instance",)).labels(inst)
+        self._g_max_batch = reg.gauge(
+            "dl4j_tpu_inference_batch_size_max",
+            "Largest dynamic batch observed", ("instance",)).labels(inst)
+        self._h_forward = reg.histogram(
+            "dl4j_tpu_inference_forward_latency_seconds",
+            "Jitted forward latency per batch (including failures)",
+            ("instance",)).labels(inst)
+        self._g_circuit = reg.gauge(
+            "dl4j_tpu_resilience_circuit_state",
+            "Circuit breaker state: 0 closed, 1 open, 2 half-open",
+            ("instance",)).labels(inst)
+        transitions = reg.counter(
+            "dl4j_tpu_resilience_circuit_transitions_total",
+            "Circuit breaker state transitions",
+            ("instance", "from_state", "to_state"))
+        adm = reg.counter(
+            "dl4j_tpu_resilience_admission_decisions_total",
+            "Admission controller decisions", ("instance", "decision"))
+        self._adm_children = {d: adm.labels(inst, d)
+                              for d in ("admitted", "shed")}
+        self._g_circuit.set(_CIRCUIT_CODE[self._breaker.state])
+
+        def on_transition(old, new, _t=transitions, _inst=inst):
+            self._g_circuit.set(_CIRCUIT_CODE[new])
+            _t.labels(_inst, old.value, new.value).inc()
+
+        def on_admission(decision, _pending):
+            self._adm_children[decision].inc()
+
+        self._circuit_observer = on_transition
+        self._admission_observer = on_admission
+        self._breaker.add_observer(on_transition)
+        self._admission.add_observer(on_admission)
 
     # ----- client side ------------------------------------------------
     def output(self, x, *, timeout: Optional[float] = None) -> np.ndarray:
@@ -146,17 +218,15 @@ class ParallelInference:
                                    self._shutdown else
                                    "ParallelInference is draining")
             if self._breaker.state is CircuitState.OPEN:
-                with self._stats_lock:
-                    self._counts["circuit_rejected"] += 1
+                self._c["circuit_rejected"].inc()
                 raise CircuitOpenError(retry_after=self._breaker.retry_after())
             try:
                 self._admission.admit()
             except Exception:
-                with self._stats_lock:
-                    self._counts["shed"] += 1
+                self._c["shed"].inc()
                 raise
-            with self._stats_lock:
-                self._counts["accepted"] += 1
+            self._c["accepted"].inc()
+            self._g_queue.inc()
             self._queue.put(_Request(np.asarray(x), fut, deadline))
         return fut
 
@@ -164,6 +234,7 @@ class ParallelInference:
         """Admission + idle bookkeeping for ``n`` settled requests."""
         for _ in range(n):
             self._admission.release()
+        self._g_queue.dec(n)
         with self._idle:
             if self._admission.pending == 0:
                 self._idle.notify_all()
@@ -194,19 +265,24 @@ class ParallelInference:
                 self._queue.put(None)
         for t in self._threads:
             t.join(timeout=5)
+        # stop feeding shared-registry series; matters when the breaker or
+        # admission controller outlives this engine (caller-provided)
+        self._breaker.remove_observer(self._circuit_observer)
+        self._admission.remove_observer(self._admission_observer)
 
     def stats(self) -> dict:
-        """Snapshot for /stats and load-balancer decisions."""
-        with self._stats_lock:
-            counts = dict(self._counts)
-        batches = counts.pop("batches")
-        rows = counts.pop("batch_rows")
+        """Snapshot for /stats and load-balancer decisions — a per-instance
+        view over the metrics registry (the registry is the one source of
+        truth; this just reads this engine's children back out)."""
+        counts = {k: int(c.value) for k, c in self._c.items()}
+        batches = int(self._c_batches.value)
+        rows = int(self._c_rows.value)
         counts.update({
             "queue_depth": self._admission.pending,
             "circuit_state": self._breaker.state.value,
             "batches": batches,
             "mean_batch_size": (rows / batches) if batches else 0.0,
-            "max_batch_size": counts.pop("max_batch"),
+            "max_batch_size": int(self._g_max_batch.value),
             "draining": self._draining,
         })
         return counts
@@ -222,8 +298,7 @@ class ParallelInference:
             if not req.fut.done():
                 req.fut.set_exception(DeadlineExceededError(
                     "request expired in queue"))
-            with self._stats_lock:
-                self._counts["timed_out"] += 1
+            self._c["timed_out"].inc()
             self._finish()
             return True
         return False
@@ -259,8 +334,7 @@ class ParallelInference:
                 for req in batch:
                     if not req.fut.done():
                         req.fut.set_exception(err)
-                with self._stats_lock:
-                    self._counts["circuit_rejected"] += len(batch)
+                self._c["circuit_rejected"].inc(len(batch))
                 self._finish(len(batch))
                 continue
             try:
@@ -276,14 +350,15 @@ class ParallelInference:
                 if padded_n > n:
                     pad = np.repeat(cat[-1:], padded_n - n, axis=0)
                     cat = np.concatenate([cat, pad], axis=0)
-                self._inj().fire(FORWARD_SITE)
-                out = np.asarray(self._fwd(jnp.asarray(cat, self.model.dtype)))[:n]
+                with Span(self._h_forward):
+                    self._inj().fire(FORWARD_SITE)
+                    out = np.asarray(
+                        self._fwd(jnp.asarray(cat, self.model.dtype)))[:n]
                 self._breaker.record_success()
-                with self._stats_lock:
-                    self._counts["batches"] += 1
-                    self._counts["batch_rows"] += n
-                    self._counts["max_batch"] = max(self._counts["max_batch"], n)
-                    self._counts["completed"] += len(batch)
+                self._c_batches.inc()
+                self._c_rows.inc(n)
+                self._g_max_batch.set_max(n)
+                self._c["completed"].inc(len(batch))
                 off = 0
                 for req, sz in zip(batch, sizes):
                     res = out[off : off + sz]
@@ -293,8 +368,7 @@ class ParallelInference:
                     off += sz
             except Exception as e:  # propagate to all waiting callers
                 self._breaker.record_failure()
-                with self._stats_lock:
-                    self._counts["failed"] += len(batch)
+                self._c["failed"].inc(len(batch))
                 for req in batch:
                     if not req.fut.done():
                         req.fut.set_exception(e)

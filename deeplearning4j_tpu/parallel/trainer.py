@@ -265,6 +265,8 @@ class DistributedTrainer:
         elif first.shape[0] % n:
             raise ValueError(
                 f"batch {first.shape[0]} not divisible by data axis {n}")
+        model.last_batch_size = int(first.shape[0])  # PerformanceListener/
+        # MetricsListener read examples-per-iteration off the model
         x = self._put_data(x)
         y = self._put_data(y)
         rng = model._rng.next_key()
@@ -346,7 +348,13 @@ class DistributedTrainer:
         model = self.model
         model.iteration_count += 1
         if sync:
-            model.score_value = float(last)
+            if model.listeners.requires_score:
+                model.score_value = float(last)
+                score = model.score_value
+            else:
+                # score-free listeners (MetricsListener) must not force a
+                # per-step device→host fetch of the loss
+                score = float("nan")
             if model.listeners.requires_arrays:
                 # array-hungry listeners (StatsListener) must see the
                 # LIVE params, not the stale pre-fit model copy
@@ -354,7 +362,7 @@ class DistributedTrainer:
                 # the gradients section on this path)
                 self.sync_to_model()
             model.listeners.iteration_done(
-                model, model.iteration_count, model.epoch_count, model.score_value
+                model, model.iteration_count, model.epoch_count, score
             )
 
     def output(self, x) -> jax.Array:

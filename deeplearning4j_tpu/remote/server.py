@@ -24,10 +24,15 @@ Endpoints:
                    (200 when ok, 503 otherwise — load balancers key off
                    the code, humans off the body)
   GET  /stats    → ParallelInference counters snapshot
+  GET  /metrics  → Prometheus text exposition 0.0.4 of the server's
+                   registry (default: the process-global one, so one
+                   scrape sees serving + training + data metrics) —
+                   contract enforced by tools/check_metrics_contract.py
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import time
@@ -48,7 +53,13 @@ from ..core.resilience import (
     ResilienceError,
     RetryPolicy,
 )
+from ..obs.metrics import MetricsRegistry, get_registry
+from ..obs.prom import CONTENT_TYPE as _PROM_CONTENT_TYPE
+from ..obs.prom import render_prometheus
 from ..parallel.inference import InferenceMode, ParallelInference
+
+_server_seq = itertools.count()
+_client_seq = itertools.count()
 
 
 class ServiceUnavailableError(ResilienceError):
@@ -66,17 +77,32 @@ class JsonModelServer:
                  queue_limit: int = 256,
                  default_deadline: float = 30.0,
                  circuit_breaker=None, admission=None,
-                 clock=time.monotonic, fault_injector=None) -> None:
+                 clock=time.monotonic, fault_injector=None,
+                 registry: Optional[MetricsRegistry] = None,
+                 name: Optional[str] = None) -> None:
         self.model = model
         self.path = path
         self.default_deadline = float(default_deadline)
         self._clock = clock
         self._draining = False
+        self.name = name or f"server-{next(_server_seq)}"
+        self.registry = registry if registry is not None else get_registry()
         self._pi = ParallelInference(
             model, inference_mode=InferenceMode.BATCHED,
             batch_limit=batch_limit, workers=workers,
             queue_limit=queue_limit, circuit_breaker=circuit_breaker,
-            admission=admission, clock=clock, fault_injector=fault_injector)
+            admission=admission, clock=clock, fault_injector=fault_injector,
+            registry=self.registry, name=self.name)
+        # per-status-code request counters + end-to-end request latency,
+        # recorded once per POST in the handler's finally
+        self._req_counts = self.registry.counter(
+            "dl4j_tpu_serving_requests_total",
+            "Serving HTTP requests by status code", ("instance", "code"))
+        self._req_counts.labels(self.name, "200")  # exists from first scrape
+        self._req_latency = self.registry.histogram(
+            "dl4j_tpu_serving_request_latency_seconds",
+            "Serving request latency (parse through response)",
+            ("instance",)).labels(self.name)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -85,6 +111,7 @@ class JsonModelServer:
 
             def _send(self, code: int, payload: dict,
                       headers: Optional[dict] = None) -> None:
+                self._sent_code = code
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
@@ -104,6 +131,13 @@ class JsonModelServer:
                     self._send(code, status)
                 elif self.path == "/stats":
                     self._send(200, outer.stats())
+                elif self.path == "/metrics":
+                    body = render_prometheus(outer.registry).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", _PROM_CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self._send(404, {"error": f"unknown path {self.path}"})
 
@@ -116,6 +150,16 @@ class JsonModelServer:
                 return Deadline.after(seconds, clock=outer._clock)
 
             def do_POST(self):
+                t0 = time.perf_counter()
+                self._sent_code = None
+                try:
+                    self._handle_post()
+                finally:
+                    if self._sent_code is not None:
+                        outer._observe_request(
+                            self._sent_code, time.perf_counter() - t0)
+
+            def _handle_post(self):
                 if self.path != outer.path:
                     self._send(404, {"error": f"unknown path {self.path}"})
                     return
@@ -156,6 +200,10 @@ class JsonModelServer:
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
+
+    def _observe_request(self, code: int, seconds: float) -> None:
+        self._req_counts.labels(self.name, str(code)).inc()
+        self._req_latency.observe(seconds)
 
     def health(self) -> tuple:
         """({"status": ...}, http_code). Truthful: draining while stopping,
@@ -207,7 +255,9 @@ class JsonRemoteInference:
 
     def __init__(self, endpoint: str, timeout: float = 30.0,
                  retry_policy: Optional[RetryPolicy] = None,
-                 sleep=time.sleep, clock=time.monotonic) -> None:
+                 sleep=time.sleep, clock=time.monotonic,
+                 registry: Optional[MetricsRegistry] = None,
+                 name: Optional[str] = None) -> None:
         self.endpoint = endpoint
         self.timeout = timeout
         self.retry_policy = retry_policy or RetryPolicy(
@@ -215,6 +265,12 @@ class JsonRemoteInference:
         self._sleep = sleep
         self._clock = clock
         self.retries = 0  # attempts beyond the first, across calls
+        self.name = name or f"client-{next(_client_seq)}"
+        reg = registry if registry is not None else get_registry()
+        self._c_retries = reg.counter(
+            "dl4j_tpu_client_retries_total",
+            "JsonRemoteInference retry attempts (beyond the first try)",
+            ("instance",)).labels(self.name)
 
     def _call_once(self, body: bytes, deadline: Deadline) -> dict:
         rem = deadline.remaining()
@@ -252,6 +308,7 @@ class JsonRemoteInference:
 
         def note_retry(attempt, exc, delay):
             self.retries += 1
+            self._c_retries.inc()
 
         payload = self.retry_policy.execute(
             lambda: self._call_once(body, deadline),

@@ -547,11 +547,16 @@ class SameDiff:
         return dict(zip(wrt, grads))
 
     # ------------------------------------------------------------- training
-    def fit(self, iterator, training_config=None, epochs: int = 1):
+    def fit(self, iterator, training_config=None, epochs: int = 1,
+            listeners=None):
         from .training import TrainingSession
 
         if self._training is None:
-            self._training = TrainingSession(self, training_config)
+            self._training = TrainingSession(self, training_config,
+                                             listeners=listeners)
+        elif listeners:
+            for l in listeners:
+                self._training.listeners.add(l)
         return self._training.fit(iterator, epochs=epochs)
 
     # ---------------------------------------------------- AOT / serialization

@@ -41,7 +41,10 @@ class History:
 
 
 class TrainingSession:
-    def __init__(self, sd, config: Optional[TrainingConfig]) -> None:
+    def __init__(self, sd, config: Optional[TrainingConfig],
+                 listeners=None) -> None:
+        from ..core.listeners import ListenerBus
+
         self.sd = sd
         self.config = config or TrainingConfig(updater=Adam(1e-3))
         self.updater = updater_from_any(self.config.updater or Adam(1e-3))
@@ -55,6 +58,14 @@ class TrainingSession:
         # the most recent fit()'s History — still holds the flushed loss
         # curve when fit() is interrupted mid-run (robust telemetry)
         self.last_history: Optional[History] = None
+        # TrainingListener bus (core/listeners.py): MetricsListener et al.
+        # attach here. The per-step score is fetched from device ONLY when
+        # some listener declares requires_score — otherwise listeners get
+        # NaN and the loss stays on device (one stacked fetch per epoch).
+        self.listeners = ListenerBus(listeners)
+        self.iteration_count = 0
+        self.epoch_count = 0
+        self.last_batch_size: Optional[int] = None
 
     def _build_step(self):
         sd = self.sd
@@ -109,8 +120,13 @@ class TrainingSession:
                     np.asarray(jnp.stack(device_losses), np.float64).tolist())
                 device_losses.clear()
 
+        bus = self.listeners
+        use_listeners = bool(bus.listeners)
+        need_score = use_listeners and bus.requires_score
         try:
             for _ in range(epochs):
+                if use_listeners:
+                    bus.epoch_start(self)
                 for item in iterator:
                     if isinstance(item, MultiDataSet):
                         feats, labs = list(item.features), list(item.labels)
@@ -129,7 +145,18 @@ class TrainingSession:
                     # measured round 5: it tripled the imported-BERT train
                     # step). One stacked fetch per epoch costs one sync.
                     device_losses.append(loss)
+                    if use_listeners:
+                        self.iteration_count += 1
+                        if feats:
+                            shp = np.shape(feats[0])
+                            self.last_batch_size = int(shp[0]) if shp else None
+                        bus.iteration_done(
+                            self, self.iteration_count, self.epoch_count,
+                            float(loss) if need_score else float("nan"))
                 flush_losses()
+                if use_listeners:
+                    bus.epoch_end(self)
+                self.epoch_count += 1
         finally:
             # an exception / KeyboardInterrupt mid-epoch must not lose the
             # curve recorded so far — flush whatever is still on device

@@ -145,7 +145,8 @@ def elastic_fit(entry_ref: str, checkpoint_dir: str, *,
                 log_fn: Callable[[str], None] = print,
                 spawn_fn: Optional[Callable[[], int]] = None,
                 sleep: Callable[[float], None] = time.sleep,
-                clock: Callable[[], float] = time.monotonic) -> dict:
+                clock: Callable[[], float] = time.monotonic,
+                registry=None) -> dict:
     """Supervised training: run ``entry_ref`` ("module:function") in a child
     process; restart from the latest checkpoint on crash or stall.
 
@@ -164,6 +165,19 @@ def elastic_fit(entry_ref: str, checkpoint_dir: str, *,
     HeartbeatListener(checkpoint_dir) itself — it owns the model and data.
     """
     from ..core.resilience import RetryPolicy, get_fault_injector
+    from ..obs.metrics import get_registry
+
+    reg = registry if registry is not None else get_registry()
+    ev_counts = reg.counter(
+        "dl4j_tpu_training_elastic_events_total",
+        "elastic_fit supervisor events", ("event",))
+    c_restarts = reg.counter(
+        "dl4j_tpu_training_restarts_total",
+        "Child restarts performed by elastic_fit")
+
+    def record(kind: str, **fields) -> None:
+        ev_counts.labels(kind).inc()
+        reg.log_event("elastic_fit", event=kind, **fields)
 
     policy = retry_policy or RetryPolicy(
         max_retries=max_restarts, initial_backoff=1.0, max_backoff=60.0)
@@ -179,14 +193,17 @@ def elastic_fit(entry_ref: str, checkpoint_dir: str, *,
             entry_ref, checkpoint_dir, stall_timeout, env)))()
         if rc == 0:
             events.append({"event": "completed", "restarts": restarts})
+            record("completed", restarts=restarts)
             return {"ok": True, "restarts": restarts, "events": events}
         kind = "stall" if rc == STALL_EXIT_CODE else "crash"
         hb = read_heartbeat(checkpoint_dir)
         events.append({"event": kind, "rc": rc, "last_heartbeat": hb})
+        record(kind, rc=rc)
         log_fn(f"elastic_fit: child {kind} (rc={rc}), last iteration "
                f"{hb['iteration'] if hb else 'none'}")
         if restarts >= max_restarts:
             events.append({"event": "gave_up", "restarts": restarts})
+            record("gave_up", restarts=restarts)
             return {"ok": False, "restarts": restarts, "events": events}
         now = clock()
         restart_times = [t for t in restart_times
@@ -194,13 +211,16 @@ def elastic_fit(entry_ref: str, checkpoint_dir: str, *,
         if len(restart_times) >= budget:
             events.append({"event": "crash_loop", "restarts": restarts,
                            "window_s": crash_loop_window, "budget": budget})
+            record("crash_loop", restarts=restarts)
             log_fn(f"elastic_fit: crash loop — {len(restart_times) + 1} "
                    f"failures within {crash_loop_window}s, giving up")
             return {"ok": False, "restarts": restarts, "events": events}
         restart_times.append(now)
         delay = policy.backoff(restarts)
         events.append({"event": "backoff", "delay_s": delay})
+        record("backoff", delay_s=delay)
         log_fn(f"elastic_fit: restarting in {delay:.2f}s "
                f"(restart {restarts + 1}/{max_restarts})")
         sleep(delay)
+        c_restarts.inc()
         restarts += 1

@@ -20,6 +20,9 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from ..obs.metrics import MetricsRegistry, get_registry
+from ..obs.prom import CONTENT_TYPE as _PROM_CONTENT_TYPE
+from ..obs.prom import render_prometheus
 from .stats import StatsStorage
 
 _PAGE = """<!DOCTYPE html>
@@ -99,12 +102,17 @@ class UIServer:
 
     _instance: Optional["UIServer"] = None
 
-    def __init__(self, port: int = 9000, host: str = "127.0.0.1") -> None:
+    def __init__(self, port: int = 9000, host: str = "127.0.0.1",
+                 registry: Optional[MetricsRegistry] = None) -> None:
         # loopback by default: the dashboard has no auth; pass
         # host="0.0.0.0" explicitly to expose it beyond the machine
         self.port = port
         self.host = host
         self.storage: Optional[StatsStorage] = None
+        # /metrics source; None = the process-global registry at scrape
+        # time, so the training dashboard process is scrapeable alongside
+        # any serving endpoints it hosts
+        self.registry = registry
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -179,6 +187,11 @@ class UIServer:
                     sid = (q.get("sessionId") or [None])[0]
                     self._send(json.dumps(ui.stats_payload(sid)).encode(),
                                "application/json")
+                elif url.path == "/metrics":
+                    reg = ui.registry if ui.registry is not None \
+                        else get_registry()
+                    self._send(render_prometheus(reg).encode(),
+                               _PROM_CONTENT_TYPE)
                 else:
                     self.send_response(404)
                     self.end_headers()

@@ -1,0 +1,17 @@
+"""Tier-1 wiring for tools/check_metrics_contract.py: the /metrics scrape
+contract (README.md "Observability" — exposition grammar + contract series
+names) is enforced on every test run, mirroring test_serving_contract.py."""
+
+import os
+import sys
+
+_TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+
+
+def test_metrics_contract_smoke():
+    sys.path.insert(0, _TOOLS)
+    try:
+        import check_metrics_contract
+    finally:
+        sys.path.remove(_TOOLS)
+    assert check_metrics_contract.main(log=lambda m: None) == 0

@@ -1,0 +1,494 @@
+"""Observability subsystem tests (obs/): registry semantics, Prometheus
+exposition correctness (label escaping, bucket cumulativity, _sum/_count),
+thread-safety under concurrent increments, spans, the resilience observer
+hooks, MetricsListener, and the AsyncDataSetIterator stats/shutdown fix."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.core.resilience import (
+    AdmissionController,
+    CircuitBreaker,
+    CircuitState,
+    RetryPolicy,
+)
+from deeplearning4j_tpu.obs import (
+    MetricError,
+    MetricsListener,
+    MetricsRegistry,
+    Span,
+    get_registry,
+    render_prometheus,
+    set_registry,
+)
+
+
+# --------------------------------------------------------------------------
+# registry semantics
+# --------------------------------------------------------------------------
+class TestRegistry:
+    def test_counter_gauge_histogram_basics(self):
+        reg = MetricsRegistry()
+        c = reg.counter("dl4j_tpu_test_events_total", "events")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(MetricError):
+            c.inc(-1)
+
+        g = reg.gauge("dl4j_tpu_test_depth", "depth")
+        g.set(4)
+        g.inc()
+        g.dec(2)
+        assert g.value == 3
+        g.set_max(10)
+        g.set_max(5)  # lower than current max: no-op
+        assert g.value == 10
+
+        h = reg.histogram("dl4j_tpu_test_latency_seconds", "lat",
+                          buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        assert h.count == 3
+        assert h.sum == pytest.approx(5.55)
+
+    def test_labels_positional_and_keyword(self):
+        reg = MetricsRegistry()
+        fam = reg.counter("dl4j_tpu_test_reqs_total", "reqs",
+                          ("instance", "code"))
+        fam.labels("a", "200").inc()
+        fam.labels(instance="a", code="200").inc()
+        fam.labels(code="500", instance="a").inc()
+        assert fam.labels("a", "200").value == 2
+        assert fam.labels("a", "500").value == 1
+        with pytest.raises(MetricError):
+            fam.inc()  # labeled family has no default child
+        with pytest.raises(MetricError):
+            fam.labels("a")  # wrong arity
+        with pytest.raises(MetricError):
+            fam.labels(instance="a")  # missing label
+
+    def test_registration_idempotent_and_shape_checked(self):
+        reg = MetricsRegistry()
+        a = reg.counter("dl4j_tpu_test_x_total", "x", ("l",))
+        b = reg.counter("dl4j_tpu_test_x_total", "x", ("l",))
+        assert a is b
+        with pytest.raises(MetricError):
+            reg.gauge("dl4j_tpu_test_x_total", "x", ("l",))  # type mismatch
+        with pytest.raises(MetricError):
+            reg.counter("dl4j_tpu_test_x_total", "x", ("other",))  # labels
+        with pytest.raises(MetricError):
+            reg.counter("0bad-name", "x")
+        with pytest.raises(MetricError):
+            reg.counter("dl4j_tpu_ok_total", "x", ("le",))  # reserved
+
+    def test_concurrent_counter_increments(self):
+        reg = MetricsRegistry()
+        fam = reg.counter("dl4j_tpu_test_conc_total", "c", ("instance",))
+        child = fam.labels("t")
+        h = reg.histogram("dl4j_tpu_test_conc_seconds", "h", buckets=(0.5,))
+        n_threads, per_thread = 8, 5000
+
+        def worker():
+            for _ in range(per_thread):
+                child.inc()
+                h.observe(0.25)
+
+        threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert child.value == n_threads * per_thread
+        assert h.count == n_threads * per_thread
+        # every observation landed in the 0.5 bucket, cumulatively
+        buckets = h._default().buckets()
+        assert buckets[0][1] == n_threads * per_thread
+        assert buckets[-1][1] == n_threads * per_thread
+
+    def test_global_registry_injectable(self):
+        prev = set_registry(None)
+        try:
+            reg = get_registry()
+            reg.counter("dl4j_tpu_test_global_total", "g").inc()
+            assert reg.get("dl4j_tpu_test_global_total").value == 1
+        finally:
+            set_registry(prev)
+        assert get_registry() is prev
+
+
+# --------------------------------------------------------------------------
+# spans + event log
+# --------------------------------------------------------------------------
+class TestSpans:
+    def test_span_feeds_histogram(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("dl4j_tpu_test_span_seconds", "s")
+        with Span(h._default()) as sp:
+            pass
+        assert sp.elapsed is not None and sp.elapsed >= 0
+        assert h.count == 1
+
+    def test_trace_registers_and_logs(self):
+        reg = MetricsRegistry()
+        with reg.trace("dl4j_tpu_test_op_seconds", labels={"op": "fwd"},
+                       log=True):
+            pass
+        fam = reg.get("dl4j_tpu_test_op_seconds")
+        assert fam.labels(op="fwd").count == 1
+        evts = reg.events("span")
+        assert len(evts) == 1
+        assert evts[0]["name"] == "dl4j_tpu_test_op_seconds"
+        assert evts[0]["op"] == "fwd" and evts[0]["error"] is False
+
+    def test_span_records_on_exception(self):
+        reg = MetricsRegistry()
+        with pytest.raises(RuntimeError):
+            with reg.trace("dl4j_tpu_test_err_seconds", log=True):
+                raise RuntimeError("boom")
+        assert reg.get("dl4j_tpu_test_err_seconds").count == 1
+        assert reg.events("span")[0]["error"] is True
+
+    def test_event_log_bounded(self):
+        reg = MetricsRegistry(max_events=4)
+        for i in range(10):
+            reg.log_event("e", i=i)
+        evts = reg.events("e")
+        assert len(evts) == 4 and evts[0]["i"] == 6
+
+
+# --------------------------------------------------------------------------
+# Prometheus exposition
+# --------------------------------------------------------------------------
+class TestExposition:
+    def test_label_escaping(self):
+        reg = MetricsRegistry()
+        fam = reg.counter("dl4j_tpu_test_esc_total", 'has "quotes"\nand \\',
+                          ("path",))
+        fam.labels('va"l\\ue\nx').inc()
+        text = render_prometheus(reg)
+        assert ('# HELP dl4j_tpu_test_esc_total '
+                'has "quotes"\\nand \\\\') in text
+        assert 'path="va\\"l\\\\ue\\nx"' in text
+        # round-trips through the external parser
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                        os.pardir, "tools"))
+        try:
+            from check_metrics_contract import parse_exposition
+        finally:
+            sys.path.pop(0)
+        fams = parse_exposition(text)
+        (_, labels, value), = fams["dl4j_tpu_test_esc_total"]["samples"]
+        assert labels["path"] == 'va"l\\ue\nx' and value == 1
+
+    def test_histogram_exposition_cumulative(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("dl4j_tpu_test_h_seconds", "h", buckets=(0.1, 1.0))
+        for v in (0.05, 0.05, 0.5, 5.0):
+            h.observe(v)
+        text = render_prometheus(reg)
+        lines = [l for l in text.splitlines() if l.startswith("dl4j_tpu_test_h")]
+        assert lines == [
+            'dl4j_tpu_test_h_seconds_bucket{le="0.1"} 2',
+            'dl4j_tpu_test_h_seconds_bucket{le="1"} 3',
+            'dl4j_tpu_test_h_seconds_bucket{le="+Inf"} 4',
+            "dl4j_tpu_test_h_seconds_sum 5.6",
+            "dl4j_tpu_test_h_seconds_count 4",
+        ]
+
+    def test_type_lines_and_ordering(self):
+        reg = MetricsRegistry()
+        reg.gauge("dl4j_tpu_test_b", "b").set(1)
+        reg.counter("dl4j_tpu_test_a_total", "a").inc()
+        text = render_prometheus(reg)
+        # families sorted by name; TYPE precedes samples
+        a = text.index("# TYPE dl4j_tpu_test_a_total counter")
+        b = text.index("# TYPE dl4j_tpu_test_b gauge")
+        assert a < text.index("dl4j_tpu_test_a_total 1") < b
+        assert text.endswith("\n")
+
+
+# --------------------------------------------------------------------------
+# resilience observer hooks (standalone — satellite 2)
+# --------------------------------------------------------------------------
+class TestObserverHooks:
+    def test_circuit_breaker_observer_sees_transitions(self):
+        t = [0.0]
+        cb = CircuitBreaker(failure_threshold=0.5, min_calls=2, window=4,
+                            open_timeout=10.0, clock=lambda: t[0])
+        seen = []
+        cb.add_observer(lambda old, new: seen.append((old.value, new.value)))
+        cb.record_failure()
+        cb.record_failure()  # trips
+        assert seen == [("closed", "open")]
+        t[0] += 10.0
+        assert cb.allow()  # open -> half_open, probe admitted
+        cb.record_success()  # half_open -> closed
+        assert seen == [("closed", "open"), ("open", "half_open"),
+                        ("half_open", "closed")]
+
+    def test_circuit_observer_may_reenter_breaker(self):
+        cb = CircuitBreaker(failure_threshold=0.5, min_calls=1)
+        ra = []
+        cb.add_observer(lambda old, new: ra.append(cb.retry_after()))
+        cb.record_failure()  # observer calls back in; must not deadlock
+        assert len(ra) == 1 and ra[0] > 0
+
+    def test_admission_observer_decisions(self):
+        ac = AdmissionController(max_pending=1)
+        seen = []
+        ac.add_observer(lambda d, pending: seen.append((d, pending)))
+        assert ac.try_admit()
+        assert not ac.try_admit()
+        ac.release()
+        assert seen == [("admitted", 1), ("shed", 1)]
+        ac.remove_observer(seen)  # unknown fn: tolerated
+        assert ac.stats()["shed"] == 1  # behavior unchanged by observer
+
+    def test_retry_policy_observer_counts_attempts(self):
+        policy = RetryPolicy(max_retries=3, initial_backoff=0.001, seed=1)
+        attempts = []
+        policy.observer = lambda attempt, exc, delay: attempts.append(attempt)
+        calls = [0]
+
+        def flaky():
+            calls[0] += 1
+            if calls[0] < 3:
+                raise ValueError("flaky")
+            return "ok"
+
+        assert policy.execute(flaky, retry_on=(ValueError,),
+                              sleep=lambda s: None) == "ok"
+        assert attempts == [0, 1]
+
+
+# --------------------------------------------------------------------------
+# MetricsListener
+# --------------------------------------------------------------------------
+class _FakeModel:
+    last_batch_size = 32
+
+
+class TestMetricsListener:
+    def test_series_from_iterations(self):
+        reg = MetricsRegistry()
+        lis = MetricsListener(registry=reg)
+        assert lis.requires_score is False
+        model = _FakeModel()
+        lis.on_epoch_start(model)
+        for i in range(1, 4):
+            lis.iteration_done(model, i, 0, 0.5 / i)
+        lis.on_epoch_end(model)
+        assert reg.get("dl4j_tpu_training_iterations_total").value == 3
+        assert reg.get("dl4j_tpu_training_examples_total").value == 96
+        assert reg.get("dl4j_tpu_training_epochs_total").value == 1
+        # first iteration has no predecessor: 2 latency observations
+        assert reg.get("dl4j_tpu_training_step_latency_seconds").count == 2
+        assert reg.get("dl4j_tpu_training_score").value == pytest.approx(0.5 / 3)
+
+    def test_nan_score_skipped(self):
+        reg = MetricsRegistry()
+        lis = MetricsListener(registry=reg)
+        lis.iteration_done(_FakeModel(), 1, 0, 0.25)
+        lis.iteration_done(_FakeModel(), 2, 0, float("nan"))
+        assert reg.get("dl4j_tpu_training_score").value == 0.25
+
+    def test_attaches_to_samediff_training_session(self):
+        from deeplearning4j_tpu.samediff import SameDiff
+        from deeplearning4j_tpu.samediff.training import TrainingConfig
+        from deeplearning4j_tpu.train.updaters import Adam
+
+        reg = MetricsRegistry()
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (None, 2))
+        label = sd.placeholder("label", (None, 1))
+        w = sd.var("w", np.zeros((2, 1), np.float32))
+        pred = (x @ w).rename("pred")
+        sd.loss.mean_squared_error(label, pred).rename("loss")
+        sd.set_loss_variables("loss")
+        cfg = TrainingConfig(updater=Adam(0.1),
+                             data_set_feature_mapping=("x",),
+                             data_set_label_mapping=("label",))
+        xs = np.random.RandomState(0).randn(8, 2).astype(np.float32)
+        ys = (xs @ np.array([[1.0], [2.0]], np.float32)).astype(np.float32)
+        sd.fit([(xs, ys)] * 3, cfg, epochs=2,
+               listeners=[MetricsListener(registry=reg)])
+        assert reg.get("dl4j_tpu_training_iterations_total").value == 6
+        assert reg.get("dl4j_tpu_training_examples_total").value == 48
+        assert reg.get("dl4j_tpu_training_epochs_total").value == 2
+
+    def test_distributed_trainer_no_score_sync(self):
+        from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.parallel import DistributedTrainer
+
+        reg = MetricsRegistry()
+        conf = (NeuralNetConfiguration.builder().seed(7).list()
+                .layer(DenseLayer(n_in=4, n_out=8))
+                .layer(OutputLayer(n_in=8, n_out=2))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        model.listeners.add(MetricsListener(registry=reg))
+        trainer = DistributedTrainer(model)
+        rng = np.random.RandomState(3)
+        x = rng.randn(32, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 32)]
+        trainer.fit(x, y, epochs=1)
+        assert reg.get("dl4j_tpu_training_iterations_total").value >= 1
+        assert reg.get("dl4j_tpu_training_examples_total").value == 32
+
+
+# --------------------------------------------------------------------------
+# AsyncDataSetIterator stats + shutdown (satellite 1)
+# --------------------------------------------------------------------------
+class TestAsyncIterator:
+    def _iterator(self, reg, n=96, batch=8, queue_size=4):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.iterators import (AsyncDataSetIterator,
+                                                       ListDataSetIterator)
+
+        x = np.arange(n * 2, dtype=np.float32).reshape(n, 2)
+        y = np.zeros((n, 1), np.float32)
+        base = ListDataSetIterator(DataSet(x, y), batch=batch)
+        return AsyncDataSetIterator(base, queue_size=queue_size, registry=reg)
+
+    def test_stats_exposed(self):
+        reg = MetricsRegistry()
+        it = self._iterator(reg)
+        batches = sum(1 for _ in it)
+        assert batches == 12
+        s = it.stats()
+        assert s["batches"] == 12
+        assert s["queue_high_water"] >= 1
+        assert s["producer_blocked_s"] >= 0.0
+        assert s["consumer_starvation_s"] >= 0.0
+        assert reg.get("dl4j_tpu_data_prefetch_batches_total") is not None
+        it.close()
+
+    def test_abandon_mid_epoch_joins_thread(self):
+        reg = MetricsRegistry()
+        it = self._iterator(reg, n=400, batch=4, queue_size=2)
+        consumed = 0
+        for _ in it:
+            consumed += 1
+            if consumed == 3:
+                break  # abandon with the producer parked on a full queue
+        thread = it._thread
+        assert thread is not None and thread.is_alive()
+        it.close()
+        assert not thread.is_alive(), "prefetch thread leaked after close()"
+        assert it._thread is None
+        # the whole epoch was NOT forced: producer stopped early
+        assert it.stats()["batches"] < 100
+
+    def test_reset_mid_epoch_restarts_cleanly(self):
+        reg = MetricsRegistry()
+        it = self._iterator(reg, n=64, batch=4, queue_size=2)
+        it.next()
+        it.next()
+        thread = it._thread
+        it.reset()
+        assert thread is None or not thread.is_alive()
+        batches = sum(1 for _ in it)
+        assert batches == 16  # full epoch after reset
+
+    def test_error_propagates_after_rework(self):
+        from deeplearning4j_tpu.data.iterators import AsyncDataSetIterator
+
+        class Exploding:
+            def __init__(self):
+                self.n = 0
+
+            def has_next(self):
+                return True
+
+            def next(self):
+                self.n += 1
+                if self.n > 2:
+                    raise RuntimeError("reader died")
+                return self.n
+
+            def reset(self):
+                self.n = 0
+
+            def batch_size(self):
+                return 1
+
+        it = AsyncDataSetIterator(Exploding(), queue_size=2,
+                                  registry=MetricsRegistry())
+        with pytest.raises(RuntimeError, match="reader died"):
+            while it.has_next():
+                it.next()
+        it.close()
+
+
+# --------------------------------------------------------------------------
+# serving integration: stats() is a view over the injected registry
+# --------------------------------------------------------------------------
+class TestServingIntegration:
+    def test_stats_view_matches_registry(self):
+        from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.parallel import ParallelInference
+
+        reg = MetricsRegistry()
+        conf = (NeuralNetConfiguration.builder().seed(5).list()
+                .layer(DenseLayer(n_in=4, n_out=8))
+                .layer(OutputLayer(n_in=8, n_out=3))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        pi = ParallelInference(model, workers=1, registry=reg, name="t")
+        try:
+            x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+            pi.output(x)
+            s = pi.stats()
+            assert s["accepted"] == 1 and s["completed"] == 1
+            fam = reg.get("dl4j_tpu_inference_requests_total")
+            assert fam.labels("t", "accepted").value == 1
+            assert fam.labels("t", "completed").value == 1
+            assert reg.get(
+                "dl4j_tpu_inference_forward_latency_seconds").labels("t").count == 1
+            assert reg.get("dl4j_tpu_inference_queue_depth").labels("t").value == 0
+            assert reg.get("dl4j_tpu_resilience_circuit_state").labels("t").value == 0
+        finally:
+            pi.shutdown()
+
+    def test_circuit_transition_series(self):
+        from deeplearning4j_tpu.core.resilience import FaultInjector
+        from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.parallel import ParallelInference
+
+        reg = MetricsRegistry()
+        conf = (NeuralNetConfiguration.builder().seed(5).list()
+                .layer(DenseLayer(n_in=4, n_out=8))
+                .layer(OutputLayer(n_in=8, n_out=3))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        inj = FaultInjector()
+        from deeplearning4j_tpu.parallel.inference import FORWARD_SITE
+        inj.inject_error(FORWARD_SITE, lambda: RuntimeError("poisoned"),
+                         times=3)
+        cb = CircuitBreaker(failure_threshold=0.5, min_calls=3, window=4,
+                            open_timeout=60.0)
+        pi = ParallelInference(model, workers=1, circuit_breaker=cb,
+                               fault_injector=inj, registry=reg, name="cb")
+        try:
+            x = np.ones((1, 4), np.float32)
+            for _ in range(3):
+                with pytest.raises(RuntimeError):
+                    pi.output(x)
+            assert reg.get("dl4j_tpu_resilience_circuit_state").labels("cb").value == 1
+            fam = reg.get("dl4j_tpu_resilience_circuit_transitions_total")
+            assert fam.labels("cb", "closed", "open").value == 1
+        finally:
+            pi.shutdown(drain=False)
