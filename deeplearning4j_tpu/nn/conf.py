@@ -56,6 +56,7 @@ from .layers import (
     LastTimeStepLayer,
     MaskZeroLayer,
     TimeDistributedLayer,
+    MixtureOfExpertsLayer,
     SelfAttentionLayer,
     LearnedSelfAttentionLayer,
     RecurrentAttentionLayer,
@@ -113,6 +114,11 @@ _RNN_LAYERS = (
     RnnOutputLayer, RnnLossLayer, LastTimeStepLayer,
 )
 _FF_LAYERS = (DenseLayer, OutputLayer, EmbeddingLayer)
+# Token layers consume FF ([b, f]) and recurrent ([b, f, t]) input natively
+# (MoE treats timesteps as extra tokens), so they only need flattening from
+# spatial input — inserting RnnToFeedForward would destroy the per-sequence
+# token_mask path.
+_TOKEN_LAYERS = (MixtureOfExpertsLayer,)
 
 
 def _needs(layer: Layer) -> str:
@@ -122,6 +128,8 @@ def _needs(layer: Layer) -> str:
         return "cnn"
     if isinstance(layer, _RNN_LAYERS):
         return "rnn"
+    if isinstance(layer, _TOKEN_LAYERS):
+        return "tokens"
     if isinstance(layer, _FF_LAYERS):
         return "ff"
     return "any"
@@ -152,6 +160,12 @@ def _preprocessor_for(current: InputType, need: str) -> Optional[Layer]:
     if need == "rnn":
         if isinstance(current, ConvolutionalType):
             return CnnToRnnPreProcessor(
+                height=current.height, width=current.width, channels=current.channels
+            )
+        return None
+    if need == "tokens":
+        if isinstance(current, ConvolutionalType):
+            return CnnToFeedForwardPreProcessor(
                 height=current.height, width=current.width, channels=current.channels
             )
         return None

@@ -4,15 +4,32 @@ Beyond-reference capability (SURVEY §2.3 lists EP as absent upstream;
 "on TPU the absent rows come nearly free from pjit"): a Switch/GShard-style
 sparse FFN whose expert weights carry a leading expert dimension that
 shards over a mesh axis via ``DistributedTrainer(param_sharding_rules=
-[("moe.*/We", P(None, "model"))...])``-like rules — XLA then partitions
-the dispatch/combine einsums and inserts the all-to-alls.
+moe_expert_parallel_rules())`` — XLA then partitions the expert MLP and
+inserts the all-to-alls.
 
-TPU-first design: the classic dense-dispatch formulation (Mesh-TF /
-GShard) — top-k routing becomes two static one-hot einsum contractions
-([tokens, experts, capacity] dispatch and combine tensors), so everything
-is MXU work with static shapes; no gather/scatter, no dynamic shapes.
-Tokens over an expert's capacity are dropped (their combine weight is 0 —
-the residual path carries them), exactly the GShard capacity contract.
+Two dispatch formulations, selected by ``dispatch_mode``:
+
+* ``"sort"`` (default) — sort-based gather/scatter dispatch
+  (ops/moe_dispatch.py): one ``lax.top_k`` route, capacity slots from a
+  per-expert cumsum over the flat assignment list, one gather into the
+  ``[E, C, d]`` expert buffer, gate-weighted gather back. Static shapes,
+  no one-hot contractions; the routing cost is O(tokens·E) index math
+  instead of the einsum path's O(tokens·E·capacity·d).
+* ``"einsum"`` — the classic dense Mesh-TF/GShard formulation (one-hot
+  ``[tokens, E, capacity]`` dispatch/combine contractions). Kept for
+  equivalence testing and as the reference semantics.
+
+Both modes implement the exact GShard capacity contract: slots are granted
+first-come-first-served in (round, token) order and tokens over an
+expert's capacity are dropped (their combine weight is 0 — the residual
+path carries them), so outputs and gradients agree between modes up to
+float reduction order.
+
+Observability: every ``apply`` refreshes ``state["expert_tokens"]`` ([E]
+kept assignments per expert) and ``state["dropped_tokens"]`` (overflow
+drops), which ``obs.record_moe_metrics``/``MoEMetricsListener`` feed into
+``dl4j_tpu_moe_expert_tokens_total{layer=,expert=}`` and
+``dl4j_tpu_moe_dropped_tokens_total{layer=}``.
 """
 
 from __future__ import annotations
@@ -25,10 +42,18 @@ import jax
 import jax.numpy as jnp
 
 from ...core.config import register_config
+from ...ops.moe_dispatch import (
+    gather_dispatch,
+    make_dispatch_plan,
+    scatter_combine,
+    top_k_routing,
+)
 from ..activations import Activation
 from ..input_type import FeedForwardType, InputType, RecurrentType
 from ..weights import WeightInit, init_weights
 from .base import Layer, LayerContext, Params, State, apply_input_dropout
+
+_DISPATCH_MODES = ("sort", "einsum")
 
 
 @register_config
@@ -52,12 +77,20 @@ class MixtureOfExpertsLayer(Layer):
     # is PUSHED toward uniform expert load, not merely observed. 0 keeps
     # it diagnostic-only (read from state["aux_load_balance"]).
     balance_loss_weight: float = 0.0
+    # "sort" (gather/scatter, default) or "einsum" (dense one-hot
+    # contractions — the legacy GShard formulation, kept for equivalence
+    # testing). Identical capacity/drop semantics either way.
+    dispatch_mode: str = "sort"
 
     def __post_init__(self) -> None:
         if self.top_k < 1 or self.top_k > self.num_experts:
             raise ValueError(
                 f"top_k={self.top_k} must be in [1, num_experts="
                 f"{self.num_experts}]")
+        if self.dispatch_mode not in _DISPATCH_MODES:
+            raise ValueError(
+                f"dispatch_mode={self.dispatch_mode!r} must be one of "
+                f"{_DISPATCH_MODES}")
 
     def output_type(self, input_type: InputType) -> InputType:
         if isinstance(input_type, RecurrentType):
@@ -84,8 +117,12 @@ class MixtureOfExpertsLayer(Layer):
 
     def init_state(self, dtype: Any) -> State:
         # declared up-front so the state pytree structure is stable across
-        # jitted steps (apply refreshes the value every call)
-        return {"aux_load_balance": jnp.zeros((), dtype)}
+        # jitted steps (apply refreshes the values every call). Counts live
+        # in float32 regardless of the compute dtype: bf16 can't represent
+        # integers above 256 exactly.
+        return {"aux_load_balance": jnp.zeros((), dtype),
+                "expert_tokens": jnp.zeros((self.num_experts,), jnp.float32),
+                "dropped_tokens": jnp.zeros((), jnp.float32)}
 
     def init(self, key: jax.Array, dtype: Any) -> Params:
         e, d, h, o = self.num_experts, self.n_in, self._hidden(), self.n_out
@@ -107,40 +144,51 @@ class MixtureOfExpertsLayer(Layer):
 
     def _route(self, gates: jax.Array, capacity: int,
                token_mask: Optional[jax.Array] = None):
-        """Top-k dense dispatch: returns (dispatch [b, E, C] 0/1,
-        combine [b, E, C] gate-weighted). Position assignment is
-        first-come-first-served in batch order per expert (GShard).
+        """Dense top-k dispatch (``dispatch_mode="einsum"``): returns
+        (dispatch [b, E, C] 0/1, combine [b, E, C] gate-weighted).
+        Position assignment is first-come-first-served per expert in
+        (round, batch) order (GShard). Routing is ONE ``lax.top_k`` —
+        round ``r``'s selection is column ``r`` of its result, replacing
+        the legacy k-round argmax-and-remask loop with identical
+        semantics (descending gate, ties to the lower expert index).
         ``token_mask`` [b] excludes padding tokens entirely: they claim no
         capacity slot and contribute nothing to dispatch/combine."""
         b, e = gates.shape
+        gate_vals, idx = top_k_routing(gates, self.top_k)        # [b, k]
         dispatch = jnp.zeros((b, e, capacity), gates.dtype)
         combine = jnp.zeros((b, e, capacity), gates.dtype)
-        # tokens already assigned per expert as the k rounds proceed
-        fill = jnp.zeros((b, e), gates.dtype)
-        masked = gates
-        for _ in range(self.top_k):
-            idx = jnp.argmax(masked, axis=-1)                    # [b]
-            sel = jax.nn.one_hot(idx, e, dtype=gates.dtype)      # [b, E]
+        # running per-expert fill across the k rounds
+        fill = jnp.zeros((1, e), gates.dtype)
+        for r in range(self.top_k):
+            sel = jax.nn.one_hot(idx[:, r], e, dtype=gates.dtype)  # [b, E]
             if token_mask is not None:
                 sel = sel * token_mask[:, None]
             # position of each token within its chosen expert's buffer,
             # counting earlier rounds' fills
-            pos = (jnp.cumsum(sel, axis=0) - 1.0 +
-                   jnp.sum(fill, axis=0, keepdims=True)) * sel   # [b, E]
+            pos = (jnp.cumsum(sel, axis=0) - 1.0 + fill) * sel   # [b, E]
             pos_idx = jnp.sum(pos, axis=-1).astype(jnp.int32)    # [b]
             keep = (pos_idx < capacity).astype(gates.dtype)
             slot = jax.nn.one_hot(pos_idx, capacity,
                                   dtype=gates.dtype)             # [b, C]
             d_i = sel[:, :, None] * slot[:, None, :] * keep[:, None, None]
             dispatch = dispatch + d_i
-            gate = jnp.sum(gates * sel, axis=-1)                 # [b]
-            combine = combine + d_i * gate[:, None, None]
-            fill = fill + sel * keep[:, None]
-            masked = masked * (1.0 - sel)
+            combine = combine + d_i * gate_vals[:, r][:, None, None]
+            fill = fill + jnp.sum(sel * keep[:, None], axis=0,
+                                  keepdims=True)
         # renormalize combine weights over the k selected experts
         denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
         combine = combine / jnp.maximum(denom, 1e-9)
         return dispatch, combine
+
+    def _experts(self, params: Params, expert_in: jax.Array) -> jax.Array:
+        """Batched expert MLPs over the [E, C, d] buffer — the leading E
+        dim is what expert-parallel sharding rules partition."""
+        h = jnp.einsum("ecd,edh->ech", expert_in, params["We1"]) \
+            + params["be1"][:, None, :]
+        act = self.activation or Activation.RELU
+        h = act(h)
+        return jnp.einsum("ech,eho->eco", h, params["We2"]) \
+            + params["be2"][:, None, :]
 
     def apply(self, params: Params, state: State, x: jax.Array,
               ctx: LayerContext) -> Tuple[jax.Array, State]:
@@ -162,16 +210,28 @@ class MixtureOfExpertsLayer(Layer):
                 jnp.asarray(ctx.mask, x2.dtype), (b_ * t_,))
 
         gates = jax.nn.softmax(x2 @ params["Wg"], axis=-1)       # [b, E]
-        dispatch, combine = self._route(gates, capacity, token_mask)
 
-        expert_in = jnp.einsum("bec,bd->ecd", dispatch, x2)      # [E, C, d]
-        h = jnp.einsum("ecd,edh->ech", expert_in, params["We1"]) \
-            + params["be1"][:, None, :]
-        act = self.activation or Activation.RELU
-        h = act(h)
-        out_e = jnp.einsum("ech,eho->eco", h, params["We2"]) \
-            + params["be2"][:, None, :]
-        y = jnp.einsum("bec,eco->bo", combine, out_e)            # [b, o]
+        if self.dispatch_mode == "sort":
+            gate_vals, expert_idx = top_k_routing(gates, self.top_k)
+            plan = make_dispatch_plan(expert_idx, e, capacity,
+                                      token_mask=token_mask)
+            expert_in = gather_dispatch(x2, plan, e, capacity)   # [E, C, d]
+            out_e = self._experts(params, expert_in)
+            y = scatter_combine(out_e, gate_vals, plan)          # [b, o]
+            expert_tokens = plan.expert_tokens.astype(jnp.float32)
+            dropped = plan.dropped_tokens.astype(jnp.float32)
+        else:
+            dispatch, combine = self._route(gates, capacity, token_mask)
+            expert_in = jnp.einsum("bec,bd->ecd", dispatch, x2)  # [E, C, d]
+            out_e = self._experts(params, expert_in)
+            y = jnp.einsum("bec,eco->bo", combine, out_e)        # [b, o]
+            # count in f32: a bf16 sum of 0/1s goes inexact past 256
+            expert_tokens = jnp.sum(dispatch.astype(jnp.float32),
+                                    axis=(0, 2))
+            requested = self.top_k * (
+                jnp.sum(token_mask.astype(jnp.float32))
+                if token_mask is not None else jnp.float32(n_tok))
+            dropped = requested - jnp.sum(expert_tokens)
 
         # load-balance aux (GShard): fraction routed per expert x mean gate
         # mass per expert, E-scaled. Exposed via state for listeners; added
@@ -179,13 +239,15 @@ class MixtureOfExpertsLayer(Layer):
         # in sequential.py/graph.py read it back). Real tokens only.
         if token_mask is not None:
             denom_tok = jnp.maximum(jnp.sum(token_mask), 1.0)
-            frac = jnp.sum(jnp.sum(dispatch, axis=-1), axis=0) / denom_tok
             mass = jnp.sum(gates * token_mask[:, None], axis=0) / denom_tok
         else:
-            frac = jnp.mean(jnp.sum(dispatch, axis=-1), axis=0)
+            denom_tok = jnp.asarray(n_tok, gates.dtype)
             mass = jnp.mean(gates, axis=0)
+        frac = expert_tokens.astype(gates.dtype) / denom_tok
         new_state = dict(state)
         new_state["aux_load_balance"] = e * jnp.sum(frac * mass)
+        new_state["expert_tokens"] = expert_tokens
+        new_state["dropped_tokens"] = dropped
 
         if recurrent:
             y = jnp.transpose(y.reshape(b_, t_, self.n_out), (0, 2, 1))

@@ -10,7 +10,7 @@ Prometheus text format 0.0.4. See README "Observability" for the metric
 naming convention and the ``stats()`` ↔ metrics mapping.
 """
 
-from .listener import MetricsListener
+from .listener import MetricsListener, MoEMetricsListener, record_moe_metrics
 from .metrics import (
     DEFAULT_BUCKETS,
     Counter,
@@ -34,9 +34,11 @@ __all__ = [
     "MetricError",
     "MetricsListener",
     "MetricsRegistry",
+    "MoEMetricsListener",
     "PROM_CONTENT_TYPE",
     "Span",
     "get_registry",
+    "record_moe_metrics",
     "render_prometheus",
     "set_registry",
     "trace",

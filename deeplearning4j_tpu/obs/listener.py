@@ -16,7 +16,9 @@ simply skips NaN.
 from __future__ import annotations
 
 import time
-from typing import Any, Optional
+from typing import Any, Mapping, Optional
+
+import numpy as np
 
 from ..core.listeners import TrainingListener
 from .metrics import MetricsRegistry, get_registry
@@ -80,3 +82,69 @@ class MetricsListener(TrainingListener):
             self._examples.inc(batch)
         if score == score:  # skip NaN (loop ran with requires_score=False)
             self._score.set(float(score))
+
+
+def record_moe_metrics(state: Optional[Mapping[str, Any]],
+                       registry: Optional[MetricsRegistry] = None) -> int:
+    """Feed MoE routing observability from a model ``state`` pytree
+    (``layer_name -> layer state``) into the registry.
+
+    Every :class:`~deeplearning4j_tpu.nn.layers.MixtureOfExpertsLayer`
+    refreshes ``state["expert_tokens"]`` ([E] assignments kept per expert)
+    and ``state["dropped_tokens"]`` (capacity-overflow drops) per forward;
+    this turns the latest per-batch values into the cumulative series
+
+    * ``dl4j_tpu_moe_expert_tokens_total{layer=,expert=}``
+    * ``dl4j_tpu_moe_dropped_tokens_total{layer=}``
+
+    Call once per completed step (that is what
+    :class:`MoEMetricsListener` does). Returns the number of MoE layer
+    states seen, so callers can assert wiring.
+    """
+    reg = registry if registry is not None else get_registry()
+    tok = reg.counter(
+        "dl4j_tpu_moe_expert_tokens_total",
+        "MoE (token, slot) assignments kept per expert (post capacity "
+        "drop)", ("layer", "expert"))
+    drop = reg.counter(
+        "dl4j_tpu_moe_dropped_tokens_total",
+        "MoE (token, slot) assignments dropped by capacity overflow",
+        ("layer",))
+    seen = 0
+    for lname, lstate in (state or {}).items():
+        if not isinstance(lstate, Mapping) or "expert_tokens" not in lstate:
+            continue
+        seen += 1
+        counts = np.asarray(lstate["expert_tokens"], dtype=np.float64)
+        for e_idx, c in enumerate(counts.tolist()):
+            tok.labels(lname, str(e_idx)).inc(c)
+        if "dropped_tokens" in lstate:
+            drop.labels(lname).inc(
+                float(np.asarray(lstate["dropped_tokens"])))
+    return seen
+
+
+class MoEMetricsListener(TrainingListener):
+    """Per-iteration MoE routing telemetry: expert load + capacity drops.
+
+    Reads ``model.state`` after each iteration and feeds
+    :func:`record_moe_metrics`. ``MultiLayerNetwork``/``ComputationGraph``
+    training loops write the post-step state back onto the model every
+    iteration, so the default works there directly. For
+    ``DistributedTrainer`` the live state stays on-device unless a
+    listener declares ``requires_arrays``; construct with
+    ``sync_arrays=True`` to request that (it forces a per-iteration
+    device→host sync of params AND state — pay it only when you need
+    per-step expert-load curves off a distributed run).
+    """
+
+    requires_score = False
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None, *,
+                 sync_arrays: bool = False) -> None:
+        self.registry = registry if registry is not None else get_registry()
+        self.requires_arrays = bool(sync_arrays)
+
+    def iteration_done(self, model: Any, iteration: int, epoch: int,
+                       score: float) -> None:
+        record_moe_metrics(getattr(model, "state", None), self.registry)

@@ -23,6 +23,13 @@ from .flash_attention import (
     mha_attention_reference,
     set_attention_impl,
 )
+from .moe_dispatch import (
+    DispatchPlan,
+    gather_dispatch,
+    make_dispatch_plan,
+    scatter_combine,
+    top_k_routing,
+)
 
 __all__ = [
     "attention_impl",
@@ -36,4 +43,9 @@ __all__ = [
     "mha_attention",
     "mha_attention_reference",
     "set_attention_impl",
+    "DispatchPlan",
+    "gather_dispatch",
+    "make_dispatch_plan",
+    "scatter_combine",
+    "top_k_routing",
 ]

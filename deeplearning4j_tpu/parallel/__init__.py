@@ -34,7 +34,7 @@ from .strategies import (
 from .sequence import ring_attention, ulysses_attention
 from .pipeline import (dense_block_stage, pipeline_apply,
                        pipeline_stages_init, shard_stage_params)
-from .trainer import DistributedTrainer
+from .trainer import DistributedTrainer, moe_expert_parallel_rules
 from .inference import InferenceMode, ParallelInference
 
 __all__ = [
@@ -56,4 +56,5 @@ __all__ = [
     "ThresholdCompressedSync",
     "initialize_distributed",
     "make_mesh",
+    "moe_expert_parallel_rules",
 ]

@@ -160,6 +160,12 @@ class ParallelInference:
         self._c_rows = reg.counter(
             "dl4j_tpu_inference_batch_rows_total",
             "Rows served across all batches", ("instance",)).labels(inst)
+        # pad rows cost a full forward each but serve nobody — the
+        # bucketing waste a capacity planner wants next to the row counter
+        self._c_padded = reg.counter(
+            "dl4j_tpu_inference_padded_rows_total",
+            "Pad rows added to reach the bucketed batch shape (forward "
+            "work that served no request)", ("instance",)).labels(inst)
         self._g_max_batch = reg.gauge(
             "dl4j_tpu_inference_batch_size_max",
             "Largest dynamic batch observed", ("instance",)).labels(inst)
@@ -283,6 +289,7 @@ class ParallelInference:
             "batches": batches,
             "mean_batch_size": (rows / batches) if batches else 0.0,
             "max_batch_size": int(self._g_max_batch.value),
+            "padded_rows": int(self._c_padded.value),
             "draining": self._draining,
         })
         return counts
@@ -357,6 +364,8 @@ class ParallelInference:
                 self._breaker.record_success()
                 self._c_batches.inc()
                 self._c_rows.inc(n)
+                if padded_n > n:
+                    self._c_padded.inc(padded_n - n)
                 self._g_max_batch.set_max(n)
                 self._c["completed"].inc(len(batch))
                 off = 0

@@ -36,6 +36,25 @@ from .strategies import GradientSyncStrategy, SyncAllReduce
 _shmap = shmap  # single-home compatibility shim (parallel/mesh.py)
 
 
+def moe_expert_parallel_rules(axis: str = "model",
+                              layer_pattern: str = r".*"
+                              ) -> List[Tuple[str, P]]:
+    """``param_sharding_rules`` for expert parallelism over ``axis``.
+
+    Shards every :class:`~deeplearning4j_tpu.nn.layers.MixtureOfExpertsLayer`
+    expert-dim parameter (``We1``/``be1``/``We2``/``be2`` all carry a
+    leading ``E``) and leaves the router ``Wg`` replicated. Valid for both
+    ``dispatch_mode="sort"`` and ``"einsum"``: the sort path's ``[E, C, d]``
+    expert buffer keeps the same leading expert dim, so GSPMD partitions
+    the batched expert MLP identically and inserts the all-to-alls around
+    the gather/scatter instead of the one-hot contractions.
+
+    ``layer_pattern`` narrows the match to specific layer names (rules are
+    matched against ``"layername/paramname"``).
+    """
+    return [(rf"{layer_pattern}/(?:We1|be1|We2|be2)$", P(axis))]
+
+
 class DistributedTrainer:
     """Data-/tensor-parallel trainer for ``MultiLayerNetwork``-style models
     (anything exposing ``loss_pure``/``forward_pure`` + ``conf`` + params).

@@ -21,18 +21,20 @@ from deeplearning4j_tpu.train.solver import Solver
 from deeplearning4j_tpu.train.updaters import Sgd
 
 
-def _layer(e=4, d=8, h=16, o=8, k=1, cap=100.0):
+def _layer(e=4, d=8, h=16, o=8, k=1, cap=100.0, mode="sort"):
     lay = MixtureOfExpertsLayer(
         n_in=d, n_out=o, num_experts=e, hidden=h, top_k=k,
-        capacity_factor=cap, activation=Activation.RELU)
+        capacity_factor=cap, activation=Activation.RELU,
+        dispatch_mode=mode)
     params = lay.init(jax.random.PRNGKey(0), jnp.float32)
     return lay, params
 
 
-def test_top1_matches_dense_reference():
+@pytest.mark.parametrize("mode", ["sort", "einsum"])
+def test_top1_matches_dense_reference(mode):
     """With capacity >= tokens, top-1 MoE output == the argmax expert's MLP
     applied per token (gate weight renormalizes to 1 for k=1)."""
-    lay, params = _layer(k=1)
+    lay, params = _layer(k=1, mode=mode)
     rs = np.random.RandomState(1)
     x = jnp.asarray(rs.rand(12, 8).astype(np.float32))
     y, _ = lay.apply(params, lay.init_state(jnp.float32), x, LayerContext())
@@ -59,9 +61,11 @@ def test_top2_combines_two_experts():
     assert float(state["aux_load_balance"]) > 0.0
 
 
-def test_capacity_drops_overflow_tokens():
+@pytest.mark.parametrize("mode", ["sort", "einsum"])
+def test_capacity_drops_overflow_tokens(mode):
     """capacity_factor tiny -> most tokens dropped -> output rows zero."""
-    lay, params = _layer(k=1, cap=0.26)  # capacity = ceil(12/4*0.26)=1
+    # capacity = ceil(12/4*0.26) = 1
+    lay, params = _layer(k=1, cap=0.26, mode=mode)
     rs = np.random.RandomState(3)
     x = jnp.asarray(rs.rand(12, 8).astype(np.float32))
     y, _ = lay.apply(params, lay.init_state(jnp.float32), x, LayerContext())
@@ -94,7 +98,8 @@ def test_expert_parallel_matches_single_device():
     """EP: expert-dim sharding over the 'model' mesh axis produces the same
     step results as the unsharded run (GSPMD inserts the collectives)."""
     from deeplearning4j_tpu.parallel.mesh import make_mesh
-    from deeplearning4j_tpu.parallel.trainer import DistributedTrainer
+    from deeplearning4j_tpu.parallel.trainer import (
+        DistributedTrainer, moe_expert_parallel_rules)
 
     def build():
         conf = (NeuralNetConfiguration.builder().seed(9).updater(Sgd(0.2))
@@ -110,8 +115,8 @@ def test_expert_parallel_matches_single_device():
     x = rs.rand(8, 8).astype(np.float32)
     y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 8)]
 
-    ep_rules = [(r".*/We1", P("model")), (r".*/be1", P("model")),
-                (r".*/We2", P("model")), (r".*/be2", P("model"))]
+    ep_rules = moe_expert_parallel_rules("model")
+    assert all(P("model") == spec for _, spec in ep_rules)
     t_ep = DistributedTrainer(
         build(), mesh=make_mesh(data=2, model=4),
         param_sharding_rules=ep_rules)
@@ -129,10 +134,11 @@ def test_expert_parallel_matches_single_device():
                 rtol=2e-3, atol=2e-5, err_msg=f"{ln}/{k}")
 
 
-def test_masked_tokens_claim_no_capacity():
+@pytest.mark.parametrize("mode", ["sort", "einsum"])
+def test_masked_tokens_claim_no_capacity(mode):
     """Padding tokens (ctx.mask=0) must not consume expert capacity slots
     or influence real-token outputs (recurrent [b, f, t] input path)."""
-    lay, params = _layer(k=1, cap=0.5)  # tight capacity
+    lay, params = _layer(k=1, cap=0.5, mode=mode)  # tight capacity
     rs = np.random.RandomState(6)
     b, d, t = 2, 8, 6
     x = np.asarray(rs.rand(b, d, t), np.float32)

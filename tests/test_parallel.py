@@ -350,6 +350,29 @@ class TestParallelInferenceResilience:
         assert s["mean_batch_size"] == pytest.approx(4.0)
         assert s["circuit_state"] == "closed"
         assert s["queue_depth"] == 0
+        assert s["padded_rows"] == 0  # 4 rows hit the 4-bucket exactly
+        pi.shutdown()
+
+    def test_padded_rows_counted(self):
+        """Bucketing pads 3 rows up to the 4-bucket: the wasted row shows
+        up in stats() and the dl4j_tpu_inference_padded_rows_total series,
+        and real rows are never counted as padding."""
+        from deeplearning4j_tpu.obs import MetricsRegistry
+
+        reg = MetricsRegistry()
+        pi = ParallelInference(_mlp(), workers=1, batch_limit=8,
+                               registry=reg, name="pad-test")
+        x, _ = _data(3)
+        pi.output(x)
+        s = pi.stats()
+        assert s["padded_rows"] == 1
+        assert s["batches"] == 1 and s["max_batch_size"] == 3
+        fam = reg.get("dl4j_tpu_inference_padded_rows_total")
+        assert fam.labels("pad-test").value == 1
+        # an exact power-of-two batch adds no padding
+        x4, _ = _data(4)
+        pi.output(x4)
+        assert pi.stats()["padded_rows"] == 1
         pi.shutdown()
 
 

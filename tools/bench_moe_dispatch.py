@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""MoE dispatch micro-bench: sort (gather/scatter) vs einsum (dense
+one-hot) on CPU-sized shapes.
+
+ISSUE 3 tooling: a standalone, seconds-not-minutes comparison of the two
+``MixtureOfExpertsLayer.dispatch_mode`` spellings on shapes a laptop CPU
+handles, printing one JSON line (bench.py's ``moe_dispatch`` measurement
+is the full-shape TPU row; this is the fast local loop for dispatch-path
+work). Runs standalone::
+
+    python tools/bench_moe_dispatch.py [--tokens 2048] [--mode both]
+
+and as a tier-1 smoke via tests/test_moe_dispatch.py, which also asserts
+the two modes agree numerically on the benched shape.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def run(tokens: int = 2048, d: int = 64, experts: int = 8, top_k: int = 2,
+        hidden: int = 128, capacity_factor: float = 1.25, iters: int = 3,
+        check: bool = True) -> dict:
+    """Time one jitted grad step per dispatch mode; returns the JSON row.
+
+    With ``check=True`` also verifies the modes agree on outputs (max
+    abs diff under a float32 tolerance) before timing — a bench of two
+    paths that disagree measures nothing.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.nn.layers import MixtureOfExpertsLayer
+    from deeplearning4j_tpu.nn.layers.base import LayerContext
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (tokens, d), jnp.float32)
+    params = None
+    grads = {}
+    outs = {}
+    times = {}
+    for mode in ("sort", "einsum"):
+        lay = MixtureOfExpertsLayer(
+            n_in=d, n_out=d, num_experts=experts, hidden=hidden,
+            top_k=top_k, capacity_factor=capacity_factor,
+            dispatch_mode=mode)
+        if params is None:
+            params = lay.init(jax.random.PRNGKey(0), jnp.float32)
+        state = lay.init_state(jnp.float32)
+
+        def loss(p, _lay=lay, _state=state):
+            y, _ = _lay.apply(p, _state, x, LayerContext())
+            return jnp.sum(jnp.square(y))
+
+        fwd = jax.jit(lambda p, _lay=lay, _state=state: _lay.apply(
+            p, _state, x, LayerContext())[0])
+        g = jax.jit(jax.grad(loss))
+        outs[mode] = np.asarray(fwd(params))
+        out = g(params)  # compile + warm
+        jax.tree_util.tree_map(lambda a: a.block_until_ready(), out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = g(params)
+        jax.tree_util.tree_map(lambda a: a.block_until_ready(), out)
+        times[mode] = (time.perf_counter() - t0) * 1e3 / iters
+        grads[mode] = out
+
+    row = {
+        "tokens": tokens, "d_model": d, "experts": experts, "top_k": top_k,
+        "hidden": hidden, "capacity_factor": capacity_factor,
+        "iters": iters,
+        "sort_grad_step_ms": round(times["sort"], 3),
+        "einsum_grad_step_ms": round(times["einsum"], 3),
+        "sort_vs_einsum_speedup": round(times["einsum"] / times["sort"], 2),
+    }
+    if check:
+        out_diff = float(np.max(np.abs(outs["sort"] - outs["einsum"])))
+        scale = float(np.max(np.abs(outs["einsum"]))) or 1.0
+        grad_diff = max(
+            float(np.max(np.abs(np.asarray(grads["sort"][k])
+                                - np.asarray(grads["einsum"][k]))))
+            for k in grads["sort"])
+        row["max_abs_output_diff"] = out_diff
+        row["max_abs_grad_diff"] = grad_diff
+        row["modes_agree"] = bool(out_diff <= 1e-4 * scale)
+    return row
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tokens", type=int, default=2048)
+    ap.add_argument("--d", type=int, default=64)
+    ap.add_argument("--experts", type=int, default=8)
+    ap.add_argument("--top-k", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--capacity-factor", type=float, default=1.25)
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--no-check", action="store_true",
+                    help="skip the numeric sort==einsum verification")
+    args = ap.parse_args(argv)
+    row = run(tokens=args.tokens, d=args.d, experts=args.experts,
+              top_k=args.top_k, hidden=args.hidden,
+              capacity_factor=args.capacity_factor, iters=args.iters,
+              check=not args.no_check)
+    print(json.dumps(row))
+    return 0 if row.get("modes_agree", True) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
