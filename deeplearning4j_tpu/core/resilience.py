@@ -39,7 +39,12 @@ class DeadlineExceededError(ResilienceError, TimeoutError):
 
 
 class AdmissionRejectedError(ResilienceError):
-    """Load shed: the admission controller refused the request (HTTP 503)."""
+    """Load shed: the admission controller refused the request (HTTP 503).
+    ``retry_after`` hints when a retry might be admitted."""
+
+    def __init__(self, msg: str = "overloaded", retry_after: float = 1.0):
+        super().__init__(msg)
+        self.retry_after = float(retry_after)
 
 
 class CircuitOpenError(ResilienceError):
@@ -396,7 +401,8 @@ class AdmissionController:
     def admit(self) -> None:
         if not self.try_admit():
             raise AdmissionRejectedError(
-                f"overloaded: {self.pending}/{self.max_pending} pending")
+                f"overloaded: {self.pending}/{self.max_pending} pending",
+                retry_after=self.retry_after())
 
     def release(self) -> None:
         with self._lock:

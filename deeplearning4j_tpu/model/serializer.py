@@ -22,6 +22,9 @@ from __future__ import annotations
 
 import io
 import json
+import os
+import tempfile
+import warnings
 import zipfile
 from typing import Any, Optional
 
@@ -38,6 +41,9 @@ _STATE = "state.npz"
 _UPDATER = "updaterState.npz"
 _NORM = "normalizer.npz"
 _META = "meta.json"
+
+_FRAMEWORK = "deeplearning4j_tpu"
+_KNOWN_MODEL_CLASSES = ("MultiLayerNetwork", "ComputationGraph")
 
 
 def _leaves_to_npz(tree: Any) -> bytes:
@@ -63,26 +69,45 @@ def _npz_to_leaves(data: bytes, template: Any) -> Any:
 
 
 def write_model(model, path: str, save_updater: bool = False, normalizer=None) -> None:
-    """Reference: ModelSerializer.writeModel(model, file, saveUpdater[, normalizer])."""
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-        zf.writestr(_CONF, to_json(model.conf))
-        flat, _ = ravel_pytree(model.params)
-        buf = io.BytesIO()
-        np.save(buf, np.asarray(flat))
-        zf.writestr(_COEFF, buf.getvalue())
-        zf.writestr(_STATE, _leaves_to_npz(model.state))
-        meta = {
-            "model_class": type(model).__name__,
-            "framework": "deeplearning4j_tpu",
-            "version": __version__,
-        }
-        zf.writestr(_META, json.dumps(meta))
-        if save_updater and model._trainer is not None:
-            zf.writestr(_UPDATER, _leaves_to_npz(model._trainer.opt_state))
-        if normalizer is not None:
-            buf = io.BytesIO()
-            np.savez(buf, **normalizer.state_dict())
-            zf.writestr(_NORM, buf.getvalue())
+    """Reference: ModelSerializer.writeModel(model, file, saveUpdater[, normalizer]).
+
+    Atomic: the zip is assembled in a temp file in the destination
+    directory, fsynced, then ``os.replace``d onto ``path`` — a crash
+    mid-write never leaves a truncated artifact at ``path`` (an existing
+    file there survives untouched)."""
+    dirname = os.path.dirname(os.path.abspath(path))
+    fd, tmp_path = tempfile.mkstemp(dir=dirname, prefix=".tmp-",
+                                    suffix=os.path.basename(path))
+    try:
+        with os.fdopen(fd, "wb") as f:
+            with zipfile.ZipFile(f, "w", zipfile.ZIP_DEFLATED) as zf:
+                zf.writestr(_CONF, to_json(model.conf))
+                flat, _ = ravel_pytree(model.params)
+                buf = io.BytesIO()
+                np.save(buf, np.asarray(flat))
+                zf.writestr(_COEFF, buf.getvalue())
+                zf.writestr(_STATE, _leaves_to_npz(model.state))
+                meta = {
+                    "model_class": type(model).__name__,
+                    "framework": _FRAMEWORK,
+                    "version": __version__,
+                }
+                zf.writestr(_META, json.dumps(meta))
+                if save_updater and model._trainer is not None:
+                    zf.writestr(_UPDATER, _leaves_to_npz(model._trainer.opt_state))
+                if normalizer is not None:
+                    buf = io.BytesIO()
+                    np.savez(buf, **normalizer.state_dict())
+                    zf.writestr(_NORM, buf.getvalue())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp_path, path)
+    except BaseException:
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+        raise
 
 
 def restore_multi_layer_network(path: str, load_updater: bool = False):
@@ -99,9 +124,34 @@ def restore_computation_graph(path: str, load_updater: bool = False):
     return _restore(path, ComputationGraph, load_updater)
 
 
+def _check_meta(meta: dict, path: str) -> None:
+    """Fail loudly on artifacts this framework cannot interpret (hard
+    error on unknown model class / foreign framework) and warn on a
+    framework-version skew — round-5 style checkpoint incompatibilities
+    (CHANGES.md) should surface at load, not as silent mis-loads."""
+    cls_name = meta.get("model_class")
+    if cls_name not in _KNOWN_MODEL_CLASSES:
+        raise ValueError(
+            f"{path}: unknown model_class {cls_name!r} in meta.json "
+            f"(expected one of {_KNOWN_MODEL_CLASSES})")
+    framework = meta.get("framework")
+    if framework is not None and framework != _FRAMEWORK:
+        raise ValueError(
+            f"{path}: artifact written by framework {framework!r}, "
+            f"not {_FRAMEWORK!r}")
+    version = meta.get("version")
+    if version is not None and version != __version__:
+        warnings.warn(
+            f"{path}: artifact written by {_FRAMEWORK} {version}, loading "
+            f"with {__version__} — layer semantics may have changed "
+            f"(see CHANGES.md); verify outputs or re-export",
+            stacklevel=3)
+
+
 def restore_model(path: str, load_updater: bool = False):
     with zipfile.ZipFile(path) as zf:
         meta = json.loads(zf.read(_META))
+    _check_meta(meta, path)
     if meta["model_class"] == "ComputationGraph":
         return restore_computation_graph(path, load_updater)
     return restore_multi_layer_network(path, load_updater)
@@ -109,14 +159,30 @@ def restore_model(path: str, load_updater: bool = False):
 
 def _restore(path: str, cls, load_updater: bool):
     with zipfile.ZipFile(path) as zf:
+        if _META in zf.namelist():
+            _check_meta(json.loads(zf.read(_META)), path)
         conf = from_json(zf.read(_CONF).decode())
         model = cls(conf).init()
         flat = np.load(io.BytesIO(zf.read(_COEFF)))
+        n_expected = model.num_params()
+        if int(flat.size) != n_expected:
+            raise ValueError(
+                f"{path}: coefficient vector has {int(flat.size)} values but "
+                f"{cls.__name__} built from the stored configuration expects "
+                f"{n_expected} params — the artifact does not match its own "
+                f"configuration (corrupt, or written by an incompatible "
+                f"framework version)")
         _, unravel = ravel_pytree(model.params)
         model.params = unravel(jax.numpy.asarray(flat))
         if _STATE in zf.namelist():
             model.state = _npz_to_leaves(zf.read(_STATE), model.state)
-        if load_updater and _UPDATER in zf.namelist():
+        if load_updater:
+            if _UPDATER not in zf.namelist():
+                raise ValueError(
+                    f"{path}: load_updater=True but the artifact has no "
+                    f"updater state — it was saved with save_updater=False; "
+                    f"re-save with write_model(..., save_updater=True) or "
+                    f"load with load_updater=False")
             from ..train.solver import Solver
 
             model._trainer = Solver(model)

@@ -35,7 +35,7 @@ from .sequence import ring_attention, ulysses_attention
 from .pipeline import (dense_block_stage, pipeline_apply,
                        pipeline_stages_init, shard_stage_params)
 from .trainer import DistributedTrainer, moe_expert_parallel_rules
-from .inference import InferenceMode, ParallelInference
+from .inference import InferenceMode, ParallelInference, Servable
 
 __all__ = [
     "ShardedEmbeddingTable",
@@ -52,6 +52,7 @@ __all__ = [
     "MeshSpec",
     "ParallelInference",
     "ParameterAveragingSync",
+    "Servable",
     "SyncAllReduce",
     "ThresholdCompressedSync",
     "initialize_distributed",

@@ -72,6 +72,26 @@ def _bucket(n: int, limit: int) -> int:
     return min(b, limit)
 
 
+class Servable:
+    """A loaded model + its jitted forward + version identity — the unit
+    :class:`ParallelInference` serves and a
+    :class:`~deeplearning4j_tpu.serving.manager.ModelManager` swaps.
+    Workers grab one reference per batch, so a swap never tears a batch:
+    in-flight batches finish on the forward they grabbed while new
+    batches pick up the replacement."""
+
+    __slots__ = ("model", "fwd", "version", "_c_requests")
+
+    def __init__(self, model, fwd, version: str, c_requests) -> None:
+        self.model = model
+        self.fwd = fwd
+        self.version = str(version)
+        self._c_requests = c_requests
+
+    def count_requests(self, n: int) -> None:
+        self._c_requests.inc(n)
+
+
 class _Request:
     __slots__ = ("x", "fut", "deadline")
 
@@ -101,8 +121,8 @@ class ParallelInference:
         fault_injector=None,
         registry: Optional[MetricsRegistry] = None,
         name: Optional[str] = None,
+        model_version: str = "0",
     ) -> None:
-        self.model = model
         self.mode = inference_mode
         self.batch_limit = int(batch_limit)
         self.default_timeout = default_timeout
@@ -121,13 +141,10 @@ class ParallelInference:
         self._idle = threading.Condition(self._stats_lock)
         self._init_metrics(registry if registry is not None else get_registry())
 
-        params, state = model.params, model.state
-
-        def fwd(x):
-            out, _, _ = model.forward_pure(params, state, x, train=False, rng=None)
-            return out
-
-        self._fwd = jax.jit(fwd)
+        self._servable = self.make_servable(model, version=model_version)
+        # feature shape of the last batch actually served — a swap engine
+        # uses it to warm a candidate on the shapes traffic really has
+        self.last_input_shape: Optional[tuple] = None
         self._threads: List[threading.Thread] = []
         self._shutdown = False
         self._draining = False
@@ -138,6 +155,69 @@ class ParallelInference:
 
     def _inj(self):
         return self._fault_injector or get_fault_injector()
+
+    # ----- servable lifecycle (hot swap) ------------------------------
+    @property
+    def model(self):
+        return self._servable.model
+
+    @property
+    def model_version(self) -> str:
+        return self._servable.version
+
+    def make_servable(self, model, *, version: str = "0") -> Servable:
+        """Build (but do not install) a servable for ``model``: the jitted
+        forward plus its per-version request counter. A swap engine warms
+        the returned servable's ``fwd`` on :meth:`bucket_sizes` shapes
+        before :meth:`swap`, so compilation never happens on the serving
+        path."""
+        params, state = model.params, model.state
+
+        def fwd(x):
+            out, _, _ = model.forward_pure(params, state, x, train=False, rng=None)
+            return out
+
+        child = self._model_req_family.labels(self.name, str(version))
+        return Servable(model, jax.jit(fwd), str(version), child)
+
+    def swap(self, servable: Servable, *, circuit_breaker=None) -> Servable:
+        """Atomically install ``servable`` as the live model; returns the
+        retired one. In-flight batches finish on the forward they already
+        grabbed — no request is dropped or torn by a swap. Passing
+        ``circuit_breaker`` also swaps the breaker, so a candidate version
+        starts with a clean failure window (its metrics observer is
+        rewired to keep ``dl4j_tpu_resilience_circuit_state`` truthful)."""
+        with self._lock:
+            old = self._servable
+            self._servable = servable
+            if circuit_breaker is not None and circuit_breaker is not self._breaker:
+                self._breaker.remove_observer(self._circuit_observer)
+                self._breaker = circuit_breaker
+                circuit_breaker.add_observer(self._circuit_observer)
+        if circuit_breaker is not None:
+            self._g_circuit.set(_CIRCUIT_CODE[self._breaker.state])
+        return old
+
+    def swap_model(self, model, *, version: str = "0",
+                   circuit_breaker=None) -> Servable:
+        """Convenience: :meth:`make_servable` + :meth:`swap` (no warmup —
+        the first batch pays compilation; use
+        :class:`~deeplearning4j_tpu.serving.manager.ModelManager` for the
+        warmed path)."""
+        return self.swap(self.make_servable(model, version=version),
+                         circuit_breaker=circuit_breaker)
+
+    def bucket_sizes(self) -> List[int]:
+        """The batch sizes :func:`_bucket` can actually emit (powers of
+        two up to ``batch_limit``, plus ``batch_limit`` itself) — the
+        shapes a warmup must compile to make a swap recompile-free."""
+        sizes: List[int] = []
+        b = 1
+        while b < self.batch_limit:
+            sizes.append(b)
+            b <<= 1
+        sizes.append(self.batch_limit)
+        return sizes
 
     # ----- metrics ----------------------------------------------------
     def _init_metrics(self, reg: MetricsRegistry) -> None:
@@ -169,6 +249,12 @@ class ParallelInference:
         self._g_max_batch = reg.gauge(
             "dl4j_tpu_inference_batch_size_max",
             "Largest dynamic batch observed", ("instance",)).labels(inst)
+        # family (not child): each Servable carves out its own
+        # model_version child at make_servable time
+        self._model_req_family = reg.counter(
+            "dl4j_tpu_serving_model_requests_total",
+            "Requests completed, by the model version that served them",
+            ("instance", "model_version"))
         self._h_forward = reg.histogram(
             "dl4j_tpu_inference_forward_latency_seconds",
             "Jitted forward latency per batch (including failures)",
@@ -291,6 +377,7 @@ class ParallelInference:
             "max_batch_size": int(self._g_max_batch.value),
             "padded_rows": int(self._c_padded.value),
             "draining": self._draining,
+            "model_version": self._servable.version,
         })
         return counts
 
@@ -344,6 +431,9 @@ class ParallelInference:
                 self._c["circuit_rejected"].inc(len(batch))
                 self._finish(len(batch))
                 continue
+            # one servable reference per batch: a concurrent swap cannot
+            # tear this batch between two model versions
+            sv = self._servable
             try:
                 arrays = []
                 sizes = []
@@ -353,6 +443,7 @@ class ParallelInference:
                     sizes.append(a.shape[0])
                 cat = np.concatenate(arrays, axis=0)
                 n = cat.shape[0]
+                self.last_input_shape = tuple(cat.shape[1:])
                 padded_n = _bucket(n, max(self.batch_limit, n))
                 if padded_n > n:
                     pad = np.repeat(cat[-1:], padded_n - n, axis=0)
@@ -360,7 +451,7 @@ class ParallelInference:
                 with Span(self._h_forward):
                     self._inj().fire(FORWARD_SITE)
                     out = np.asarray(
-                        self._fwd(jnp.asarray(cat, self.model.dtype)))[:n]
+                        sv.fwd(jnp.asarray(cat, sv.model.dtype)))[:n]
                 self._breaker.record_success()
                 self._c_batches.inc()
                 self._c_rows.inc(n)
@@ -368,6 +459,7 @@ class ParallelInference:
                     self._c_padded.inc(padded_n - n)
                 self._g_max_batch.set_max(n)
                 self._c["completed"].inc(len(batch))
+                sv.count_requests(len(batch))
                 off = 0
                 for req, sz in zip(batch, sizes):
                     res = out[off : off + sz]

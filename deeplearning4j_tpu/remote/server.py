@@ -28,6 +28,18 @@ Endpoints:
                    registry (default: the process-global one, so one
                    scrape sees serving + training + data metrics) —
                    contract enforced by tools/check_metrics_contract.py
+
+Multi-model serving (serving/ — README "Model registry & hot-swap
+serving"): registered :class:`~deeplearning4j_tpu.serving.manager.
+ModelManager` endpoints add
+
+  GET  /v1/models          → {"models": {name: manager.describe()}}
+  POST /v1/models/<name>   → same payload/status contract as <path>;
+                             response carries ``X-Model-Version``.
+                             ``X-Model-Version`` request header pins a
+                             resident version (live or canary; 404 when
+                             that version is not currently serving) and
+                             ``X-Request-Id`` is the canary routing key.
 """
 
 from __future__ import annotations
@@ -57,6 +69,7 @@ from ..obs.metrics import MetricsRegistry, get_registry
 from ..obs.prom import CONTENT_TYPE as _PROM_CONTENT_TYPE
 from ..obs.prom import render_prometheus
 from ..parallel.inference import InferenceMode, ParallelInference
+from ..serving.store import VersionNotFoundError
 
 _server_seq = itertools.count()
 _client_seq = itertools.count()
@@ -71,15 +84,19 @@ class ServiceUnavailableError(ResilienceError):
         self.retry_after = retry_after
 
 
+_MODELS_PREFIX = "/v1/models"
+
+
 class JsonModelServer:
-    def __init__(self, model, *, port: int = 0, path: str = "/v1/serving",
+    def __init__(self, model=None, *, port: int = 0, path: str = "/v1/serving",
                  batch_limit: int = 32, workers: int = 2,
                  queue_limit: int = 256,
                  default_deadline: float = 30.0,
                  circuit_breaker=None, admission=None,
                  clock=time.monotonic, fault_injector=None,
                  registry: Optional[MetricsRegistry] = None,
-                 name: Optional[str] = None) -> None:
+                 name: Optional[str] = None,
+                 managers: Optional[dict] = None) -> None:
         self.model = model
         self.path = path
         self.default_deadline = float(default_deadline)
@@ -87,7 +104,11 @@ class JsonModelServer:
         self._draining = False
         self.name = name or f"server-{next(_server_seq)}"
         self.registry = registry if registry is not None else get_registry()
-        self._pi = ParallelInference(
+        # named ModelManager endpoints (serving/): name -> manager. The
+        # server routes to them; their lifecycle (deploy/rollback/
+        # shutdown) stays with the caller that owns them.
+        self._managers: dict = dict(managers or {})
+        self._pi = None if model is None else ParallelInference(
             model, inference_mode=InferenceMode.BATCHED,
             batch_limit=batch_limit, workers=workers,
             queue_limit=queue_limit, circuit_breaker=circuit_breaker,
@@ -131,6 +152,10 @@ class JsonModelServer:
                     self._send(code, status)
                 elif self.path == "/stats":
                     self._send(200, outer.stats())
+                elif self.path == _MODELS_PREFIX:
+                    self._send(200, {"models": {
+                        n: m.describe() for n, m in
+                        sorted(outer._managers.items())}})
                 elif self.path == "/metrics":
                     body = render_prometheus(outer.registry).encode()
                     self.send_response(200)
@@ -159,9 +184,28 @@ class JsonModelServer:
                         outer._observe_request(
                             self._sent_code, time.perf_counter() - t0)
 
+            def _submit_fn(self):
+                """Resolve the POST path to a ``(data, deadline) ->
+                (future, version|None)`` submitter, or answer 404."""
+                if self.path == outer.path and outer._pi is not None:
+                    return lambda data, deadline: (
+                        outer._pi.output_async(data, deadline=deadline), None)
+                if self.path.startswith(_MODELS_PREFIX + "/"):
+                    mname = self.path[len(_MODELS_PREFIX) + 1:]
+                    mgr = outer._managers.get(mname)
+                    if mgr is None:
+                        self._send(404, {"error": f"unknown model {mname!r}"})
+                        return None
+                    pin = self.headers.get("X-Model-Version")
+                    key = self.headers.get("X-Request-Id")
+                    return lambda data, deadline: mgr.submit(
+                        data, key=key, version=pin, deadline=deadline)
+                self._send(404, {"error": f"unknown path {self.path}"})
+                return None
+
             def _handle_post(self):
-                if self.path != outer.path:
-                    self._send(404, {"error": f"unknown path {self.path}"})
+                submit = self._submit_fn()
+                if submit is None:
                     return
                 # ---- parse: any failure here is the CLIENT's fault -> 400
                 try:
@@ -173,15 +217,20 @@ class JsonModelServer:
                     self._send(400, {"error": f"malformed request: {e}"})
                     return
                 # ---- serve: failures here are the SERVER's state -> 5xx
+                # (except a pinned version that is not serving -> 404)
                 try:
                     if outer._draining:
                         raise RuntimeError("draining")
-                    fut = outer._pi.output_async(data, deadline=deadline)
+                    fut, version = submit(data, deadline)
                     out = fut.result(timeout=deadline.remaining())
-                    self._send(200, {"output": np.asarray(out).tolist()})
+                    headers = ({"X-Model-Version": str(version)}
+                               if version is not None else None)
+                    self._send(200, {"output": np.asarray(out).tolist()},
+                               headers)
+                except VersionNotFoundError as e:
+                    self._send(404, {"error": str(e)})
                 except AdmissionRejectedError as e:
-                    self._send_unavailable(
-                        f"overloaded: {e}", outer._pi._admission.retry_after())
+                    self._send_unavailable(f"overloaded: {e}", e.retry_after)
                 except CircuitOpenError as e:
                     self._send_unavailable(f"circuit open: {e}", e.retry_after)
                 except (DeadlineExceededError, FutureTimeoutError):
@@ -205,22 +254,44 @@ class JsonModelServer:
         self._req_counts.labels(self.name, str(code)).inc()
         self._req_latency.observe(seconds)
 
+    def add_model(self, name: str, manager) -> "JsonModelServer":
+        """Register a :class:`~deeplearning4j_tpu.serving.manager.
+        ModelManager` under ``POST /v1/models/<name>``."""
+        self._managers[name] = manager
+        return self
+
+    def remove_model(self, name: str) -> None:
+        self._managers.pop(name, None)
+
     def health(self) -> tuple:
         """({"status": ...}, http_code). Truthful: draining while stopping,
-        degraded while the breaker is not closed, ok otherwise."""
-        circuit = self._pi.circuit_state
+        degraded while any live breaker is not closed, ok otherwise."""
+        engines = ([] if self._pi is None else [self._pi]) + \
+            [m.engine for m in self._managers.values()]
+        circuits = [e.circuit_state for e in engines]
         if self._draining:
             status = "draining"
-        elif circuit is not CircuitState.CLOSED:
+        elif any(c is not CircuitState.CLOSED for c in circuits):
             status = "degraded"
         else:
             status = "ok"
-        payload = {"status": status, "circuit": circuit.value,
-                   "queue_depth": self._pi.stats()["queue_depth"]}
+        payload = {"status": status,
+                   "queue_depth": sum(e.stats()["queue_depth"]
+                                      for e in engines)}
+        if self._pi is not None:
+            payload["circuit"] = self._pi.circuit_state.value
+        if self._managers:
+            payload["models"] = {
+                n: {"circuit": m.engine.circuit_state.value,
+                    "live_version": m.live_version}
+                for n, m in sorted(self._managers.items())}
         return payload, (200 if status == "ok" else 503)
 
     def stats(self) -> dict:
-        s = self._pi.stats()
+        s = {} if self._pi is None else self._pi.stats()
+        if self._managers:
+            s["models"] = {n: m.stats()
+                           for n, m in sorted(self._managers.items())}
         s["draining"] = self._draining
         return s
 
@@ -238,10 +309,16 @@ class JsonModelServer:
         Retry-After), let in-flight requests finish, then tear down."""
         self._draining = True
         if drain:
-            self._pi.drain(timeout=drain_timeout)
+            if self._pi is not None:
+                self._pi.drain(timeout=drain_timeout)
+            for m in self._managers.values():
+                m.engine.drain(timeout=drain_timeout)
         self._httpd.shutdown()
         self._httpd.server_close()
-        self._pi.shutdown(drain=False)
+        if self._pi is not None:
+            self._pi.shutdown(drain=False)
+        # registered managers are caller-owned: their engines drain above
+        # but shutdown stays with whoever created them
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None

@@ -1,0 +1,45 @@
+"""Versioned model registry, zero-downtime hot-swap, and canary serving.
+
+The production-serving subsystem between training and the HTTP edge
+(README "Model registry & hot-swap serving"):
+
+* :class:`~.store.ModelStore` — versioned on-disk artifact store over
+  ``model/serializer.py``: monotonic version ids, atomic publish,
+  SHA-256 manifests verified on load, ``resolve("latest")``/pinned
+  lookup, retention GC.
+* :class:`~.manager.ModelManager` — load → warm → atomic swap →
+  probation → automatic rollback (warmup failure or circuit-breaker
+  open), plus canary/shadow rollout on a second engine.
+* :class:`~.router.ModelRouter` — deterministic hash-split canary
+  routing and fail-open shadow mirroring.
+
+``remote/JsonModelServer`` exposes managed models over HTTP
+(``GET /v1/models``, ``POST /v1/models/<name>``, ``X-Model-Version``
+pinning); ``tools/check_registry_contract.py`` enforces the
+publish → resolve → swap → rollback contract every test run.
+"""
+
+from .manager import LOAD_SITE, WARMUP_SITE, ModelManager, SwapError
+from .router import ModelRouter
+from .store import (
+    LATEST,
+    ChecksumMismatchError,
+    ModelStore,
+    ModelStoreError,
+    ModelVersion,
+    VersionNotFoundError,
+)
+
+__all__ = [
+    "LATEST",
+    "LOAD_SITE",
+    "WARMUP_SITE",
+    "ChecksumMismatchError",
+    "ModelManager",
+    "ModelRouter",
+    "ModelStore",
+    "ModelStoreError",
+    "ModelVersion",
+    "SwapError",
+    "VersionNotFoundError",
+]

@@ -1,0 +1,493 @@
+"""Hot-swap engine: load → warm → swap → probation → rollback.
+
+A retrained model must replace the live one **without stopping the
+server** (ROADMAP north star; the servable lifecycle of "TensorFlow: A
+system for large-scale machine learning", PAPERS.md). The sequence a
+:class:`ModelManager` runs for :meth:`deploy`:
+
+1. **Load off the serving path.** The candidate version is resolved and
+   checksum-verified out of the :class:`~.store.ModelStore` in the
+   caller's thread; serving workers keep draining traffic untouched.
+2. **Warm before swap.** The candidate's jitted forward is compiled and
+   executed on the bucketed batch shapes the live
+   :class:`~deeplearning4j_tpu.parallel.inference.ParallelInference`
+   actually serves (:meth:`~deeplearning4j_tpu.parallel.inference.
+   ParallelInference.bucket_sizes` × the last-served feature shape), so
+   the first post-swap request never pays an XLA compile. A warmup
+   failure aborts the deploy — the prior version stays live
+   (``dl4j_tpu_serving_swap_total{outcome="warmup_failed"}``).
+3. **Atomic swap.** One reference assignment installs the candidate; the
+   retired servable is kept resident as the rollback target. The
+   candidate gets a **fresh** :class:`~deeplearning4j_tpu.core.
+   resilience.CircuitBreaker` so the old version's failure window cannot
+   bias it.
+4. **Probation.** If the candidate's breaker opens within
+   ``probation_seconds`` of the swap, the manager rolls back to the
+   prior servable automatically
+   (``dl4j_tpu_serving_swap_total{outcome="rolled_back"}``).
+
+Canary rollout runs the candidate on a *second* engine behind a
+:class:`~.router.ModelRouter` (deterministic hash split or shadow
+mirroring) before it ever owns 100% of traffic; a canary breaker-open
+inside probation tears the canary down instead of rolling back the live
+engine. Every path is exercisable on CPU via the seeded
+:class:`~deeplearning4j_tpu.core.resilience.FaultInjector` sites
+``model_manager.load`` and ``model_manager.warmup``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.resilience import CircuitBreaker, CircuitState, get_fault_injector
+from ..obs.metrics import MetricsRegistry, Span, get_registry
+from ..parallel.inference import ParallelInference, Servable
+from .router import ModelRouter
+from .store import LATEST, ModelStore, ModelVersion, VersionNotFoundError
+
+LOAD_SITE = "model_manager.load"      # FaultInjector: artifact load
+WARMUP_SITE = "model_manager.warmup"  # FaultInjector: per-bucket warmup fwd
+
+_SWAP_OUTCOMES = ("completed", "warmup_failed", "rolled_back",
+                  "canary_started", "canary_promoted", "canary_stopped")
+
+
+class SwapError(RuntimeError):
+    """A deploy/rollback could not complete; the prior version is live."""
+
+
+class _Deployment:
+    """A resident version: servable + the breaker that judged it."""
+
+    __slots__ = ("entry", "servable", "breaker")
+
+    def __init__(self, entry: Optional[ModelVersion], servable: Servable,
+                 breaker: CircuitBreaker) -> None:
+        self.entry = entry
+        self.servable = servable
+        self.breaker = breaker
+
+    @property
+    def version(self) -> str:
+        return self.servable.version
+
+
+class ModelManager:
+    def __init__(
+        self,
+        store: ModelStore,
+        model_name: str,
+        *,
+        version: Union[int, str] = LATEST,
+        model=None,
+        engine: Optional[ParallelInference] = None,
+        batch_limit: int = 32,
+        workers: int = 2,
+        queue_limit: int = 256,
+        default_timeout: Optional[float] = None,
+        warmup_example=None,
+        probation_seconds: float = 300.0,
+        breaker_factory: Optional[Callable[[], CircuitBreaker]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        fault_injector=None,
+        registry: Optional[MetricsRegistry] = None,
+    ) -> None:
+        self.store = store
+        self.model_name = model_name
+        self._clock = clock
+        self._fault_injector = fault_injector
+        self.probation_seconds = float(probation_seconds)
+        self._breaker_factory = breaker_factory or (
+            lambda: CircuitBreaker(clock=clock))
+        self._warmup_example = warmup_example
+        self._engine_opts = dict(
+            batch_limit=batch_limit, workers=workers, queue_limit=queue_limit,
+            default_timeout=default_timeout, clock=clock,
+            fault_injector=fault_injector)
+        self.registry = registry if registry is not None else get_registry()
+        swap = self.registry.counter(
+            "dl4j_tpu_serving_swap_total",
+            "Model hot-swap lifecycle events by outcome",
+            ("model", "outcome"))
+        self._c_swap = {o: swap.labels(model_name, o) for o in _SWAP_OUTCOMES}
+        self._h_warmup = self.registry.histogram(
+            "dl4j_tpu_serving_warmup_latency_seconds",
+            "Per-bucket warmup forward latency (compile + execute)",
+            ("model",)).labels(model_name)
+        self._g_live = self.registry.gauge(
+            "dl4j_tpu_serving_live_version",
+            "Version id currently serving 100% (or primary) traffic",
+            ("model",)).labels(model_name)
+
+        self._lock = threading.RLock()
+        self._probation_until = 0.0
+        self._rolling_back = False
+        self._canary: Optional[_Deployment] = None
+        self._canary_engine: Optional[ParallelInference] = None
+        self._router: Optional[ModelRouter] = None
+
+        if engine is not None:
+            self.engine = engine
+            entry = None
+            try:
+                entry = store.resolve(model_name, engine.model_version)
+            except VersionNotFoundError:
+                pass
+            self._live = _Deployment(entry, engine._servable, engine._breaker)
+        else:
+            entry = None
+            if model is None:
+                model, entry = self._load(version)
+            elif version != LATEST:
+                entry = store.resolve(model_name, version)
+            initial_version = str(entry.version) if entry is not None else "0"
+            breaker = self._breaker_factory()
+            self.engine = ParallelInference(
+                model, circuit_breaker=breaker, registry=self.registry,
+                name=f"{model_name}-live", model_version=initial_version,
+                **self._engine_opts)
+            self._live = _Deployment(entry, self.engine._servable, breaker)
+        self._previous: Optional[_Deployment] = None
+        self._set_live_gauge()
+
+    # ----- helpers ----------------------------------------------------
+    def _inj(self):
+        return self._fault_injector or get_fault_injector()
+
+    def _load(self, version: Union[int, str]):
+        self._inj().fire(LOAD_SITE)
+        return self.store.load(self.model_name, version)
+
+    def _set_live_gauge(self) -> None:
+        try:
+            self._g_live.set(float(self._live.version))
+        except ValueError:
+            self._g_live.set(0.0)
+
+    def _warmup_shapes(self):
+        """Feature shape to warm on: explicit example wins, else the last
+        shape the live engine served, else skip warmup (nothing is known
+        about the traffic yet — the first request compiles, exactly like
+        a cold engine)."""
+        if self._warmup_example is not None:
+            ex = np.asarray(self._warmup_example)
+            return tuple(ex.shape[1:] if ex.ndim > 1 else ex.shape)
+        return self.engine.last_input_shape
+
+    def _warm(self, servable: Servable, engine: ParallelInference) -> None:
+        feat = self._warmup_shapes()
+        if feat is None:
+            return
+        dtype = servable.model.dtype
+        for b in engine.bucket_sizes():
+            x = jnp.zeros((b,) + tuple(feat), dtype)
+            with Span(self._h_warmup):
+                self._inj().fire(WARMUP_SITE)
+                np.asarray(servable.fwd(x))  # block until executed
+
+    # ----- deploy / rollback ------------------------------------------
+    @property
+    def live_version(self) -> str:
+        return self._live.version
+
+    @property
+    def previous_version(self) -> Optional[str]:
+        return self._previous.version if self._previous else None
+
+    @property
+    def canary_version(self) -> Optional[str]:
+        return self._canary.version if self._canary else None
+
+    def deploy(self, version: Union[int, str] = LATEST) -> ModelVersion:
+        """Zero-downtime hot swap to ``version``: load + verify + warm off
+        the serving path, then atomically install. On warmup failure the
+        prior version stays live and :class:`SwapError` is raised. The
+        new version serves under a fresh circuit breaker and is on
+        probation for ``probation_seconds`` — a breaker-open inside that
+        window rolls back automatically."""
+        with self._lock:
+            entry = self.store.resolve(self.model_name, version)
+            if str(entry.version) == self._live.version:
+                return entry
+            model, entry = self._load(entry.version)
+            servable = self.engine.make_servable(
+                model, version=str(entry.version))
+            try:
+                self._warm(servable, self.engine)
+            except Exception as e:
+                self._c_swap["warmup_failed"].inc()
+                raise SwapError(
+                    f"{self.model_name} v{entry.version}: warmup failed, "
+                    f"keeping v{self._live.version} live: {e}") from e
+            breaker = self._breaker_factory()
+            breaker.add_observer(self._on_candidate_transition)
+            old_breaker = self._live.breaker
+            self.engine.swap(servable, circuit_breaker=breaker)
+            old_breaker.remove_observer(self._on_candidate_transition)
+            self._previous = self._live
+            self._live = _Deployment(entry, servable, breaker)
+            self._probation_until = self._clock() + self.probation_seconds
+            self._rolling_back = False
+            self._c_swap["completed"].inc()
+            self._set_live_gauge()
+            self.registry.log_event(
+                "model_swap", model=self.model_name,
+                version=str(entry.version),
+                previous=self._previous.version)
+            return entry
+
+    def _on_candidate_transition(self, old: CircuitState,
+                                 new: CircuitState) -> None:
+        """Breaker observer for the probationary live version: an OPEN
+        inside the probation window triggers automatic rollback.
+
+        Deliberately lock-free: this can fire from any thread that reads
+        ``breaker.state`` — including one already holding the engine's
+        lock (``output_async``) while ``deploy`` holds the manager lock
+        and wants the engine's (ABBA). The screen below is a benign
+        race; the reaper thread re-verifies under the lock."""
+        if new is not CircuitState.OPEN:
+            return
+        live = self._live
+        if (self._rolling_back or self._previous is None
+                or self._clock() > self._probation_until):
+            return
+        threading.Thread(target=self._auto_rollback, args=(live,),
+                         name=f"{self.model_name}-rollback",
+                         daemon=True).start()
+
+    def _auto_rollback(self, dep: _Deployment) -> None:
+        with self._lock:
+            # identity check: if a newer deploy landed between the trip
+            # and this reaper, the open breaker belonged to a version
+            # that is no longer live — do not roll back the newcomer
+            if (dep is not self._live or self._rolling_back
+                    or self._previous is None
+                    or self._clock() > self._probation_until):
+                return
+            self._rolling_back = True
+            self._rollback_locked()
+
+    def rollback(self) -> ModelVersion:
+        """Manually swap back to the previously live version."""
+        with self._lock:
+            if self._previous is None:
+                raise SwapError(f"{self.model_name}: no previous version "
+                                f"resident to roll back to")
+            return self._rollback_locked().entry
+
+    def _rollback_locked(self) -> _Deployment:
+        bad = self._live
+        good = self._previous
+        bad.breaker.remove_observer(self._on_candidate_transition)
+        # counter first: anyone who observes the version flip must also
+        # see the rollback already counted
+        self._c_swap["rolled_back"].inc()
+        self.engine.swap(good.servable, circuit_breaker=good.breaker)
+        self._live = good
+        self._previous = None  # the bad version is not a rollback target
+        self._probation_until = 0.0
+        self._set_live_gauge()
+        self.registry.log_event(
+            "model_rollback", model=self.model_name,
+            version=good.version, rolled_back_from=bad.version)
+        return good
+
+    def confirm(self) -> None:
+        """End probation early: the live version is declared good."""
+        with self._lock:
+            self._probation_until = 0.0
+            self._live.breaker.remove_observer(self._on_candidate_transition)
+
+    # ----- canary / shadow --------------------------------------------
+    def start_canary(self, version: Union[int, str], *,
+                     weight: float = 0.05, shadow: bool = False,
+                     workers: int = 1) -> ModelVersion:
+        """Load + warm ``version`` on a second engine and route ``weight``
+        of traffic (deterministic per request key) to it — or, with
+        ``shadow=True``, mirror every request to it while responses keep
+        coming from the live version. A canary breaker-open inside the
+        probation window stops the canary automatically."""
+        with self._lock:
+            if self._canary is not None:
+                raise SwapError(f"{self.model_name}: canary v"
+                                f"{self._canary.version} already running")
+            model, entry = self._load(version)
+            breaker = self._breaker_factory()
+            opts = dict(self._engine_opts)
+            opts["workers"] = workers
+            engine = ParallelInference(
+                model, circuit_breaker=breaker, registry=self.registry,
+                name=f"{self.model_name}-canary",
+                model_version=str(entry.version), **opts)
+            try:
+                self._warm(engine._servable, engine)
+            except Exception as e:
+                engine.shutdown(drain=False)
+                self._c_swap["warmup_failed"].inc()
+                raise SwapError(
+                    f"{self.model_name} v{entry.version}: canary warmup "
+                    f"failed: {e}") from e
+            breaker.add_observer(self._on_canary_transition)
+            self._canary = _Deployment(entry, engine._servable, breaker)
+            self._canary_engine = engine
+            self._router = ModelRouter(
+                self.engine,
+                canary=None if shadow else engine,
+                canary_weight=0.0 if shadow else weight,
+                shadow=engine if shadow else None,
+                name=self.model_name, registry=self.registry)
+            self._probation_until = self._clock() + self.probation_seconds
+            self._c_swap["canary_started"].inc()
+            self.registry.log_event(
+                "canary_start", model=self.model_name,
+                version=str(entry.version), weight=weight, shadow=shadow)
+            return entry
+
+    def _on_canary_transition(self, old: CircuitState,
+                              new: CircuitState) -> None:
+        if new is not CircuitState.OPEN:
+            return
+        # Lock-free screen, like _on_candidate_transition. Beyond the
+        # lock-order hazard, this observer fires on the canary engine's
+        # own worker thread (whichever recorded the tripping failure) and
+        # tearing the engine down would join that thread — so the reaper
+        # is mandatory here, not just defensive. Until it runs,
+        # canary-routed requests fail fast with CircuitOpenError, which
+        # is the correct interim behavior.
+        canary = self._canary
+        if canary is None or self._clock() > self._probation_until:
+            return
+        threading.Thread(target=self._abort_canary, args=(canary,),
+                         name=f"{self.model_name}-canary-reaper",
+                         daemon=True).start()
+
+    def _abort_canary(self, dep: _Deployment) -> None:
+        with self._lock:
+            if self._canary is not dep:  # stopped/replaced in the interim
+                return
+            self._stop_canary_locked()
+            self._c_swap["rolled_back"].inc()
+            self.registry.log_event(
+                "canary_rollback", model=self.model_name,
+                version=dep.version)
+
+    def promote_canary(self) -> ModelVersion:
+        """The canary won: hot-swap its version onto the live engine
+        (full deploy path: warmed, fresh breaker, probation), then tear
+        the canary engine down."""
+        with self._lock:
+            if self._canary is None:
+                raise SwapError(f"{self.model_name}: no canary to promote")
+            version = self._canary.entry.version
+            self._stop_canary_locked()
+            entry = self.deploy(version)
+            self._c_swap["canary_promoted"].inc()
+            return entry
+
+    def stop_canary(self) -> None:
+        with self._lock:
+            if self._canary is None:
+                return
+            self._stop_canary_locked()
+
+    def _stop_canary_locked(self) -> None:
+        engine, dep = self._canary_engine, self._canary
+        self._canary = None
+        self._canary_engine = None
+        self._router = None
+        dep.breaker.remove_observer(self._on_canary_transition)
+        engine.shutdown(drain=True, drain_timeout=10.0)
+        self._c_swap["canary_stopped"].inc()
+
+    # ----- request path -----------------------------------------------
+    def submit(self, x, *, key: Optional[str] = None,
+               version: Optional[Union[int, str]] = None,
+               timeout: Optional[float] = None, deadline=None):
+        """Route one request; returns ``(future, version_str)``. A pinned
+        ``version`` must be resident and serving (the live version, or
+        the canary) — pinning is how a client deterministically hits the
+        canary or asserts which version answered."""
+        if version is not None:
+            want = str(version).lstrip("v")
+            if want == self._live.version:
+                fut = self.engine.output_async(
+                    x, timeout=timeout, deadline=deadline)
+                return fut, self._live.version
+            canary, engine = self._canary, self._canary_engine
+            if canary is not None and want == canary.version:
+                fut = engine.output_async(
+                    x, timeout=timeout, deadline=deadline)
+                return fut, canary.version
+            raise VersionNotFoundError(
+                f"{self.model_name} v{want} is not currently serving "
+                f"(live=v{self._live.version}, canary="
+                f"{'v' + canary.version if canary else 'none'})")
+        router = self._router
+        if router is not None:
+            fut, _target, served = router.submit(
+                x, key=key, timeout=timeout, deadline=deadline)
+            return fut, served
+        fut = self.engine.output_async(x, timeout=timeout, deadline=deadline)
+        return fut, self._live.version
+
+    def output(self, x, *, key: Optional[str] = None,
+               version: Optional[Union[int, str]] = None,
+               timeout: Optional[float] = None) -> np.ndarray:
+        fut, _ = self.submit(x, key=key, version=version, timeout=timeout)
+        return fut.result()
+
+    # ----- introspection / lifecycle ----------------------------------
+    def describe(self) -> Dict:
+        with self._lock:
+            canary = None
+            if self._canary is not None:
+                canary = {
+                    "version": self._canary.version,
+                    "weight": self._router.canary_weight if self._router else 0.0,
+                    "shadow": bool(self._router and self._router.shadow is not None),
+                    "circuit": self._canary.breaker.state.value,
+                }
+            return {
+                "name": self.model_name,
+                "live_version": self._live.version,
+                "previous_version": self.previous_version,
+                "canary": canary,
+                "probation_remaining": max(
+                    0.0, self._probation_until - self._clock()),
+                "circuit": self._live.breaker.state.value,
+            }
+
+    def resident_versions(self):
+        """Version ids that must survive GC (live, rollback target,
+        canary)."""
+        out = set()
+        with self._lock:
+            for dep in (self._live, self._previous, self._canary):
+                if dep is not None and dep.version.isdigit():
+                    out.add(int(dep.version))
+        return out
+
+    def gc(self, *, keep_last: Optional[int] = None) -> Dict:
+        """Store GC for this model, protecting every resident version."""
+        return self.store.gc(self.model_name, keep_last=keep_last,
+                             in_use=self.resident_versions())
+
+    def stats(self) -> Dict:
+        s = self.engine.stats()
+        if self._canary_engine is not None:
+            s["canary"] = self._canary_engine.stats()
+        return s
+
+    def shutdown(self, *, drain: bool = True,
+                 drain_timeout: Optional[float] = 30.0) -> None:
+        with self._lock:
+            if self._canary is not None:
+                self._stop_canary_locked()
+        self.engine.shutdown(drain=drain, drain_timeout=drain_timeout)

@@ -1,0 +1,346 @@
+"""ModelManager hot-swap: zero-downtime swaps under concurrent load,
+rollback on warmup failure, rollback on breaker-open within probation,
+canary lifecycle (serving/manager.py). All on CPU via the seeded
+FaultInjector and fake clocks — ISSUE 4 acceptance criteria."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.core.resilience import CircuitBreaker, FaultInjector
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.obs import MetricsRegistry
+from deeplearning4j_tpu.parallel.inference import FORWARD_SITE
+from deeplearning4j_tpu.serving import (
+    WARMUP_SITE,
+    ModelManager,
+    ModelStore,
+    SwapError,
+    VersionNotFoundError,
+)
+
+
+def _model(seed=1):
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = ModelStore(str(tmp_path / "registry"))
+    s.publish("m", _model(1))
+    s.publish("m", _model(2))
+    return s
+
+
+def _swap_count(registry, outcome):
+    fam = registry.get("dl4j_tpu_serving_swap_total")
+    return fam.labels("m", outcome).value if fam else 0.0
+
+
+def test_hot_swap_under_concurrent_load_zero_failures(store):
+    """The acceptance-criterion test: a client thread pool hammers the
+    engine while versions swap back and forth; every request succeeds
+    and every response is exactly one of the two versions' outputs."""
+    reg = MetricsRegistry()
+    mgr = ModelManager(store, "m", version=1, registry=reg, workers=2,
+                       batch_limit=4, probation_seconds=0.0)
+    x = np.random.RandomState(3).randn(1, 4).astype(np.float32)
+    m1, _ = store.load("m", 1)
+    m2, _ = store.load("m", 2)
+    # tolerance, not bytes: the engine's bucketed/padded batch forward is
+    # not bit-identical to a single-row model.output
+    expect = [np.asarray(m1.output(x), np.float32),
+              np.asarray(m2.output(x), np.float32)]
+
+    n_clients, n_swaps = 6, 4
+    failures = []
+    mismatches = []
+    swapping = threading.Event()
+    swapping.set()
+
+    def client():
+        # hammer until every swap has happened (≥1 request guaranteed)
+        done_once = False
+        while swapping.is_set() or not done_once:
+            done_once = True
+            try:
+                out = np.asarray(mgr.output(x, timeout=30.0), np.float32)
+                if not any(np.allclose(out, e, atol=1e-4) for e in expect):
+                    mismatches.append(out)
+            except Exception as e:  # any failure breaks the criterion
+                failures.append(e)
+
+    threads = [threading.Thread(target=client) for _ in range(n_clients)]
+    for t in threads:
+        t.start()
+
+    def swapper():
+        try:
+            v = 2
+            for _ in range(n_swaps):
+                mgr.deploy(v)
+                v = 1 if v == 2 else 2
+                time.sleep(0.05)
+        finally:
+            swapping.clear()
+
+    sw = threading.Thread(target=swapper)
+    sw.start()
+    sw.join(timeout=300)
+    for t in threads:
+        t.join(timeout=120)
+    try:
+        assert failures == [], f"requests failed during swap: {failures[:3]}"
+        assert mismatches == [], "a response matched neither version"
+        s = mgr.stats()
+        assert s["completed"] >= n_clients  # every client got answers
+        assert s["failed"] == 0 and s["shed"] == 0 and s["timed_out"] == 0
+        assert _swap_count(reg, "completed") == n_swaps
+    finally:
+        mgr.shutdown(drain=False)
+
+
+def test_warmup_failure_keeps_prior_version_live(store):
+    reg = MetricsRegistry()
+    inj = FaultInjector()
+    mgr = ModelManager(store, "m", version=1, registry=reg,
+                       fault_injector=inj, batch_limit=4)
+    x = np.ones((2, 4), np.float32)
+    before = np.asarray(mgr.output(x))  # also seeds last_input_shape
+    inj.inject_error(WARMUP_SITE, lambda: RuntimeError("bad compile"),
+                     times=1)
+    with pytest.raises(SwapError, match="warmup failed"):
+        mgr.deploy(2)
+    try:
+        assert mgr.live_version == "1"
+        np.testing.assert_allclose(np.asarray(mgr.output(x)), before,
+                                   atol=1e-6)
+        assert _swap_count(reg, "warmup_failed") == 1
+        assert _swap_count(reg, "completed") == 0
+        # the store is intact: a later deploy (no fault armed) succeeds
+        mgr.deploy(2)
+        assert mgr.live_version == "2"
+    finally:
+        mgr.shutdown(drain=False)
+
+
+def test_breaker_open_in_probation_rolls_back_automatically(store):
+    clk = [0.0]
+    reg = MetricsRegistry()
+    inj = FaultInjector()
+    mgr = ModelManager(
+        store, "m", version=1, registry=reg, fault_injector=inj,
+        workers=1, batch_limit=4, probation_seconds=60.0,
+        clock=lambda: clk[0],
+        breaker_factory=lambda: CircuitBreaker(
+            failure_threshold=1.0, min_calls=2, window=4,
+            open_timeout=60.0, clock=lambda: clk[0]))
+    x = np.ones((2, 4), np.float32)
+    v1_out = np.asarray(mgr.output(x))
+    mgr.deploy(2)
+    assert mgr.live_version == "2"
+    inj.inject_error(FORWARD_SITE, lambda: RuntimeError("poisoned"), times=2)
+    for _ in range(2):
+        with pytest.raises(RuntimeError):
+            mgr.output(x, timeout=10.0)
+    try:
+        for _ in range(500):  # rollback fires from the worker thread
+            if mgr.live_version == "1":
+                break
+            time.sleep(0.01)
+        assert mgr.live_version == "1"
+        assert _swap_count(reg, "rolled_back") == 1
+        np.testing.assert_allclose(np.asarray(mgr.output(x)), v1_out,
+                                   atol=1e-6)
+        assert mgr.describe()["circuit"] == "closed"
+    finally:
+        mgr.shutdown(drain=False)
+
+
+def test_breaker_open_after_probation_does_not_roll_back(store):
+    clk = [0.0]
+    inj = FaultInjector()
+    mgr = ModelManager(
+        store, "m", version=1, fault_injector=inj, registry=MetricsRegistry(),
+        workers=1, batch_limit=4, probation_seconds=60.0,
+        clock=lambda: clk[0],
+        breaker_factory=lambda: CircuitBreaker(
+            failure_threshold=1.0, min_calls=2, window=4,
+            open_timeout=60.0, clock=lambda: clk[0]))
+    x = np.ones((2, 4), np.float32)
+    mgr.output(x)
+    mgr.deploy(2)
+    clk[0] += 61.0  # probation window elapses
+    inj.inject_error(FORWARD_SITE, lambda: RuntimeError("poisoned"), times=2)
+    for _ in range(2):
+        with pytest.raises(RuntimeError):
+            mgr.output(x, timeout=10.0)
+    try:
+        time.sleep(0.1)
+        assert mgr.live_version == "2"  # breaker open, but no rollback
+    finally:
+        mgr.shutdown(drain=False)
+
+
+def test_manual_rollback_and_confirm(store):
+    mgr = ModelManager(store, "m", version=1, registry=MetricsRegistry(),
+                       batch_limit=4)
+    with pytest.raises(SwapError):
+        mgr.rollback()  # nothing resident to roll back to
+    x = np.ones((1, 4), np.float32)
+    mgr.output(x)
+    mgr.deploy(2)
+    assert mgr.previous_version == "1"
+    mgr.confirm()
+    assert mgr.describe()["probation_remaining"] == 0.0
+    entry = mgr.rollback()
+    try:
+        assert (entry.version, mgr.live_version) == (1, "1")
+        assert mgr.previous_version is None
+    finally:
+        mgr.shutdown(drain=False)
+
+
+def test_deploy_same_version_is_noop(store):
+    reg = MetricsRegistry()
+    mgr = ModelManager(store, "m", version=2, registry=reg, batch_limit=4)
+    try:
+        entry = mgr.deploy(2)
+        assert entry.version == 2
+        assert _swap_count(reg, "completed") == 0
+    finally:
+        mgr.shutdown(drain=False)
+
+
+def test_per_version_request_counters_and_pinning(store):
+    reg = MetricsRegistry()
+    mgr = ModelManager(store, "m", version=1, registry=reg, batch_limit=4)
+    x = np.ones((1, 4), np.float32)
+    try:
+        mgr.output(x)
+        mgr.deploy(2)
+        mgr.output(x)
+        mgr.output(x)
+        fam = reg.get("dl4j_tpu_serving_model_requests_total")
+        assert fam.labels("m-live", "1").value == 1
+        assert fam.labels("m-live", "2").value == 2
+        # pinning: live version answers, absent version is a loud miss
+        fut, served = mgr.submit(x, version=2)
+        fut.result()
+        assert served == "2"
+        with pytest.raises(VersionNotFoundError):
+            mgr.submit(x, version=9)
+        assert mgr.stats()["model_version"] == "2"
+    finally:
+        mgr.shutdown(drain=False)
+
+
+def test_canary_rollout_and_promotion(store):
+    reg = MetricsRegistry()
+    mgr = ModelManager(store, "m", version=1, registry=reg, batch_limit=4,
+                       probation_seconds=60.0)
+    x = np.ones((1, 4), np.float32)
+    try:
+        mgr.output(x)
+        mgr.start_canary(2, weight=0.5)
+        desc = mgr.describe()
+        assert desc["canary"] == {"version": "2", "weight": 0.5,
+                                  "shadow": False, "circuit": "closed"}
+        served = set()
+        for i in range(40):
+            fut, v = mgr.submit(x, key=f"user-{i}")
+            fut.result()
+            served.add(v)
+        assert served == {"1", "2"}  # both sides of the split saw traffic
+        # the same key always lands on the same side
+        v_first = mgr.submit(x, key="sticky")[1]
+        for _ in range(5):
+            assert mgr.submit(x, key="sticky")[1] == v_first
+        mgr.promote_canary()
+        assert mgr.live_version == "2"
+        assert mgr.canary_version is None
+        assert _swap_count(reg, "canary_promoted") == 1
+    finally:
+        mgr.shutdown(drain=False)
+
+
+def test_canary_breaker_open_stops_canary_not_live(store):
+    clk = [0.0]
+    reg = MetricsRegistry()
+    inj = FaultInjector()
+    mgr = ModelManager(
+        store, "m", version=1, registry=reg, fault_injector=inj,
+        workers=1, batch_limit=4, probation_seconds=60.0,
+        clock=lambda: clk[0],
+        breaker_factory=lambda: CircuitBreaker(
+            failure_threshold=1.0, min_calls=2, window=4,
+            open_timeout=60.0, clock=lambda: clk[0]))
+    x = np.ones((1, 4), np.float32)
+    try:
+        mgr.output(x)
+        mgr.start_canary(2, weight=1.0)  # all traffic to the canary
+        inj.inject_error(FORWARD_SITE, lambda: RuntimeError("poisoned"),
+                         times=2)
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                mgr.output(x, timeout=10.0)
+        for _ in range(500):  # reaper tears the canary down asynchronously
+            if _swap_count(reg, "rolled_back") >= 1:
+                break
+            time.sleep(0.01)
+        assert mgr.canary_version is None  # canary torn down...
+        assert mgr.live_version == "1"     # ...live untouched
+        assert _swap_count(reg, "rolled_back") == 1
+        np.testing.assert_allclose(
+            np.asarray(mgr.output(x)),
+            np.asarray(store.load("m", 1)[0].output(x)), atol=1e-6)
+    finally:
+        mgr.shutdown(drain=False)
+
+
+def test_shadow_mode_mirrors_without_affecting_responses(store):
+    reg = MetricsRegistry()
+    mgr = ModelManager(store, "m", version=1, registry=reg, batch_limit=4)
+    x = np.ones((1, 4), np.float32)
+    try:
+        v1_out = np.asarray(mgr.output(x))
+        mgr.start_canary(2, shadow=True)
+        for i in range(5):
+            fut, v = mgr.submit(x, key=f"k{i}")
+            assert v == "1"  # responses come from live only
+            np.testing.assert_allclose(np.asarray(fut.result()), v1_out,
+                                       atol=1e-6)
+        for _ in range(500):  # mirrored submissions settle asynchronously
+            if mgr._canary_engine.stats()["completed"] >= 5:
+                break
+            time.sleep(0.01)
+        assert mgr._canary_engine.stats()["completed"] == 5
+        fam = reg.get("dl4j_tpu_serving_routes_total")
+        assert fam.labels("m", "shadow").value == 5
+        assert fam.labels("m", "primary").value == 5
+        assert fam.labels("m", "canary").value == 0
+    finally:
+        mgr.shutdown(drain=False)
+
+
+def test_manager_gc_protects_resident_versions(store):
+    for seed in (3, 4, 5):
+        store.publish("m", _model(seed))  # now v1..v5
+    mgr = ModelManager(store, "m", version=4, registry=MetricsRegistry(),
+                       batch_limit=4)
+    x = np.ones((1, 4), np.float32)
+    try:
+        mgr.output(x)
+        mgr.deploy(5)  # live=5, previous=4
+        removed = mgr.gc(keep_last=1)
+        assert removed == {"m": [1, 2, 3]}
+        assert [v.version for v in store.versions("m")] == [4, 5]
+    finally:
+        mgr.shutdown(drain=False)

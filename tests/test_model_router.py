@@ -1,0 +1,132 @@
+"""ModelRouter: deterministic hash splitting + shadow mirroring
+(serving/router.py), unit-tested against fake backends."""
+
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.obs import MetricsRegistry
+from deeplearning4j_tpu.serving import ModelRouter
+
+
+class FakeBackend:
+    def __init__(self, version, result=0.0, fail=False):
+        self.model_version = str(version)
+        self.result = result
+        self.fail = fail
+        self.calls = []
+
+    def output_async(self, x, *, timeout=None, deadline=None):
+        self.calls.append(np.asarray(x))
+        fut = Future()
+        if self.fail:
+            fut.set_exception(RuntimeError("backend down"))
+        else:
+            fut.set_result(np.full((1,), self.result))
+        return fut
+
+
+def test_weight_zero_routes_everything_primary():
+    p, c = FakeBackend(1), FakeBackend(2)
+    r = ModelRouter(p, canary=c, canary_weight=0.0,
+                    registry=MetricsRegistry())
+    for i in range(20):
+        assert r.assign(np.zeros(2), key=f"k{i}") == "primary"
+
+
+def test_weight_one_routes_everything_canary():
+    p, c = FakeBackend(1), FakeBackend(2)
+    r = ModelRouter(p, canary=c, canary_weight=1.0,
+                    registry=MetricsRegistry())
+    for i in range(20):
+        assert r.assign(np.zeros(2), key=f"k{i}") == "canary"
+
+
+def test_assignment_is_deterministic_per_key():
+    p, c = FakeBackend(1), FakeBackend(2)
+    r = ModelRouter(p, canary=c, canary_weight=0.3, salt="s",
+                    registry=MetricsRegistry())
+    first = {f"k{i}": r.assign(np.zeros(2), key=f"k{i}") for i in range(50)}
+    for k, want in first.items():
+        assert r.assign(np.ones(2), key=k) == want  # payload irrelevant
+    # a different salt reshuffles the split
+    r2 = ModelRouter(p, canary=c, canary_weight=0.3, salt="other",
+                     registry=MetricsRegistry())
+    assert any(r2.assign(np.zeros(2), key=k) != v for k, v in first.items())
+
+
+def test_keyless_requests_hash_payload():
+    p, c = FakeBackend(1), FakeBackend(2)
+    r = ModelRouter(p, canary=c, canary_weight=0.5,
+                    registry=MetricsRegistry())
+    x = np.arange(8, dtype=np.float32)
+    assert len({r.assign(x) for _ in range(5)}) == 1  # stable per payload
+
+
+def test_split_fraction_tracks_weight():
+    p, c = FakeBackend(1), FakeBackend(2)
+    r = ModelRouter(p, canary=c, canary_weight=0.25,
+                    registry=MetricsRegistry())
+    hits = sum(r.assign(np.zeros(2), key=f"user-{i}") == "canary"
+               for i in range(2000))
+    assert 0.18 < hits / 2000 < 0.32
+
+
+def test_submit_returns_owning_version_and_counts():
+    reg = MetricsRegistry()
+    p, c = FakeBackend(1, result=1.0), FakeBackend(2, result=2.0)
+    r = ModelRouter(p, canary=c, canary_weight=0.5, name="m", registry=reg)
+    seen = {"1": 0, "2": 0}
+    for i in range(40):
+        fut, target, version = r.submit(np.zeros(2), key=f"u{i}")
+        out = fut.result()
+        assert out[0] == float(version)  # response came from that backend
+        assert (target == "canary") == (version == "2")
+        seen[version] += 1
+    assert seen["1"] > 0 and seen["2"] > 0
+    fam = reg.get("dl4j_tpu_serving_routes_total")
+    assert fam.labels("m", "primary").value == seen["1"]
+    assert fam.labels("m", "canary").value == seen["2"]
+
+
+def test_shadow_mirrors_every_request_fail_open():
+    reg = MetricsRegistry()
+    p = FakeBackend(1, result=1.0)
+    sh = FakeBackend(9, fail=True)  # shadow is broken — must not matter
+    r = ModelRouter(p, shadow=sh, name="m", registry=reg)
+    for _ in range(10):
+        fut, target, version = r.submit(np.zeros(2))
+        assert fut.result()[0] == 1.0 and target == "primary"
+    assert len(sh.calls) == 10
+    fam = reg.get("dl4j_tpu_serving_routes_total")
+    assert fam.labels("m", "shadow").value == 10
+
+
+def test_shadow_sync_raise_is_swallowed():
+    class Exploding(FakeBackend):
+        def output_async(self, x, **kw):
+            raise RuntimeError("admission rejected")
+
+    p = FakeBackend(1, result=1.0)
+    r = ModelRouter(p, shadow=Exploding(9), registry=MetricsRegistry())
+    fut, _, _ = r.submit(np.zeros(2))
+    assert fut.result()[0] == 1.0
+
+
+def test_shadow_receives_a_copy_not_the_live_buffer():
+    p, sh = FakeBackend(1), FakeBackend(2)
+    r = ModelRouter(p, shadow=sh, registry=MetricsRegistry())
+    x = np.zeros(4, np.float32)
+    r.submit(x)
+    x += 99.0  # caller mutates after submit
+    assert sh.calls[0][0] == 0.0  # the mirror saw the original values
+
+
+def test_invalid_weights_rejected():
+    p, c = FakeBackend(1), FakeBackend(2)
+    with pytest.raises(ValueError):
+        ModelRouter(p, canary=c, canary_weight=1.5,
+                    registry=MetricsRegistry())
+    with pytest.raises(ValueError):
+        ModelRouter(p, canary_weight=0.5, registry=MetricsRegistry())

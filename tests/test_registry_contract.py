@@ -1,0 +1,18 @@
+"""Tier-1 wiring for tools/check_registry_contract.py: the model-registry
+lifecycle contract (publish → resolve → serve → swap → rollback → gc,
+README.md "Model registry & hot-swap serving") is enforced on every test
+run, not just when someone remembers to run the tool."""
+
+import os
+import sys
+
+_TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+
+
+def test_registry_contract_smoke():
+    sys.path.insert(0, _TOOLS)
+    try:
+        import check_registry_contract
+    finally:
+        sys.path.remove(_TOOLS)
+    assert check_registry_contract.main(log=lambda m: None) == 0

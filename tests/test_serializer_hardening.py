@@ -1,0 +1,119 @@
+"""Serializer hardening: atomic writes, loud load-time validation
+(model/serializer.py — ISSUE 4 satellites)."""
+
+import io
+import json
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.model import serializer
+from deeplearning4j_tpu.model.serializer import (
+    restore_model,
+    restore_multi_layer_network,
+    write_model,
+)
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+
+def _model(seed=1):
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _rewrite_entry(path, name, data: bytes) -> None:
+    """Rewrite one zip entry (zips are append-only; rebuild)."""
+    with zipfile.ZipFile(path) as zf:
+        entries = {n: zf.read(n) for n in zf.namelist()}
+    entries[name] = data
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        for n, d in entries.items():
+            zf.writestr(n, d)
+
+
+def test_crashed_write_never_clobbers_existing_artifact(tmp_path, monkeypatch):
+    path = str(tmp_path / "model.zip")
+    m1 = _model(1)
+    write_model(m1, path)
+    x = np.ones((2, 4), np.float32)
+    expected = np.asarray(m1.output(x))
+
+    def boom(tree):
+        raise RuntimeError("crash mid-serialize")
+
+    monkeypatch.setattr(serializer, "_leaves_to_npz", boom)
+    with pytest.raises(RuntimeError):
+        write_model(_model(2), path)
+    monkeypatch.undo()
+    # the original artifact survives byte-identical in behavior and no
+    # temp debris remains in the directory
+    assert [f for f in os.listdir(tmp_path) if f.startswith(".tmp-")] == []
+    restored = restore_multi_layer_network(path)
+    np.testing.assert_allclose(np.asarray(restored.output(x)), expected,
+                               atol=1e-6)
+
+
+def test_write_model_to_fresh_path_is_complete(tmp_path):
+    path = str(tmp_path / "sub" / "model.zip")
+    os.makedirs(os.path.dirname(path))
+    write_model(_model(1), path)
+    assert zipfile.is_zipfile(path)
+
+
+def test_coefficient_length_mismatch_is_loud(tmp_path):
+    path = str(tmp_path / "model.zip")
+    write_model(_model(1), path)
+    buf = io.BytesIO()
+    np.save(buf, np.zeros(7, np.float32))  # wrong size on purpose
+    _rewrite_entry(path, "coefficients.npy", buf.getvalue())
+    with pytest.raises(ValueError, match="coefficient vector has 7"):
+        restore_multi_layer_network(path)
+
+
+def test_load_updater_without_updater_state_raises(tmp_path):
+    path = str(tmp_path / "model.zip")
+    write_model(_model(1), path, save_updater=False)
+    with pytest.raises(ValueError, match="save_updater"):
+        restore_multi_layer_network(path, load_updater=True)
+    # explicit opt-out still loads
+    assert restore_multi_layer_network(path, load_updater=False) is not None
+
+
+def test_unknown_model_class_hard_errors(tmp_path):
+    path = str(tmp_path / "model.zip")
+    write_model(_model(1), path)
+    with zipfile.ZipFile(path) as zf:
+        meta = json.loads(zf.read("meta.json"))
+    meta["model_class"] = "FancyFutureNetwork"
+    _rewrite_entry(path, "meta.json", json.dumps(meta).encode())
+    with pytest.raises(ValueError, match="unknown model_class"):
+        restore_model(path)
+
+
+def test_foreign_framework_hard_errors(tmp_path):
+    path = str(tmp_path / "model.zip")
+    write_model(_model(1), path)
+    with zipfile.ZipFile(path) as zf:
+        meta = json.loads(zf.read("meta.json"))
+    meta["framework"] = "someone_elses_dl"
+    _rewrite_entry(path, "meta.json", json.dumps(meta).encode())
+    with pytest.raises(ValueError, match="framework"):
+        restore_model(path)
+
+
+def test_framework_version_skew_warns_but_loads(tmp_path):
+    path = str(tmp_path / "model.zip")
+    write_model(_model(1), path)
+    with zipfile.ZipFile(path) as zf:
+        meta = json.loads(zf.read("meta.json"))
+    meta["version"] = "0.0.0-ancient"
+    _rewrite_entry(path, "meta.json", json.dumps(meta).encode())
+    with pytest.warns(UserWarning, match="0.0.0-ancient"):
+        model = restore_model(path)
+    assert model is not None
