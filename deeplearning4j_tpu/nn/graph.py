@@ -108,6 +108,31 @@ class ComputationGraph:
         if not self._initialized:
             self.init()
 
+    def migrate_state(self) -> None:
+        """Fill persistent-state keys added by newer framework versions with
+        their ``init_state`` defaults, keeping existing values (see
+        MultiLayerNetwork.migrate_state — e.g. PR 3's MoE
+        ``expert_tokens``/``dropped_tokens`` keys)."""
+        if not self._initialized:
+            return
+        changed = False
+        for spec in self.conf.vertices:
+            if spec.layer is None:
+                continue
+            defaults = spec.layer.init_state(self.dtype)
+            if not defaults:
+                continue
+            cur = dict(self.state.get(spec.name, {}))
+            missing = [k for k in defaults if k not in cur]
+            if missing:
+                for k in missing:
+                    cur[k] = defaults[k]
+                self.state[spec.name] = cur
+                self._persistent_keys[spec.name] = tuple(cur.keys())
+                changed = True
+        if changed:
+            self._output_fn_cache.clear()
+
     # -------------------------------------------------------------- forward
     def forward_pure(
         self,

@@ -36,6 +36,12 @@ class BatchNormalizationLayer(Layer):
     lock_gamma_beta: bool = False
     gamma_init: float = 1.0
     beta_init: float = 0.0
+    # Affine-precompute form (set by rewrite.BatchNormAffinePass): apply the
+    # normalization as ONE fused multiply-add with per-channel
+    # scale = gamma*rsqrt(var+eps), shift = beta - mean*scale, instead of the
+    # 4-op subtract/rsqrt/scale/shift chain — same math to float tolerance,
+    # but XLA fuses the single FMA into the neighbouring op's epilogue.
+    fused: bool = False
 
     def with_input(self, input_type: InputType) -> "BatchNormalizationLayer":
         if self.n_out:
@@ -88,10 +94,19 @@ class BatchNormalizationLayer(Layer):
         else:
             mean, var = state["mean"].astype(stat_dtype), state["var"].astype(stat_dtype)
             new_state = state
-        xhat = (x32 - mean.reshape(bshape)) * jax.lax.rsqrt(var.reshape(bshape) + self.eps)
-        if not self.lock_gamma_beta:
-            xhat = (xhat * params["gamma"].astype(stat_dtype).reshape(bshape)
-                    + params["beta"].astype(stat_dtype).reshape(bshape))
+        if self.fused:
+            rstd = jax.lax.rsqrt(var + self.eps)
+            if self.lock_gamma_beta:
+                scale, shift = rstd, -mean * rstd
+            else:
+                scale = params["gamma"].astype(stat_dtype) * rstd
+                shift = params["beta"].astype(stat_dtype) - mean * scale
+            xhat = x32 * scale.reshape(bshape) + shift.reshape(bshape)
+        else:
+            xhat = (x32 - mean.reshape(bshape)) * jax.lax.rsqrt(var.reshape(bshape) + self.eps)
+            if not self.lock_gamma_beta:
+                xhat = (xhat * params["gamma"].astype(stat_dtype).reshape(bshape)
+                        + params["beta"].astype(stat_dtype).reshape(bshape))
         act = self.activation or Activation.IDENTITY
         return act(xhat).astype(x.dtype), new_state
 

@@ -1,0 +1,40 @@
+"""Graph rewrite passes (README "Graph optimization passes").
+
+Pattern-match-and-rewrite over ``MultiLayerConfiguration`` /
+``ComputationGraphConfiguration`` configs **plus their params**: each
+pass returns a numerically equivalent (config, params, state) triple.
+Rewrites are in-memory only — serialized artifacts always store the
+un-rewritten model.
+
+Entry points: ``Solver``/``GraphSolver`` ``optimize=`` (training-safe
+set), ``ModelManager`` ``optimize=`` (inference set, applied before
+warmup on every deploy/canary), or direct ``rewrite_model``.
+"""
+
+from .base import (
+    RewritePass,
+    apply_passes,
+    inference_passes,
+    resolve_passes,
+    rewrite_model,
+    rewrite_model_inplace,
+    training_passes,
+)
+from .passes import (
+    BatchNormAffinePass,
+    ConvBatchNormFoldPass,
+    SpaceToDepthStemPass,
+)
+
+__all__ = [
+    "BatchNormAffinePass",
+    "ConvBatchNormFoldPass",
+    "RewritePass",
+    "SpaceToDepthStemPass",
+    "apply_passes",
+    "inference_passes",
+    "resolve_passes",
+    "rewrite_model",
+    "rewrite_model_inplace",
+    "training_passes",
+]

@@ -127,6 +127,34 @@ class MultiLayerNetwork:
         if not self._initialized:
             self.init()
 
+    def migrate_state(self) -> None:
+        """Fill persistent-state keys introduced by newer framework versions
+        with their ``init_state`` defaults, keeping every existing value
+        (BN running stats survive untouched). E.g. PR 3 added
+        ``expert_tokens``/``dropped_tokens`` to MixtureOfExpertsLayer state;
+        pre-PR-3 state pytrees restored onto this version would otherwise
+        break the jitted scan's carry structure. Called automatically at
+        Solver construction and ``make_servable`` — a manual
+        ``init_state`` re-run is never required."""
+        if not self._initialized:
+            return
+        changed = False
+        for i, layer in enumerate(self.layers):
+            defaults = layer.init_state(self.dtype)
+            if not defaults:
+                continue
+            name = self.conf.layer_name(i)
+            cur = dict(self.state.get(name, {}))
+            missing = [k for k in defaults if k not in cur]
+            if missing:
+                for k in missing:
+                    cur[k] = defaults[k]
+                self.state[name] = cur
+                self._persistent_keys[name] = tuple(cur.keys())
+                changed = True
+        if changed:
+            self._output_fn_cache.clear()
+
     # -------------------------------------------------------------- forward
     def forward_pure(
         self,

@@ -171,6 +171,12 @@ class ParallelInference:
         the returned servable's ``fwd`` on :meth:`bucket_sizes` shapes
         before :meth:`swap`, so compilation never happens on the serving
         path."""
+        # state pytrees from older framework versions may lack keys newer
+        # layers persist (e.g. PR 3's MoE counters) — fill the defaults so
+        # the jitted forward sees a complete structure
+        migrate = getattr(model, "migrate_state", None)
+        if callable(migrate):
+            migrate()
         params, state = model.params, model.state
 
         def fwd(x):

@@ -97,11 +97,19 @@ class ModelManager:
         clock: Callable[[], float] = time.monotonic,
         fault_injector=None,
         registry: Optional[MetricsRegistry] = None,
+        optimize: Union[str, list, None] = "inference",
     ) -> None:
         self.store = store
         self.model_name = model_name
         self._clock = clock
         self._fault_injector = fault_injector
+        # graph rewrite pipeline applied to every store-loaded model
+        # BEFORE warmup (nn/rewrite): the default "inference" set folds
+        # conv+BN, rewrites the conv stem and fuses remaining BNs, so the
+        # swapped-in version serves — and probation measures — the
+        # rewritten graph. In-memory only: store artifacts stay
+        # un-rewritten. None disables.
+        self._optimize = optimize
         self.probation_seconds = float(probation_seconds)
         self._breaker_factory = breaker_factory or (
             lambda: CircuitBreaker(clock=clock))
@@ -161,8 +169,22 @@ class ModelManager:
         return self._fault_injector or get_fault_injector()
 
     def _load(self, version: Union[int, str]):
+        """Load + checksum-verify from the store, then apply the inference
+        rewrite pipeline to the in-memory copy (the artifact on disk stays
+        un-rewritten). Warmup — and therefore probation — always measures
+        the graph that will actually serve."""
         self._inj().fire(LOAD_SITE)
-        return self.store.load(self.model_name, version)
+        model, entry = self.store.load(self.model_name, version)
+        if self._optimize:
+            from ..nn.rewrite import rewrite_model
+
+            model, applied = rewrite_model(model, self._optimize,
+                                           context="inference")
+            if applied:
+                self.registry.log_event(
+                    "model_rewrite", model=self.model_name,
+                    version=str(entry.version), passes=applied)
+        return model, entry
 
     def _set_live_gauge(self) -> None:
         try:

@@ -19,8 +19,18 @@ from .solver import LayerOptimizers, _normalize_gradients
 
 
 class GraphSolver:
-    def __init__(self, model) -> None:
+    def __init__(self, model, *, optimize=None) -> None:
+        """``optimize=`` applies training-safe graph rewrite passes at
+        step-build time (see Solver.__init__ / nn/rewrite)."""
         self.model = model
+        if hasattr(model, "migrate_state"):
+            model.migrate_state()
+        self.applied_rewrites = []
+        if optimize:
+            from ..nn.rewrite import rewrite_model_inplace
+
+            self.applied_rewrites = rewrite_model_inplace(
+                model, optimize, context="training")
         self.optim = LayerOptimizers(model)
         self.opt_state = self.optim.init(model.params)
         self._step_cache: Dict[Any, Any] = {}

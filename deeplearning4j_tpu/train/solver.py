@@ -111,8 +111,22 @@ class LayerOptimizers:
 
 
 class Solver:
-    def __init__(self, model) -> None:
+    def __init__(self, model, *, optimize=None) -> None:
+        """``optimize=`` applies training-safe graph rewrite passes at
+        step-build time (``True``/``"training"`` -> the default set:
+        space-to-depth stem + BN affine precompute; or an explicit pass
+        list — inference-only passes are rejected). The model is rewritten
+        in place to a numerically equivalent form; rewrites are in-memory
+        only and never serialized (nn/rewrite)."""
         self.model = model
+        if hasattr(model, "migrate_state"):
+            model.migrate_state()
+        self.applied_rewrites = []
+        if optimize:
+            from ..nn.rewrite import rewrite_model_inplace
+
+            self.applied_rewrites = rewrite_model_inplace(
+                model, optimize, context="training")
         self.optim = LayerOptimizers(model)
         self.opt_state = self.optim.init(model.params)
         self._step_cache: Dict[Any, Any] = {}

@@ -245,3 +245,61 @@ def test_balance_loss_weight_improves_balance():
     # frac=mass=1/E); the trained-with-loss router must be much closer
     assert aux_on < aux_off - 1.0, (aux_on, aux_off)
     assert aux_on < 1.5, aux_on
+
+
+def test_pre_pr3_state_pytree_migrates_silently():
+    """State pytrees from before PR 3 lack the expert_tokens /
+    dropped_tokens keys; Solver construction and make_servable must fill
+    the defaults via migrate_state (CHANGES.md PR 3 caveat) instead of
+    requiring a manual init_state — and existing state values survive."""
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.2))
+            .weight_init(WeightInit.XAVIER).list()
+            .layer(MixtureOfExpertsLayer(n_out=8, num_experts=2, hidden=16,
+                                         top_k=1))
+            .layer(OutputLayer(n_out=2, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    name = net.conf.layer_name(0)
+    marker = jnp.asarray(0.625, net.state[name]["aux_load_balance"].dtype)
+    # simulate a restored pre-PR-3 pytree: only aux_load_balance present
+    net.state[name] = {"aux_load_balance": marker}
+    net._persistent_keys[name] = ("aux_load_balance",)
+
+    rs = np.random.RandomState(3)
+    x = rs.rand(8, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 8)]
+    # fit() takes the compiled-scan path whose lax.scan carry requires a
+    # stable state structure — without migration this raised a carry
+    # structure mismatch
+    net.fit(x, y, epochs=2)
+    st = net.state[name]
+    assert set(st) >= {"aux_load_balance", "expert_tokens", "dropped_tokens"}
+    assert st["expert_tokens"].shape == (2,)
+    out = np.asarray(net.output(x))
+    assert np.all(np.isfinite(out))
+
+
+def test_pre_pr3_state_migrates_in_make_servable():
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+    conf = (NeuralNetConfiguration.builder().seed(8).updater(Sgd(0.2))
+            .weight_init(WeightInit.XAVIER).list()
+            .layer(MixtureOfExpertsLayer(n_out=8, num_experts=2, hidden=16,
+                                         top_k=1))
+            .layer(OutputLayer(n_out=2, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    name = net.conf.layer_name(0)
+    net.state[name] = {"aux_load_balance":
+                       net.state[name]["aux_load_balance"]}
+    net._persistent_keys[name] = ("aux_load_balance",)
+    pi = ParallelInference(net, workers=1, batch_limit=4)
+    try:
+        x = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+        out = pi.output_async(x).result(timeout=30)
+        assert np.all(np.isfinite(np.asarray(out)))
+        assert "expert_tokens" in net.state[name]
+    finally:
+        pi.shutdown(drain=False)

@@ -1,0 +1,310 @@
+"""Unit coverage for the graph-rewrite pass framework (nn/rewrite):
+float64 gradchecks per pass, stem-rewrite shape/parity on the zoo
+ResNet block, fold-then-serialize round trips, solver/manager knobs.
+The cross-cutting equivalence contract (forward/backward parity, no-op
+byte-identity, deploy-serves-folded) lives in
+tools/check_rewrite_equivalence.py -> test_rewrite_contract.py."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (
+    Activation,
+    InputType,
+    LossFunction,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer,
+    BatchNormalizationLayer,
+    ConvolutionLayer,
+    ConvolutionMode,
+    DenseLayer,
+    OutputLayer,
+    SpaceToDepthLayer,
+)
+from deeplearning4j_tpu.nn.rewrite import (
+    BatchNormAffinePass,
+    ConvBatchNormFoldPass,
+    SpaceToDepthStemPass,
+    resolve_passes,
+    rewrite_model,
+)
+from deeplearning4j_tpu.nn.sequential import MultiLayerNetwork
+from deeplearning4j_tpu.train.solver import Solver
+from deeplearning4j_tpu.utils.gradcheck import check_gradients
+
+
+def _stem_net(dtype="float64", n_out=2, classes=3, hw=8, extra_bn=False,
+              seed=12):
+    b = NeuralNetConfiguration.builder().seed(seed).data_type(dtype).list()
+    b.layer(ConvolutionLayer(
+        name="stem_conv", n_out=n_out, kernel_size=(7, 7), stride=(2, 2),
+        convolution_mode=ConvolutionMode.SAME,
+        activation=Activation.IDENTITY, has_bias=True))
+    if extra_bn:
+        b.layer(BatchNormalizationLayer(name="stem_bn"))
+        b.layer(ActivationLayer(name="stem_relu",
+                                activation=Activation.RELU))
+    else:
+        b.layer(ActivationLayer(name="stem_act",
+                                activation=Activation.TANH))
+    b.layer(OutputLayer(name="out", n_out=classes, loss=LossFunction.MCXENT,
+                        activation=Activation.SOFTMAX))
+    b.set_input_type(InputType.convolutional(hw, hw, 3))
+    return MultiLayerNetwork(b.build()).init()
+
+
+def _batch(model, hw=8, n=3, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 3, hw, hw).astype(np.float64)
+    y = np.eye(classes)[rng.randint(0, classes, n)].astype(np.float64)
+    return x, y
+
+
+# ---------------------------------------------------------------------------
+# float64 gradchecks per pass
+# ---------------------------------------------------------------------------
+
+def test_gradcheck_stem_rewrite():
+    model = _stem_net()
+    x, y = _batch(model)
+    m2, applied = rewrite_model(model, [SpaceToDepthStemPass()],
+                                context="training")
+    assert applied == ["space_to_depth_stem"]
+    np.testing.assert_allclose(np.asarray(m2.output(x)),
+                               np.asarray(model.output(x)), atol=1e-12)
+    assert check_gradients(m2, x, y, subset=60)
+
+
+def test_gradcheck_conv_bn_fold():
+    model = _stem_net(extra_bn=True)
+    x, y = _batch(model)
+    model.fit(x, y, epochs=2)  # move BN stats off the init values
+    m2, applied = rewrite_model(model, [ConvBatchNormFoldPass()],
+                                context="inference")
+    assert applied == ["conv_bn_fold"]
+    np.testing.assert_allclose(np.asarray(m2.output(x)),
+                               np.asarray(model.output(x)), atol=1e-10)
+    # the folded graph is a plain trainable net in its own right
+    assert check_gradients(m2, x, y, subset=60)
+
+
+def test_gradcheck_bn_affine():
+    b = (NeuralNetConfiguration.builder().seed(5).data_type("float64").list()
+         .layer(DenseLayer(name="d", n_out=6, activation=Activation.TANH))
+         .layer(BatchNormalizationLayer(name="bn"))
+         .layer(OutputLayer(name="out", n_out=3, loss=LossFunction.MCXENT,
+                            activation=Activation.SOFTMAX))
+         .set_input_type(InputType.feed_forward(4)))
+    model = MultiLayerNetwork(b.build()).init()
+    rng = np.random.RandomState(1)
+    x = rng.rand(4, 4)
+    y = np.eye(3)[rng.randint(0, 3, 4)].astype(np.float64)
+    model.fit(x, y, epochs=2)
+    m2, applied = rewrite_model(model, [BatchNormAffinePass()],
+                                context="training")
+    assert applied == ["bn_affine_precompute"]
+    assert m2.conf.layers[1].fused
+    # same params/state objects: config-only rewrite
+    assert m2.params["bn"] is model.params["bn"]
+    np.testing.assert_allclose(np.asarray(m2.output(x)),
+                               np.asarray(model.output(x)), atol=1e-12)
+    assert check_gradients(m2, x, y, subset=60)
+
+
+# ---------------------------------------------------------------------------
+# stem rewrite: shapes and exact kernel transform
+# ---------------------------------------------------------------------------
+
+def test_stem_rewrite_shapes_and_kernel_layout():
+    model = _stem_net(dtype="float32", n_out=4, hw=16)
+    m2, _ = rewrite_model(model, [SpaceToDepthStemPass()],
+                          context="training")
+    s2d, conv = m2.conf.layers[0], m2.conf.layers[1]
+    assert isinstance(s2d, SpaceToDepthLayer) and s2d.block_size == 2
+    assert conv.n_in == 12 and conv.kernel_size == (4, 4)
+    assert conv.stride == (1, 1)
+    assert conv.convolution_mode is ConvolutionMode.SAME
+    w2 = np.asarray(m2.params[m2.conf.layer_name(1)]["W"])
+    assert w2.shape == (4, 12, 4, 4)
+    # exact pad+reshape: every original weight appears once, untouched
+    w = np.asarray(model.params[model.conf.layer_name(0)]["W"])
+    for o in range(4):
+        for c in range(3):
+            for dh in range(7):
+                for dw in range(7):
+                    m_, u = dh // 2, dh % 2
+                    n_, v = dw // 2, dw % 2
+                    assert w2[o, (u * 2 + v) * 3 + c, m_, n_] == w[o, c, dh, dw]
+    # zero-padded taps (dh==7 or dw==7) are exactly zero
+    assert np.count_nonzero(w2) <= np.count_nonzero(w)
+    # spatial output identical
+    out = np.asarray(m2.output(np.random.RandomState(0)
+                               .rand(2, 3, 16, 16).astype(np.float32)))
+    assert out.shape == (2, 3)
+
+
+def test_stem_rewrite_skips_odd_input():
+    b = (NeuralNetConfiguration.builder().seed(3).list()
+         .layer(ConvolutionLayer(n_out=4, kernel_size=(7, 7), stride=(2, 2),
+                                 convolution_mode=ConvolutionMode.SAME,
+                                 activation=Activation.IDENTITY))
+         .layer(OutputLayer(n_out=2, loss=LossFunction.MCXENT,
+                            activation=Activation.SOFTMAX))
+         .set_input_type(InputType.convolutional(15, 15, 3)))
+    model = MultiLayerNetwork(b.build()).init()
+    m2, applied = rewrite_model(model, [SpaceToDepthStemPass()],
+                                context="training")
+    assert m2 is model and applied == []
+
+
+# ---------------------------------------------------------------------------
+# zoo ResNet block parity (the real zoo builders, both rewrite sets)
+# ---------------------------------------------------------------------------
+
+def _zoo_resnet_block():
+    from deeplearning4j_tpu.model.zoo.resnet50 import ResNet50
+    from deeplearning4j_tpu.nn import WeightInit
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.layers import (
+        GlobalPoolingLayer, PoolingType, SubsamplingLayer,
+    )
+
+    rn = ResNet50(num_classes=4, height=32, width=32)
+    g = (NeuralNetConfiguration.builder().seed(9).updater(rn.updater)
+         .weight_init(WeightInit.RELU).graph_builder().add_inputs("input"))
+    x = rn._conv_bn(g, "stem", 16, (7, 7), (2, 2), "input")
+    g.add_layer("stem_pool", SubsamplingLayer(
+        kernel_size=(3, 3), stride=(2, 2),
+        convolution_mode=ConvolutionMode.SAME,
+        pooling_type=PoolingType.MAX), x)
+    x = rn._bottleneck(g, "s0b0", "stem_pool", (8, 8, 32), project=True)
+    g.add_layer("avgpool", GlobalPoolingLayer(pooling_type=PoolingType.AVG), x)
+    g.add_layer("fc", OutputLayer(n_out=4, loss=LossFunction.MCXENT,
+                                  activation=Activation.SOFTMAX), "avgpool")
+    g.set_outputs("fc")
+    g.set_input_types(InputType.convolutional(32, 32, 3))
+    return ComputationGraph(g.build()).init()
+
+
+def test_zoo_resnet_block_stem_parity():
+    from deeplearning4j_tpu.train.graph_solver import GraphSolver
+
+    model = _zoo_resnet_block()
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 32, 32).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 2)]
+    solver = GraphSolver(model)
+    for _ in range(2):
+        solver.fit_batch((x,), (y,))
+    base = np.asarray(model.output(x))
+
+    m2, applied = rewrite_model(model, [SpaceToDepthStemPass()],
+                                context="training")
+    assert applied == ["space_to_depth_stem"]
+    # the s2d vertex feeds the rewritten stem conv
+    names = [v.name for v in m2.conf.vertices]
+    assert "stem_conv_s2d" in names
+    spec = m2.conf.spec("stem_conv")
+    assert spec.inputs == ("stem_conv_s2d",)
+    assert spec.layer.n_in == 12
+    np.testing.assert_allclose(np.asarray(m2.output(x)), base, atol=2e-5)
+
+    # full inference set: no BN vertices remain, outputs still match
+    m3, applied3 = rewrite_model(model, "inference")
+    assert "conv_bn_fold" in applied3
+    assert not any(isinstance(v.layer, BatchNormalizationLayer)
+                   for v in m3.conf.vertices)
+    np.testing.assert_allclose(np.asarray(m3.output(x)), base, atol=2e-5)
+    # training through the stem-rewritten graph still works
+    s2 = GraphSolver(m2)
+    s2.fit_batch((x,), (y,))
+
+
+# ---------------------------------------------------------------------------
+# fold-then-serialize round trip: artifacts store the UN-rewritten model
+# ---------------------------------------------------------------------------
+
+def test_fold_then_serialize_round_trip(tmp_path):
+    from deeplearning4j_tpu.core.config import to_json
+    from deeplearning4j_tpu.model.serializer import restore_model, write_model
+
+    model = _stem_net(dtype="float32", extra_bn=True, hw=16)
+    x, _ = _batch(model, hw=16)
+    x = x.astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.random.RandomState(2).randint(0, 3, 3)]
+    model.fit(x, y, epochs=2)
+    expected = np.asarray(model.output(x))
+
+    # serialize the ORIGINAL, restore, rewrite the restored copy
+    path = os.path.join(tmp_path, "m.zip")
+    write_model(model, path)
+    restored = restore_model(path)
+    assert to_json(restored.conf) == to_json(model.conf)
+    folded, applied = rewrite_model(restored, "inference")
+    assert "conv_bn_fold" in applied
+    np.testing.assert_allclose(np.asarray(folded.output(x)), expected,
+                               atol=2e-5)
+    # re-serializing the restored (un-rewritten) model keeps the artifact
+    # checkpoint-compatible: same config, same param count
+    path2 = os.path.join(tmp_path, "m2.zip")
+    write_model(restored, path2)
+    again = restore_model(path2)
+    assert to_json(again.conf) == to_json(model.conf)
+    assert again.num_params() == model.num_params()
+    np.testing.assert_allclose(np.asarray(again.output(x)), expected,
+                               atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# solver knob
+# ---------------------------------------------------------------------------
+
+def test_solver_optimize_knob_rewrites_in_place():
+    model = _stem_net(dtype="float32", extra_bn=True, hw=16)
+    x, _ = _batch(model, hw=16)
+    x = x.astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.random.RandomState(4).randint(0, 3, 3)]
+    before = np.asarray(model.output(x))
+    solver = Solver(model, optimize="training")
+    assert set(solver.applied_rewrites) == {"space_to_depth_stem",
+                                            "bn_affine_precompute"}
+    assert isinstance(model.layers[0], SpaceToDepthLayer)
+    assert any(getattr(l, "fused", False) for l in model.layers)
+    np.testing.assert_allclose(np.asarray(model.output(x)), before,
+                               atol=2e-5)
+    for _ in range(3):
+        solver.fit_batch(x, y)
+    assert np.isfinite(float(solver.fit_batch(x, y)[0]))
+
+
+def test_solver_rejects_inference_only_pass():
+    model = _stem_net(dtype="float32", extra_bn=True, hw=16)
+    with pytest.raises(ValueError, match="inference-only"):
+        Solver(model, optimize=[ConvBatchNormFoldPass()])
+    with pytest.raises(ValueError):
+        resolve_passes("inference", context="training")
+
+
+def test_manager_optimize_none_serves_original(tmp_path):
+    from deeplearning4j_tpu.obs import MetricsRegistry
+    from deeplearning4j_tpu.serving import ModelManager, ModelStore
+
+    model = _stem_net(dtype="float32", extra_bn=True, hw=16)
+    x = np.random.RandomState(0).rand(2, 3, 16, 16).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.random.RandomState(1).randint(0, 3, 2)]
+    model.fit(x, y, epochs=1)
+    store = ModelStore(str(tmp_path))
+    store.publish("m", model)
+    mgr = ModelManager(store, "m", registry=MetricsRegistry(),
+                       warmup_example=x, workers=1, optimize=None)
+    try:
+        assert any(isinstance(l, BatchNormalizationLayer)
+                   for l in mgr.engine.model.conf.layers)
+        np.testing.assert_allclose(np.asarray(mgr.output(x)),
+                                   np.asarray(model.output(x)), atol=1e-6)
+    finally:
+        mgr.shutdown(drain=False)

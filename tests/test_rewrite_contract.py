@@ -1,0 +1,19 @@
+"""Tier-1 wiring for tools/check_rewrite_equivalence.py: every rewrite
+pass must stay numerically equivalent on matching graphs (forward AND
+backward), a provable no-op on BERT/LSTM/MoE graphs, and the serving path
+must fold before warm while the store artifact stays un-rewritten —
+enforced on every test run, not just when someone runs the tool."""
+
+import os
+import sys
+
+_TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+
+
+def test_rewrite_equivalence_contract():
+    sys.path.insert(0, _TOOLS)
+    try:
+        import check_rewrite_equivalence
+    finally:
+        sys.path.remove(_TOOLS)
+    assert check_rewrite_equivalence.main(log=lambda m: None) == 0

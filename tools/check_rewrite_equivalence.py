@@ -1,0 +1,290 @@
+#!/usr/bin/env python
+"""Graph-rewrite equivalence contract check (README "Graph optimization
+passes").
+
+Asserts, on CPU, the contract every rewrite pass must keep:
+
+    match     → forward parity to float tolerance on ResNet-50-style
+                graphs (ComputationGraph AND MultiLayerNetwork spellings),
+                in both inference and training mode
+    backward  → input gradients and shared-parameter gradients of
+                training-safe passes match the unrewritten graph
+    no match  → byte-identical config (to_json), the SAME params/state
+                objects, changed=False — BERT-style (attention+LayerNorm),
+                LSTM and MoE graphs pass through every pass untouched
+    serving   → ModelManager.deploy serves the rewritten (BN-folded)
+                graph by default while the store artifact stays
+                un-rewritten
+
+Runs standalone (``python tools/check_rewrite_equivalence.py``) and as a
+tier-1 pytest via tests/test_rewrite_contract.py.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+TOL = 2e-5
+
+
+def _build_sequential_stem(seed=7):
+    from deeplearning4j_tpu.nn import (
+        Activation, InputType, LossFunction, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.layers import (
+        ActivationLayer, BatchNormalizationLayer, ConvolutionLayer,
+        ConvolutionMode, OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.sequential import MultiLayerNetwork
+
+    conf = (
+        NeuralNetConfiguration.builder().seed(seed)
+        .list()
+        .layer(ConvolutionLayer(
+            name="stem_conv", n_out=8, kernel_size=(7, 7), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME,
+            activation=Activation.IDENTITY, has_bias=False))
+        .layer(BatchNormalizationLayer(name="stem_bn"))
+        .layer(ActivationLayer(name="stem_relu", activation=Activation.RELU))
+        .layer(OutputLayer(name="out", n_out=5, loss=LossFunction.MCXENT,
+                           activation=Activation.SOFTMAX))
+        .set_input_type(InputType.convolutional(16, 16, 3))
+        .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _build_graph_resnet_block(seed=11):
+    """ResNet-50-style mini graph using the zoo's own block builders:
+    7×7/2 stem conv+BN+relu → maxpool → one projected bottleneck →
+    global-avg-pool → softmax."""
+    from deeplearning4j_tpu.model.zoo.resnet50 import ResNet50
+    from deeplearning4j_tpu.nn import (
+        Activation, InputType, LossFunction, NeuralNetConfiguration,
+        WeightInit,
+    )
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.layers import (
+        ConvolutionMode, GlobalPoolingLayer, OutputLayer, PoolingType,
+        SubsamplingLayer,
+    )
+
+    rn = ResNet50(num_classes=5, height=32, width=32)
+    g = (NeuralNetConfiguration.builder().seed(seed).updater(rn.updater)
+         .weight_init(WeightInit.RELU).graph_builder().add_inputs("input"))
+    x = rn._conv_bn(g, "stem", 16, (7, 7), (2, 2), "input")
+    g.add_layer("stem_pool", SubsamplingLayer(
+        kernel_size=(3, 3), stride=(2, 2),
+        convolution_mode=ConvolutionMode.SAME,
+        pooling_type=PoolingType.MAX), x)
+    x = rn._bottleneck(g, "s0b0", "stem_pool", (8, 8, 32), project=True)
+    g.add_layer("avgpool", GlobalPoolingLayer(pooling_type=PoolingType.AVG), x)
+    g.add_layer("fc", OutputLayer(n_out=5, loss=LossFunction.MCXENT,
+                                  activation=Activation.SOFTMAX), "avgpool")
+    g.set_outputs("fc")
+    g.set_input_types(InputType.convolutional(32, 32, 3))
+    return ComputationGraph(g.build()).init()
+
+
+def _build_unmatched_nets():
+    """Graphs without any rewrite pattern: BERT-style attention+LayerNorm,
+    LSTM, and MoE."""
+    from deeplearning4j_tpu.nn import (
+        Activation, InputType, LossFunction, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.layers import (
+        DenseLayer, LSTMLayer, MixtureOfExpertsLayer, OutputLayer,
+        RnnOutputLayer, SelfAttentionLayer,
+    )
+    from deeplearning4j_tpu.nn.layers.norm import LayerNormLayer
+    from deeplearning4j_tpu.nn.sequential import MultiLayerNetwork
+
+    bert_ish = (NeuralNetConfiguration.builder().seed(3).list()
+                .layer(SelfAttentionLayer(n_out=8, n_heads=2, project_input=True))
+                .layer(LayerNormLayer())
+                .layer(RnnOutputLayer(n_out=4, loss=LossFunction.MCXENT,
+                                      activation=Activation.SOFTMAX))
+                .set_input_type(InputType.recurrent(8, 6))
+                .build())
+    lstm = (NeuralNetConfiguration.builder().seed(4).list()
+            .layer(LSTMLayer(n_out=8))
+            .layer(RnnOutputLayer(n_out=4, loss=LossFunction.MCXENT,
+                                  activation=Activation.SOFTMAX))
+            .set_input_type(InputType.recurrent(5, 6))
+            .build())
+    moe = (NeuralNetConfiguration.builder().seed(5).list()
+           .layer(DenseLayer(n_out=8, activation=Activation.RELU))
+           .layer(MixtureOfExpertsLayer(n_out=8, num_experts=2, hidden=16))
+           .layer(OutputLayer(n_out=4, loss=LossFunction.MCXENT,
+                              activation=Activation.SOFTMAX))
+           .set_input_type(InputType.feed_forward(6))
+           .build())
+    return {
+        "bert_ish": MultiLayerNetwork(bert_ish).init(),
+        "lstm": MultiLayerNetwork(lstm).init(),
+        "moe": MultiLayerNetwork(moe).init(),
+    }
+
+
+def _input_grad(model, x, y):
+    """d loss / d input — a parametrization-independent backward probe."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    if isinstance(model, ComputationGraph):
+        def f(xx):
+            s, _ = model.loss_pure(model.params, model.state, (xx,), (y,),
+                                   rng=None, train=True)
+            return s
+    else:
+        def f(xx):
+            s, _ = model.loss_pure(model.params, model.state, xx, y,
+                                   rng=None, train=True)
+            return s
+    return jax.grad(f)(jnp.asarray(x, model.dtype))
+
+
+def _shared_param_grads(model, x, y):
+    """{layer: {param: grad}} for comparison across rewrites (shared
+    layers keep their names; the transformed stem kernel is excluded by
+    shape mismatch in the comparison)."""
+    return model.calculate_gradients(x, y)
+
+
+def main(log=print) -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from deeplearning4j_tpu.nn.rewrite import (
+        BatchNormAffinePass,
+        ConvBatchNormFoldPass,
+        SpaceToDepthStemPass,
+        inference_passes,
+        resolve_passes,
+        rewrite_model,
+        training_passes,
+    )
+    from deeplearning4j_tpu.core.config import to_json
+
+    rng = np.random.RandomState(0)
+    every_pass = [SpaceToDepthStemPass(), ConvBatchNormFoldPass(),
+                  BatchNormAffinePass()]
+
+    # ---- matched graphs: forward + backward parity -----------------------
+    for label, model, x, y in (
+        ("sequential-stem", _build_sequential_stem(),
+         rng.rand(4, 3, 16, 16).astype(np.float32),
+         np.eye(5, dtype=np.float32)[rng.randint(0, 5, 4)]),
+        ("graph-resnet-block", _build_graph_resnet_block(),
+         rng.rand(2, 3, 32, 32).astype(np.float32),
+         np.eye(5, dtype=np.float32)[rng.randint(0, 5, 2)]),
+    ):
+        # a few train steps so BN running stats are non-trivial
+        model.fit(x, y, epochs=3)
+        base_out = np.asarray(model.output(x))
+        base_igrad = np.asarray(_input_grad(model, x, y))
+        base_pgrads = _shared_param_grads(model, x, y)
+
+        for p in every_pass + [inference_passes(), training_passes()]:
+            plist = p if isinstance(p, list) else [p]
+            pname = "+".join(q.name for q in plist)
+            m2, applied = rewrite_model(model, plist, context="inference")
+            assert applied, f"{label}: {pname} should have matched"
+            out2 = np.asarray(m2.output(x))
+            diff = float(np.abs(out2 - base_out).max())
+            assert diff < TOL, f"{label}/{pname}: forward diff {diff}"
+            if all(q.training_safe for q in plist):
+                ig = np.asarray(_input_grad(m2, x, y))
+                gdiff = float(np.abs(ig - base_igrad).max())
+                assert gdiff < TOL, f"{label}/{pname}: input-grad diff {gdiff}"
+                g2 = _shared_param_grads(m2, x, y)
+                n_shared = 0
+                for lname, lg in base_pgrads.items():
+                    for k, g in lg.items():
+                        other = g2.get(lname, {}).get(k)
+                        if other is not None and other.shape == g.shape:
+                            d = float(np.abs(np.asarray(other)
+                                             - np.asarray(g)).max())
+                            assert d < TOL, \
+                                f"{label}/{pname}: grad[{lname}][{k}] {d}"
+                            n_shared += 1
+                assert n_shared > 0, f"{label}/{pname}: no shared params"
+            log(f"ok: {label} / {pname} (forward diff {diff:.2e})")
+
+    # ---- unmatched graphs: provable no-ops -------------------------------
+    x_by_kind = {
+        "bert_ish": rng.rand(2, 8, 6).astype(np.float32),
+        "lstm": rng.rand(2, 5, 6).astype(np.float32),
+        "moe": rng.rand(2, 6).astype(np.float32),
+    }
+    for kind, model in _build_unmatched_nets().items():
+        before_json = to_json(model.conf)
+        for p in every_pass:
+            conf2, params2, state2, changed = p.apply(
+                model.conf, model.params, model.state)
+            assert not changed, f"{kind}: {p.name} claimed a match"
+            assert conf2 is model.conf, f"{kind}: {p.name} rebuilt config"
+            assert params2 is model.params and state2 is model.state, \
+                f"{kind}: {p.name} rebuilt params/state"
+            assert to_json(conf2) == before_json
+        m2, applied = rewrite_model(model, "inference")
+        assert m2 is model and not applied
+        out = model.output(x_by_kind[kind])  # still functional
+        assert np.all(np.isfinite(np.asarray(out)))
+        log(f"ok: {kind} untouched by every pass")
+
+    # ---- training-context gating -----------------------------------------
+    try:
+        resolve_passes([ConvBatchNormFoldPass()], context="training")
+    except ValueError:
+        log("ok: conv_bn_fold rejected at training time")
+    else:
+        raise AssertionError("inference-only pass accepted for training")
+
+    # ---- serving: deploy serves the folded graph, store stays clean ------
+    from deeplearning4j_tpu.serving import ModelManager, ModelStore
+    from deeplearning4j_tpu.obs import MetricsRegistry
+
+    model = _build_sequential_stem(seed=21)
+    x = rng.rand(4, 3, 16, 16).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[rng.randint(0, 5, 4)]
+    model.fit(x, y, epochs=2)
+    expected = np.asarray(model.output(x))
+    n_layers_orig = len(model.conf.layers)
+    with tempfile.TemporaryDirectory() as root:
+        store = ModelStore(root)
+        store.publish("m", model)
+        reg = MetricsRegistry()
+        mgr = ModelManager(store, "m", registry=reg, warmup_example=x,
+                           workers=1)
+        try:
+            served = np.asarray(mgr.output(x))
+            assert np.abs(served - expected).max() < TOL
+            live = mgr.engine.model
+            has_bn = any(type(l).__name__ == "BatchNormalizationLayer"
+                         for l in live.conf.layers)
+            assert not has_bn, "served graph still contains BatchNorm"
+            events = reg.events("model_rewrite")
+            assert events and "conv_bn_fold" in events[0]["passes"]
+        finally:
+            mgr.shutdown(drain=False)
+        # the artifact in the store is the UN-rewritten model
+        reloaded, _ = store.load("m")
+        assert to_json(reloaded.conf) == to_json(model.conf)
+        assert len(reloaded.conf.layers) == n_layers_orig
+    log("ok: deploy serves folded graph; store artifact un-rewritten")
+
+    log("rewrite equivalence contract: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
