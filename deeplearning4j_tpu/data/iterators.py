@@ -146,6 +146,18 @@ class AsyncDataSetIterator(DataSetIterator):
             "dl4j_tpu_data_consumer_starvation_seconds_total",
             "Time the consumer waited on an empty queue "
             "(input-bound — the I/O bottleneck signal)", ("instance",)).labels(inst)
+        # prefetch-starvation open item (ROADMAP): depth means nothing
+        # without capacity, and the StepProfiler's data-wait story needs
+        # the per-dequeue wait distribution, not just its total
+        self._g_capacity = reg.gauge(
+            "dl4j_tpu_data_prefetch_queue_capacity",
+            "Prefetch queue capacity (bounded queue size)",
+            ("instance",)).labels(inst)
+        self._g_capacity.set(queue_size)
+        self._h_wait = reg.histogram(
+            "dl4j_tpu_data_fetch_wait_seconds",
+            "Consumer-visible wait per dequeue (0 when a batch was "
+            "already prefetched)", ("instance",)).labels(inst)
 
     def _put(self, item, stop: threading.Event) -> bool:
         """Bounded put that gives up when ``stop`` is set (an abandoned
@@ -199,10 +211,13 @@ class AsyncDataSetIterator(DataSetIterator):
         q = self._queue
         try:
             item = q.get_nowait()
+            self._h_wait.observe(0.0)
         except queue.Empty:
             t0 = time.perf_counter()
             item = q.get()
-            self._c_starved.inc(time.perf_counter() - t0)
+            waited = time.perf_counter() - t0
+            self._c_starved.inc(waited)
+            self._h_wait.observe(waited)
         self._g_depth.set(q.qsize())
         if item is self._SENTINEL:
             if self._error is not None:
@@ -255,12 +270,17 @@ class AsyncDataSetIterator(DataSetIterator):
     def stats(self) -> dict:
         """Per-instance view over the registry children (one source of
         truth; see README "Observability")."""
+        waits = self._h_wait.count
         return {
             "queue_depth": self._queue.qsize(),
+            "queue_capacity": self.queue_size,
             "queue_high_water": int(self._g_hwm.value),
             "batches": int(self._c_batches.value),
             "producer_blocked_s": float(self._c_blocked.value),
             "consumer_starvation_s": float(self._c_starved.value),
+            "fetches": int(waits),
+            "mean_fetch_wait_s": (float(self._h_wait.sum) / waits
+                                  if waits else 0.0),
         }
 
     def batch_size(self) -> int:

@@ -1,5 +1,6 @@
 """Process-wide observability: metrics registry, Prometheus exposition,
-trace spans, and the training-listener bridge.
+distributed tracing, step-time attribution, and the training-listener
+bridge.
 
 One registry (default process-global, injectable everywhere) is the single
 source of truth for serving (``ParallelInference``, ``JsonModelServer``),
@@ -8,6 +9,14 @@ resilience (circuit/admission/retry/elastic_fit), training
 ``GET /metrics`` on ``JsonModelServer`` and ``UIServer`` exposes it in
 Prometheus text format 0.0.4. See README "Observability" for the metric
 naming convention and the ``stats()`` ↔ metrics mapping.
+
+Tracing (``obs/tracing.py``): :class:`Tracer`/:class:`TraceSpan` give
+requests identity (W3C ``traceparent``) and parent/child structure across
+the client→server→engine hop, exported to a bounded :class:`TraceStore`
+served by ``GET /v1/traces``. :class:`StepProfiler`
+(``obs/step_profiler.py``) attributes training step time to
+data_wait/h2d/compute/host phases with sampled device fencing. README
+"Tracing & step-time attribution".
 """
 
 from .listener import MetricsListener, MoEMetricsListener, record_moe_metrics
@@ -25,10 +34,27 @@ from .metrics import (
 )
 from .prom import CONTENT_TYPE as PROM_CONTENT_TYPE
 from .prom import render_prometheus
+from .step_profiler import PHASES as STEP_PHASES
+from .step_profiler import StepProfiler
+from .tracing import (
+    DEFAULT_SAMPLE_RATE as DEFAULT_TRACE_SAMPLE_RATE,
+    TraceContext,
+    TraceSpan,
+    TraceStore,
+    Tracer,
+    current_context,
+    current_span,
+    decode_traceparent,
+    encode_traceparent,
+    get_tracer,
+    set_tracer,
+    trace_now,
+)
 
 __all__ = [
     "Counter",
     "DEFAULT_BUCKETS",
+    "DEFAULT_TRACE_SAMPLE_RATE",
     "Gauge",
     "Histogram",
     "MetricError",
@@ -36,10 +62,23 @@ __all__ = [
     "MetricsRegistry",
     "MoEMetricsListener",
     "PROM_CONTENT_TYPE",
+    "STEP_PHASES",
     "Span",
+    "StepProfiler",
+    "TraceContext",
+    "TraceSpan",
+    "TraceStore",
+    "Tracer",
+    "current_context",
+    "current_span",
+    "decode_traceparent",
+    "encode_traceparent",
     "get_registry",
+    "get_tracer",
     "record_moe_metrics",
     "render_prometheus",
     "set_registry",
+    "set_tracer",
     "trace",
+    "trace_now",
 ]

@@ -1,0 +1,227 @@
+"""StepProfiler — per-phase training step-time attribution.
+
+The input-pipeline open item (ROADMAP; ``resnet50_e2e_fit`` is
+transfer-bound at 0.16× the synthetic step rate) needs *attribution*, not
+just totals: a step's wall time splits into
+
+* ``data_wait`` — time the training loop blocked waiting for the next
+  batch (an :class:`~deeplearning4j_tpu.data.iterators.
+  AsyncDataSetIterator` dequeue, file decode on a sync iterator, …),
+* ``h2d`` — host→device transfer of the batch (``device_put`` /
+  ``jnp.asarray`` on host memory),
+* ``compute`` — device execution of the jitted step,
+* ``host`` — host-side bookkeeping after dispatch (param reassignment,
+  listeners, score fetch).
+
+JAX dispatch is asynchronous: timing the jitted call measures only
+dispatch (~µs) while the device runs in the background, and naively
+fencing every step would serialize the pipeline the profiler is supposed
+to diagnose. So ``compute`` (and ``h2d``) are **fenced only every
+``sync_every`` steps** (``jax.block_until_ready``): sampled steps pay one
+synchronization and yield a true device-time measurement; the other
+steps run undisturbed and contribute to the cheap phases only. The
+breakdown extrapolates the sampled mean across all steps — the MLPerf
+TPU-pod input-pipeline methodology (PAPERS.md) of measuring input wait
+vs transfer vs device compute before optimizing any of them.
+
+Metrics: phase latencies land in
+``dl4j_tpu_training_step_phase_seconds{instance=,phase=}``; the
+:meth:`stats` breakdown is the per-instance view (README
+"Observability" one-source-of-truth convention).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Dict, Optional
+
+from .metrics import MetricsRegistry, get_registry
+
+PHASES = ("data_wait", "h2d", "compute", "host")
+
+# sub-ms tiny-model steps up to multi-second pod steps
+_PHASE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                  0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+_profiler_seq = itertools.count()
+
+
+class _Phase:
+    """Context manager timing one phase occurrence."""
+
+    __slots__ = ("_prof", "_name", "_sampled", "_t0")
+
+    def __init__(self, prof: "StepProfiler", name: str, sampled: bool) -> None:
+        self._prof = prof
+        self._name = name
+        self._sampled = sampled
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_Phase":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self._prof.record(self._name, time.perf_counter() - self._t0,
+                          sampled=self._sampled)
+
+
+class StepProfiler:
+    """Attributes training step wall time to ``data_wait`` / ``h2d`` /
+    ``compute`` / ``host`` phases.
+
+    Pass one to ``Solver(model, profiler=...)`` / ``GraphSolver`` and wrap
+    the data source with :meth:`wrap_iterator`; every phase both feeds the
+    metrics registry and accumulates into the :meth:`stats` breakdown.
+    ``sync_every=N`` fences device work on every Nth step (N=0 never
+    fences — device phases then measure dispatch only, which is stated in
+    ``stats()['fenced']``).
+    """
+
+    def __init__(self, *, sync_every: int = 10,
+                 registry: Optional[MetricsRegistry] = None,
+                 name: Optional[str] = None) -> None:
+        if sync_every < 0:
+            raise ValueError(f"sync_every must be >= 0, got {sync_every}")
+        self.sync_every = int(sync_every)
+        self.name = name or f"profiler-{next(_profiler_seq)}"
+        reg = registry if registry is not None else get_registry()
+        self.registry = reg
+        hist = reg.histogram(
+            "dl4j_tpu_training_step_phase_seconds",
+            "Training step time by phase (data_wait=input pipeline, "
+            "h2d=host-to-device transfer, compute=device step [fenced on "
+            "sampled steps only], host=post-dispatch bookkeeping)",
+            ("instance", "phase"), buckets=_PHASE_BUCKETS)
+        self._hist = {p: hist.labels(self.name, p) for p in PHASES}
+        self._totals: Dict[str, float] = {p: 0.0 for p in PHASES}
+        self._counts: Dict[str, int] = {p: 0 for p in PHASES}
+        # device phases measured under a fence, tracked separately so the
+        # extrapolation never mixes dispatch-only and fenced samples
+        self._sampled_totals = {"h2d": 0.0, "compute": 0.0}
+        self._sampled_counts = {"h2d": 0, "compute": 0}
+        self.steps = 0
+        self.sampled_steps = 0
+        self._step_open = False
+        self._step_sampled = False
+
+    # ---- step lifecycle ----------------------------------------------
+    def begin_step(self) -> bool:
+        """Start a step; returns True when this step should fence device
+        work (``jax.block_until_ready``) so compute/h2d are real."""
+        self._step_open = True
+        self._step_sampled = (self.sync_every > 0
+                              and self.steps % self.sync_every == 0)
+        return self._step_sampled
+
+    def end_step(self) -> None:
+        if not self._step_open:
+            return
+        self._step_open = False
+        self.steps += 1
+        if self._step_sampled:
+            self.sampled_steps += 1
+
+    # ---- recording ----------------------------------------------------
+    def phase(self, name: str, *, sampled: bool = False) -> _Phase:
+        """``with profiler.phase("h2d"): ...`` — times the block into the
+        phase. ``sampled=True`` marks a fenced device measurement."""
+        if name not in self._totals:
+            raise ValueError(f"unknown phase {name!r}; expected one of {PHASES}")
+        return _Phase(self, name, sampled)
+
+    def record(self, name: str, seconds: float, *, sampled: bool = False) -> None:
+        self._totals[name] += seconds
+        self._counts[name] += 1
+        if sampled and name in self._sampled_totals:
+            self._sampled_totals[name] += seconds
+            self._sampled_counts[name] += 1
+        self._hist[name].observe(seconds)
+
+    def record_data_wait(self, seconds: float) -> None:
+        self.record("data_wait", seconds)
+
+    # ---- iterator instrumentation ------------------------------------
+    def wrap_iterator(self, iterator):
+        """Wrap a ``DataSetIterator`` (or any iterable) so the time the
+        consumer blocks in ``next()`` is attributed to ``data_wait``."""
+        return _ProfiledIterator(iterator, self)
+
+    # ---- breakdown ----------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        """Per-phase breakdown.
+
+        ``per_step_ms`` uses fenced (sampled) means for the device phases
+        and all-step means for the host phases; ``share`` normalizes those
+        attributed per-step costs — the number that must *explain* an
+        e2e/synthetic throughput ratio, not just restate totals.
+        """
+        steps = max(self.steps, 1)
+        per_step_ms: Dict[str, float] = {}
+        for p in PHASES:
+            if p in self._sampled_totals and self._sampled_counts[p] > 0:
+                mean = self._sampled_totals[p] / self._sampled_counts[p]
+            else:
+                mean = self._totals[p] / steps
+            per_step_ms[p] = mean * 1e3
+        total_ms = sum(per_step_ms.values())
+        share = {p: (v / total_ms if total_ms > 0 else 0.0)
+                 for p, v in per_step_ms.items()}
+        return {
+            "steps": self.steps,
+            "sampled_steps": self.sampled_steps,
+            "fenced": self.sync_every > 0,
+            "seconds_total": {p: self._totals[p] for p in PHASES},
+            "per_step_ms": {p: round(v, 4) for p, v in per_step_ms.items()},
+            "share": {p: round(v, 4) for p, v in share.items()},
+            "step_time_ms_est": round(total_ms, 4),
+            "input_bound_share": round(
+                share["data_wait"] + share["h2d"], 4),
+        }
+
+
+class _ProfiledIterator:
+    """DataSetIterator/iterable proxy attributing ``next()`` wall time to
+    the profiler's ``data_wait`` phase."""
+
+    def __init__(self, underlying, profiler: StepProfiler) -> None:
+        self.underlying = underlying
+        self.profiler = profiler
+
+    # DataSetIterator protocol --------------------------------------------
+    def has_next(self) -> bool:
+        return self.underlying.has_next()
+
+    def next(self):
+        t0 = time.perf_counter()
+        try:
+            return self.underlying.next()
+        finally:
+            self.profiler.record_data_wait(time.perf_counter() - t0)
+
+    def reset(self) -> None:
+        self.underlying.reset()
+
+    def batch_size(self) -> int:
+        return self.underlying.batch_size()
+
+    def stats(self) -> dict:
+        s = getattr(self.underlying, "stats", None)
+        return s() if callable(s) else {}
+
+    def close(self, *a, **kw) -> None:
+        c = getattr(self.underlying, "close", None)
+        if callable(c):
+            c(*a, **kw)
+
+    # plain-iterable protocol ---------------------------------------------
+    def __iter__(self):
+        self._it = iter(self.underlying)
+        return self
+
+    def __next__(self):
+        t0 = time.perf_counter()
+        item = next(self._it)  # StopIteration is not a wait to attribute
+        self.profiler.record_data_wait(time.perf_counter() - t0)
+        return item

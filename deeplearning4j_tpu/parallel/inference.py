@@ -50,6 +50,7 @@ from ..core.resilience import (
     get_fault_injector,
 )
 from ..obs.metrics import MetricsRegistry, Span, get_registry
+from ..obs.tracing import Tracer, current_context, get_tracer, trace_now
 
 FORWARD_SITE = "parallel_inference.forward"  # FaultInjector site name
 
@@ -93,12 +94,17 @@ class Servable:
 
 
 class _Request:
-    __slots__ = ("x", "fut", "deadline")
+    __slots__ = ("x", "fut", "deadline", "trace_ctx", "t_enqueue")
 
-    def __init__(self, x: np.ndarray, fut: Future, deadline: Deadline) -> None:
+    def __init__(self, x: np.ndarray, fut: Future, deadline: Deadline,
+                 trace_ctx=None, t_enqueue: float = 0.0) -> None:
         self.x = x
         self.fut = fut
         self.deadline = deadline
+        # trace identity captured at enqueue (the handler thread's current
+        # span); the worker parents queue-wait/forward spans under it
+        self.trace_ctx = trace_ctx
+        self.t_enqueue = t_enqueue
 
     @property
     def rows(self) -> int:
@@ -122,12 +128,14 @@ class ParallelInference:
         registry: Optional[MetricsRegistry] = None,
         name: Optional[str] = None,
         model_version: str = "0",
+        tracer: Optional[Tracer] = None,
     ) -> None:
         self.mode = inference_mode
         self.batch_limit = int(batch_limit)
         self.default_timeout = default_timeout
         self._clock = clock
         self._fault_injector = fault_injector
+        self._tracer = tracer  # None -> process-global at call time
         self.name = name or f"pi-{next(_instance_seq)}"
         # the queue itself is unbounded: backpressure is the admission
         # controller's job, and it answers NOW instead of blocking the
@@ -308,6 +316,12 @@ class ParallelInference:
                 timeout if timeout is not None else self.default_timeout,
                 clock=self._clock)
         fut: Future = Future()
+        # request-scoped tracing only: a traced caller (server span) gets
+        # queue-wait/forward child spans from the worker; untraced callers
+        # (training eval loops, tests) cost nothing and store nothing
+        tracer = self._tracer if self._tracer is not None else get_tracer()
+        ctx = current_context() if tracer.enabled else None
+        t_enq = trace_now() if ctx is not None else 0.0
         # The lock orders enqueues against shutdown's sentinel placement: no
         # request can land behind the sentinels and starve its Future.
         with self._lock:
@@ -325,7 +339,8 @@ class ParallelInference:
                 raise
             self._c["accepted"].inc()
             self._g_queue.inc()
-            self._queue.put(_Request(np.asarray(x), fut, deadline))
+            self._queue.put(_Request(np.asarray(x), fut, deadline,
+                                     trace_ctx=ctx, t_enqueue=t_enq))
         return fut
 
     def _finish(self, n: int = 1) -> None:
@@ -392,6 +407,36 @@ class ParallelInference:
         return self._breaker.state
 
     # ----- worker side ------------------------------------------------
+    def _record_engine_spans(self, traced, batch_requests, t_assemble,
+                             t_fwd, t_done, n, padded_n, version,
+                             fwd_ok) -> None:
+        """Flush the per-request engine child spans measured during a
+        batch. Called after the batch's futures have settled — span
+        recording costs the worker, never the waiting caller — and
+        exported as ONE bulk put (one potential flusher wakeup per
+        forward, not per span)."""
+        tracer = self._tracer if self._tracer is not None else get_tracer()
+        mk = tracer.make_record
+        records = []
+        for req in traced:
+            records.append(mk(
+                "engine.queue_wait", req.trace_ctx,
+                req.t_enqueue, t_assemble, attrs={"engine": self.name}))
+            if t_fwd:  # batch assembly completed
+                records.append(mk(
+                    "engine.batch", req.trace_ctx, t_assemble, t_fwd,
+                    attrs={"engine": self.name,
+                           "batch_requests": batch_requests,
+                           "batch_rows": n,
+                           "padded_rows": padded_n - n}))
+            if t_done:  # forward ran (successfully or not)
+                records.append(mk(
+                    "engine.forward", req.trace_ctx, t_fwd, t_done,
+                    attrs={"engine": self.name, "batch_rows": padded_n,
+                           "model_version": version},
+                    error=not fwd_ok))
+        tracer.record_spans(records)
+
     def _expire(self, req: _Request) -> bool:
         """Settle an already-expired request without spending a forward."""
         if req.deadline.expired():
@@ -440,6 +485,16 @@ class ParallelInference:
             # one servable reference per batch: a concurrent swap cannot
             # tear this batch between two model versions
             sv = self._servable
+            # per-request child spans (queue wait enqueue→dequeue, batch
+            # assembly+padding, jitted forward) for requests that carried
+            # a trace context in. Timestamps are taken inline but the
+            # spans are RECORDED after the futures settle, so telemetry
+            # never adds to the caller-visible critical path.
+            traced = [r for r in batch if r.trace_ctx is not None]
+            t_assemble = trace_now() if traced else 0.0
+            t_fwd = t_done = 0.0
+            fwd_ok = False
+            n = padded_n = 0
             try:
                 arrays = []
                 sizes = []
@@ -454,10 +509,16 @@ class ParallelInference:
                 if padded_n > n:
                     pad = np.repeat(cat[-1:], padded_n - n, axis=0)
                     cat = np.concatenate([cat, pad], axis=0)
-                with Span(self._h_forward):
-                    self._inj().fire(FORWARD_SITE)
-                    out = np.asarray(
-                        sv.fwd(jnp.asarray(cat, sv.model.dtype)))[:n]
+                t_fwd = trace_now() if traced else 0.0
+                try:
+                    with Span(self._h_forward):
+                        self._inj().fire(FORWARD_SITE)
+                        out = np.asarray(
+                            sv.fwd(jnp.asarray(cat, sv.model.dtype)))[:n]
+                    fwd_ok = True
+                finally:
+                    if traced:
+                        t_done = trace_now()
                 self._breaker.record_success()
                 self._c_batches.inc()
                 self._c_rows.inc(n)
@@ -480,4 +541,11 @@ class ParallelInference:
                     if not req.fut.done():
                         req.fut.set_exception(e)
             finally:
+                # spans before _finish: futures are already settled (the
+                # caller is not waiting on this), and recording first
+                # means drain()/shutdown() imply all spans are flushed
+                if traced:
+                    self._record_engine_spans(
+                        traced, len(batch), t_assemble, t_fwd, t_done,
+                        n, padded_n, sv.version, fwd_ok)
                 self._finish(len(batch))

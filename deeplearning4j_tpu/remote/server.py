@@ -28,6 +28,17 @@ Endpoints:
                    registry (default: the process-global one, so one
                    scrape sees serving + training + data metrics) —
                    contract enforced by tools/check_metrics_contract.py
+  GET  /v1/traces → recent completed traces (?min_ms=&route=&limit=),
+                   README "Tracing & step-time attribution"; contract
+                   enforced by tools/check_trace_contract.py
+
+Tracing (obs/tracing.py): every POST gets an ``X-Request-Id`` (echoed
+when the client sent one, generated otherwise — also the canary routing
+key); the W3C ``traceparent`` request header is honored and a
+``server.request`` span (with engine child spans) is recorded for
+sampled traces. ``JsonRemoteInference`` injects ``traceparent`` per
+attempt under a ``client.request`` root span. Tracing off = byte
+identical behavior.
 
 Multi-model serving (serving/ — README "Model registry & hot-swap
 serving"): registered :class:`~deeplearning4j_tpu.serving.manager.
@@ -48,11 +59,13 @@ import itertools
 import json
 import threading
 import time
+import uuid
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib import request as urllib_request
 from urllib.error import HTTPError, URLError
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
@@ -68,6 +81,14 @@ from ..core.resilience import (
 from ..obs.metrics import MetricsRegistry, get_registry
 from ..obs.prom import CONTENT_TYPE as _PROM_CONTENT_TYPE
 from ..obs.prom import render_prometheus
+from ..obs.tracing import (
+    Tracer,
+    current_context,
+    decode_traceparent,
+    encode_traceparent,
+    get_tracer,
+    trace_now,
+)
 from ..parallel.inference import InferenceMode, ParallelInference
 from ..serving.store import VersionNotFoundError
 
@@ -96,7 +117,8 @@ class JsonModelServer:
                  clock=time.monotonic, fault_injector=None,
                  registry: Optional[MetricsRegistry] = None,
                  name: Optional[str] = None,
-                 managers: Optional[dict] = None) -> None:
+                 managers: Optional[dict] = None,
+                 tracer: Optional[Tracer] = None) -> None:
         self.model = model
         self.path = path
         self.default_deadline = float(default_deadline)
@@ -104,6 +126,7 @@ class JsonModelServer:
         self._draining = False
         self.name = name or f"server-{next(_server_seq)}"
         self.registry = registry if registry is not None else get_registry()
+        self._tracer = tracer  # None -> process-global at request time
         # named ModelManager endpoints (serving/): name -> manager. The
         # server routes to them; their lifecycle (deploy/rollback/
         # shutdown) stays with the caller that owns them.
@@ -113,7 +136,7 @@ class JsonModelServer:
             batch_limit=batch_limit, workers=workers,
             queue_limit=queue_limit, circuit_breaker=circuit_breaker,
             admission=admission, clock=clock, fault_injector=fault_injector,
-            registry=self.registry, name=self.name)
+            registry=self.registry, name=self.name, tracer=tracer)
         # per-status-code request counters + end-to-end request latency,
         # recorded once per POST in the handler's finally
         self._req_counts = self.registry.counter(
@@ -137,6 +160,11 @@ class JsonModelServer:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                # every POST response names the request it answers —
+                # client-provided id echoed, server-generated otherwise
+                rid = getattr(self, "_request_id", None)
+                if rid is not None:
+                    self.send_header("X-Request-Id", rid)
                 for k, v in (headers or {}).items():
                     self.send_header(k, v)
                 self.end_headers()
@@ -152,6 +180,9 @@ class JsonModelServer:
                     self._send(code, status)
                 elif self.path == "/stats":
                     self._send(200, outer.stats())
+                elif self.path.split("?", 1)[0] == "/v1/traces":
+                    self._send(200, outer.traces_payload(
+                        urlparse(self.path).query))
                 elif self.path == _MODELS_PREFIX:
                     self._send(200, {"models": {
                         n: m.describe() for n, m in
@@ -177,8 +208,26 @@ class JsonModelServer:
             def do_POST(self):
                 t0 = time.perf_counter()
                 self._sent_code = None
+                # X-Request-Id: client-provided or server-generated, echoed
+                # on the response either way so canary routing / trace
+                # lookup never silently key off a payload hash
+                self._request_id = (self.headers.get("X-Request-Id")
+                                    or uuid.uuid4().hex)
+                tracer = outer.tracer
+                ctx = decode_traceparent(self.headers.get("traceparent")) \
+                    if tracer.enabled else None
+                span = tracer.span(
+                    "server.request", parent=ctx,
+                    attrs={"route": self.path,
+                           "request_id": self._request_id,
+                           "server": outer.name})
                 try:
-                    self._handle_post()
+                    with span:
+                        self._handle_post()
+                        if self._sent_code is not None:
+                            span.set_attribute("status", self._sent_code)
+                            if self._sent_code >= 500:
+                                span.error = True
                 finally:
                     if self._sent_code is not None:
                         outer._observe_request(
@@ -197,7 +246,10 @@ class JsonModelServer:
                         self._send(404, {"error": f"unknown model {mname!r}"})
                         return None
                     pin = self.headers.get("X-Model-Version")
-                    key = self.headers.get("X-Request-Id")
+                    # canary routing keys off the request id — generated
+                    # server-side when the client sent none, so the split
+                    # is always attributable to an id the client saw
+                    key = self._request_id
                     return lambda data, deadline: mgr.submit(
                         data, key=key, version=pin, deadline=deadline)
                 self._send(404, {"error": f"unknown path {self.path}"})
@@ -253,6 +305,35 @@ class JsonModelServer:
     def _observe_request(self, code: int, seconds: float) -> None:
         self._req_counts.labels(self.name, str(code)).inc()
         self._req_latency.observe(seconds)
+
+    @property
+    def tracer(self) -> Tracer:
+        return self._tracer if self._tracer is not None else get_tracer()
+
+    def traces_payload(self, query: str = "") -> dict:
+        """``GET /v1/traces`` body: recent completed traces, filterable by
+        ``min_ms`` (minimum total duration), ``route`` (span route
+        attribute, e.g. ``/v1/serving``) and ``limit``."""
+        q = parse_qs(query or "")
+
+        def first(key, cast, default=None):
+            vals = q.get(key)
+            if not vals:
+                return default
+            try:
+                return cast(vals[0])
+            except (TypeError, ValueError):
+                return default
+
+        store = self.tracer.store
+        return {
+            "enabled": self.tracer.enabled,
+            "trace_count": len(store),
+            "traces": store.traces(
+                min_duration_ms=first("min_ms", float),
+                route=first("route", str),
+                limit=first("limit", int, 50)),
+        }
 
     def add_model(self, name: str, manager) -> "JsonModelServer":
         """Register a :class:`~deeplearning4j_tpu.serving.manager.
@@ -334,13 +415,15 @@ class JsonRemoteInference:
                  retry_policy: Optional[RetryPolicy] = None,
                  sleep=time.sleep, clock=time.monotonic,
                  registry: Optional[MetricsRegistry] = None,
-                 name: Optional[str] = None) -> None:
+                 name: Optional[str] = None,
+                 tracer: Optional[Tracer] = None) -> None:
         self.endpoint = endpoint
         self.timeout = timeout
         self.retry_policy = retry_policy or RetryPolicy(
             max_retries=3, initial_backoff=0.05, max_backoff=2.0)
         self._sleep = sleep
         self._clock = clock
+        self._tracer = tracer  # None -> process-global at call time
         self.retries = 0  # attempts beyond the first, across calls
         self.name = name or f"client-{next(_client_seq)}"
         reg = registry if registry is not None else get_registry()
@@ -349,6 +432,10 @@ class JsonRemoteInference:
             "JsonRemoteInference retry attempts (beyond the first try)",
             ("instance",)).labels(self.name)
 
+    @property
+    def tracer(self) -> Tracer:
+        return self._tracer if self._tracer is not None else get_tracer()
+
     def _call_once(self, body: bytes, deadline: Deadline) -> dict:
         rem = deadline.remaining()
         if rem is not None and rem <= 0:
@@ -356,26 +443,56 @@ class JsonRemoteInference:
         headers = {"Content-Type": "application/json"}
         if rem is not None:
             headers["X-Deadline-Ms"] = str(int(rem * 1000))
-        req = urllib_request.Request(self.endpoint, data=body, headers=headers)
+        # one HTTP attempt = one span: a retry keeps the request's trace
+        # id (the enclosing client.request span) but gets a fresh span id,
+        # so the trace shows every attempt the server saw. The attempt
+        # span is synthesized with the exact identity sent on the wire
+        # (no contextvar churn on the request hot path).
+        tracer = self.tracer
+        parent = current_context() if tracer.enabled else None
+        attempt = None
+        t0 = 0.0
+        if parent is not None:  # propagate identity even when unsampled
+            attempt = parent.child()
+            headers["traceparent"] = encode_traceparent(attempt)
+            t0 = trace_now()
+        status = None
+        ok = False
         try:
-            with urllib_request.urlopen(req, timeout=rem) as resp:
-                return json.loads(resp.read())
-        except HTTPError as e:
-            detail = ""
+            req = urllib_request.Request(self.endpoint, data=body,
+                                         headers=headers)
             try:
-                detail = json.loads(e.read()).get("error", "")
-            except Exception:
-                pass
-            if e.code == 503:
-                ra = e.headers.get("Retry-After")
-                raise ServiceUnavailableError(
-                    detail or "service unavailable",
-                    retry_after=float(ra) if ra else None) from e
-            if e.code == 504:
-                raise DeadlineExceededError(detail or "deadline exceeded") from e
-            if e.code == 400:
-                raise ValueError(detail or "bad request") from e
-            raise RuntimeError(f"HTTP {e.code}: {detail}") from e
+                with urllib_request.urlopen(req, timeout=rem) as resp:
+                    status = resp.status
+                    payload = json.loads(resp.read())
+                    ok = True
+                    return payload
+            except HTTPError as e:
+                status = e.code
+                detail = ""
+                try:
+                    detail = json.loads(e.read()).get("error", "")
+                except Exception:
+                    pass
+                if e.code == 503:
+                    ra = e.headers.get("Retry-After")
+                    raise ServiceUnavailableError(
+                        detail or "service unavailable",
+                        retry_after=float(ra) if ra else None) from e
+                if e.code == 504:
+                    raise DeadlineExceededError(
+                        detail or "deadline exceeded") from e
+                if e.code == 400:
+                    raise ValueError(detail or "bad request") from e
+                raise RuntimeError(f"HTTP {e.code}: {detail}") from e
+        finally:
+            if attempt is not None:
+                rec = tracer.make_record(
+                    "client.http", parent, t0, trace_now(),
+                    attrs={"endpoint": self.endpoint, "status": status},
+                    error=not ok, span_id=attempt.span_id)
+                if rec is not None:
+                    tracer._export(rec)
 
     def predict(self, data, *, timeout: Optional[float] = None) -> np.ndarray:
         body = json.dumps({"data": np.asarray(data).tolist()}).encode()
@@ -387,10 +504,13 @@ class JsonRemoteInference:
             self.retries += 1
             self._c_retries.inc()
 
-        payload = self.retry_policy.execute(
-            lambda: self._call_once(body, deadline),
-            retry_on=(ServiceUnavailableError, URLError, ConnectionError),
-            deadline=deadline, sleep=self._sleep, on_retry=note_retry)
+        with self.tracer.span("client.request",
+                              attrs={"endpoint": self.endpoint}) as root:
+            payload = self.retry_policy.execute(
+                lambda: self._call_once(body, deadline),
+                retry_on=(ServiceUnavailableError, URLError, ConnectionError),
+                deadline=deadline, sleep=self._sleep, on_retry=note_retry)
+            root.set_attribute("retries", self.retries)
         if "error" in payload:
             raise RuntimeError(payload["error"])
         return np.asarray(payload["output"], np.float32)

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 
 from ..core.resilience import CircuitBreaker, CircuitState, get_fault_injector
 from ..obs.metrics import MetricsRegistry, Span, get_registry
+from ..obs.tracing import Tracer, get_tracer
 from ..parallel.inference import ParallelInference, Servable
 from .router import ModelRouter
 from .store import LATEST, ModelStore, ModelVersion, VersionNotFoundError
@@ -98,11 +99,13 @@ class ModelManager:
         fault_injector=None,
         registry: Optional[MetricsRegistry] = None,
         optimize: Union[str, list, None] = "inference",
+        tracer: Optional[Tracer] = None,
     ) -> None:
         self.store = store
         self.model_name = model_name
         self._clock = clock
         self._fault_injector = fault_injector
+        self._tracer = tracer  # None -> process-global at call time
         # graph rewrite pipeline applied to every store-loaded model
         # BEFORE warmup (nn/rewrite): the default "inference" set folds
         # conv+BN, rewrites the conv stem and fuses remaining BNs, so the
@@ -117,7 +120,7 @@ class ModelManager:
         self._engine_opts = dict(
             batch_limit=batch_limit, workers=workers, queue_limit=queue_limit,
             default_timeout=default_timeout, clock=clock,
-            fault_injector=fault_injector)
+            fault_injector=fault_injector, tracer=tracer)
         self.registry = registry if registry is not None else get_registry()
         swap = self.registry.counter(
             "dl4j_tpu_serving_swap_total",
@@ -168,22 +171,29 @@ class ModelManager:
     def _inj(self):
         return self._fault_injector or get_fault_injector()
 
+    @property
+    def tracer(self) -> Tracer:
+        return self._tracer if self._tracer is not None else get_tracer()
+
     def _load(self, version: Union[int, str]):
         """Load + checksum-verify from the store, then apply the inference
         rewrite pipeline to the in-memory copy (the artifact on disk stays
         un-rewritten). Warmup — and therefore probation — always measures
         the graph that will actually serve."""
-        self._inj().fire(LOAD_SITE)
-        model, entry = self.store.load(self.model_name, version)
-        if self._optimize:
-            from ..nn.rewrite import rewrite_model
+        with self.tracer.span("manager.load",
+                              attrs={"model": self.model_name,
+                                     "version": str(version)}):
+            self._inj().fire(LOAD_SITE)
+            model, entry = self.store.load(self.model_name, version)
+            if self._optimize:
+                from ..nn.rewrite import rewrite_model
 
-            model, applied = rewrite_model(model, self._optimize,
-                                           context="inference")
-            if applied:
-                self.registry.log_event(
-                    "model_rewrite", model=self.model_name,
-                    version=str(entry.version), passes=applied)
+                model, applied = rewrite_model(model, self._optimize,
+                                               context="inference")
+                if applied:
+                    self.registry.log_event(
+                        "model_rewrite", model=self.model_name,
+                        version=str(entry.version), passes=applied)
         return model, entry
 
     def _set_live_gauge(self) -> None:
@@ -207,11 +217,15 @@ class ModelManager:
         if feat is None:
             return
         dtype = servable.model.dtype
-        for b in engine.bucket_sizes():
-            x = jnp.zeros((b,) + tuple(feat), dtype)
-            with Span(self._h_warmup):
-                self._inj().fire(WARMUP_SITE)
-                np.asarray(servable.fwd(x))  # block until executed
+        with self.tracer.span("manager.warmup",
+                              attrs={"model": self.model_name,
+                                     "version": servable.version,
+                                     "buckets": len(engine.bucket_sizes())}):
+            for b in engine.bucket_sizes():
+                x = jnp.zeros((b,) + tuple(feat), dtype)
+                with Span(self._h_warmup):
+                    self._inj().fire(WARMUP_SITE)
+                    np.asarray(servable.fwd(x))  # block until executed
 
     # ----- deploy / rollback ------------------------------------------
     @property
@@ -237,31 +251,43 @@ class ModelManager:
             entry = self.store.resolve(self.model_name, version)
             if str(entry.version) == self._live.version:
                 return entry
-            model, entry = self._load(entry.version)
-            servable = self.engine.make_servable(
-                model, version=str(entry.version))
-            try:
-                self._warm(servable, self.engine)
-            except Exception as e:
-                self._c_swap["warmup_failed"].inc()
-                raise SwapError(
-                    f"{self.model_name} v{entry.version}: warmup failed, "
-                    f"keeping v{self._live.version} live: {e}") from e
-            breaker = self._breaker_factory()
-            breaker.add_observer(self._on_candidate_transition)
-            old_breaker = self._live.breaker
-            self.engine.swap(servable, circuit_breaker=breaker)
-            old_breaker.remove_observer(self._on_candidate_transition)
-            self._previous = self._live
-            self._live = _Deployment(entry, servable, breaker)
-            self._probation_until = self._clock() + self.probation_seconds
-            self._rolling_back = False
-            self._c_swap["completed"].inc()
-            self._set_live_gauge()
-            self.registry.log_event(
-                "model_swap", model=self.model_name,
-                version=str(entry.version),
-                previous=self._previous.version)
+            # a slow deploy must be diagnosable after the fact: the whole
+            # load→warm→swap sequence is one trace, children per stage
+            with self.tracer.span(
+                    "manager.deploy",
+                    attrs={"model": self.model_name,
+                           "version": str(entry.version),
+                           "previous": self._live.version}) as dspan:
+                model, entry = self._load(entry.version)
+                servable = self.engine.make_servable(
+                    model, version=str(entry.version))
+                try:
+                    self._warm(servable, self.engine)
+                except Exception as e:
+                    self._c_swap["warmup_failed"].inc()
+                    dspan.set_attribute("outcome", "warmup_failed")
+                    raise SwapError(
+                        f"{self.model_name} v{entry.version}: warmup failed, "
+                        f"keeping v{self._live.version} live: {e}") from e
+                breaker = self._breaker_factory()
+                breaker.add_observer(self._on_candidate_transition)
+                old_breaker = self._live.breaker
+                with self.tracer.span("manager.swap",
+                                      attrs={"model": self.model_name,
+                                             "version": str(entry.version)}):
+                    self.engine.swap(servable, circuit_breaker=breaker)
+                old_breaker.remove_observer(self._on_candidate_transition)
+                self._previous = self._live
+                self._live = _Deployment(entry, servable, breaker)
+                self._probation_until = self._clock() + self.probation_seconds
+                self._rolling_back = False
+                self._c_swap["completed"].inc()
+                self._set_live_gauge()
+                dspan.set_attribute("outcome", "completed")
+                self.registry.log_event(
+                    "model_swap", model=self.model_name,
+                    version=str(entry.version),
+                    previous=self._previous.version)
             return entry
 
     def _on_candidate_transition(self, old: CircuitState,
@@ -307,18 +333,22 @@ class ModelManager:
     def _rollback_locked(self) -> _Deployment:
         bad = self._live
         good = self._previous
-        bad.breaker.remove_observer(self._on_candidate_transition)
-        # counter first: anyone who observes the version flip must also
-        # see the rollback already counted
-        self._c_swap["rolled_back"].inc()
-        self.engine.swap(good.servable, circuit_breaker=good.breaker)
-        self._live = good
-        self._previous = None  # the bad version is not a rollback target
-        self._probation_until = 0.0
-        self._set_live_gauge()
-        self.registry.log_event(
-            "model_rollback", model=self.model_name,
-            version=good.version, rolled_back_from=bad.version)
+        with self.tracer.span("manager.rollback",
+                              attrs={"model": self.model_name,
+                                     "version": good.version,
+                                     "rolled_back_from": bad.version}):
+            bad.breaker.remove_observer(self._on_candidate_transition)
+            # counter first: anyone who observes the version flip must also
+            # see the rollback already counted
+            self._c_swap["rolled_back"].inc()
+            self.engine.swap(good.servable, circuit_breaker=good.breaker)
+            self._live = good
+            self._previous = None  # the bad version is not a rollback target
+            self._probation_until = 0.0
+            self._set_live_gauge()
+            self.registry.log_event(
+                "model_rollback", model=self.model_name,
+                version=good.version, rolled_back_from=bad.version)
         return good
 
     def confirm(self) -> None:
@@ -340,22 +370,27 @@ class ModelManager:
             if self._canary is not None:
                 raise SwapError(f"{self.model_name}: canary v"
                                 f"{self._canary.version} already running")
-            model, entry = self._load(version)
-            breaker = self._breaker_factory()
-            opts = dict(self._engine_opts)
-            opts["workers"] = workers
-            engine = ParallelInference(
-                model, circuit_breaker=breaker, registry=self.registry,
-                name=f"{self.model_name}-canary",
-                model_version=str(entry.version), **opts)
-            try:
-                self._warm(engine._servable, engine)
-            except Exception as e:
-                engine.shutdown(drain=False)
-                self._c_swap["warmup_failed"].inc()
-                raise SwapError(
-                    f"{self.model_name} v{entry.version}: canary warmup "
-                    f"failed: {e}") from e
+            with self.tracer.span(
+                    "manager.canary_start",
+                    attrs={"model": self.model_name,
+                           "version": str(version), "weight": weight,
+                           "shadow": bool(shadow)}):
+                model, entry = self._load(version)
+                breaker = self._breaker_factory()
+                opts = dict(self._engine_opts)
+                opts["workers"] = workers
+                engine = ParallelInference(
+                    model, circuit_breaker=breaker, registry=self.registry,
+                    name=f"{self.model_name}-canary",
+                    model_version=str(entry.version), **opts)
+                try:
+                    self._warm(engine._servable, engine)
+                except Exception as e:
+                    engine.shutdown(drain=False)
+                    self._c_swap["warmup_failed"].inc()
+                    raise SwapError(
+                        f"{self.model_name} v{entry.version}: canary warmup "
+                        f"failed: {e}") from e
             breaker.add_observer(self._on_canary_transition)
             self._canary = _Deployment(entry, engine._servable, breaker)
             self._canary_engine = engine

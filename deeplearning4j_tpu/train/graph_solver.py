@@ -8,6 +8,7 @@ the jitted step takes tuples of inputs/labels (MultiDataSet).
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -19,9 +20,11 @@ from .solver import LayerOptimizers, _normalize_gradients
 
 
 class GraphSolver:
-    def __init__(self, model, *, optimize=None) -> None:
+    def __init__(self, model, *, optimize=None, profiler=None) -> None:
         """``optimize=`` applies training-safe graph rewrite passes at
-        step-build time (see Solver.__init__ / nn/rewrite)."""
+        step-build time (see Solver.__init__ / nn/rewrite). ``profiler=``
+        attaches a :class:`~deeplearning4j_tpu.obs.step_profiler.
+        StepProfiler` for per-phase step attribution (see Solver)."""
         self.model = model
         if hasattr(model, "migrate_state"):
             model.migrate_state()
@@ -31,6 +34,7 @@ class GraphSolver:
 
             self.applied_rewrites = rewrite_model_inplace(
                 model, optimize, context="training")
+        self.profiler = profiler
         self.optim = LayerOptimizers(model)
         self.opt_state = self.optim.init(model.params)
         self._step_cache: Dict[Any, Any] = {}
@@ -89,14 +93,29 @@ class GraphSolver:
 
     def fit_batch(self, xs: Tuple, ys: Tuple):
         model = self.model
+        # StepProfiler phase attribution; mirrors Solver.fit_batch (device
+        # phases fenced only on sampled steps). prof=None costs nothing.
+        prof = self.profiler
+        fence = prof.begin_step() if prof is not None else False
+        t0 = time.perf_counter() if prof is not None else 0.0
         xs = model._as_inputs(xs)
         ys = tuple(jnp.asarray(y) for y in ys)
+        if prof is not None and (fence or prof.sync_every == 0):
+            if fence:
+                jax.block_until_ready((xs, ys))
+            prof.record("h2d", time.perf_counter() - t0, sampled=fence)
         want_grads = model.listeners.requires_arrays
         fn = self._step_fn(len(xs), len(ys), want_grads)
         rng = model._rng.next_key()
+        tc = time.perf_counter() if prof is not None else 0.0
         out = fn(
             model.params, self.opt_state, model.state, xs, ys, rng
         )
+        if prof is not None and (fence or prof.sync_every == 0):
+            if fence:
+                jax.block_until_ready(out)
+            prof.record("compute", time.perf_counter() - tc, sampled=fence)
+        th = time.perf_counter() if prof is not None else 0.0
         grads = None
         if want_grads:
             params, opt_state, state, score, grads = out
@@ -109,6 +128,9 @@ class GraphSolver:
         if grads is not None:
             # after reassignment: pre-step buffers were donated to the step
             model.listeners.gradient_calculation(model, grads)
+        if prof is not None:
+            prof.record("host", time.perf_counter() - th)
+            prof.end_step()
         return score
 
     def fit(self, data, labels=None, *, epochs: int = 1) -> None:
@@ -120,7 +142,8 @@ class GraphSolver:
             tuple(np.shape(a) for a in xs) + tuple(np.shape(a) for a in ys)
             for xs, ys in batches
         }
-        if not sync_every_iter and batches and len(shapes) == 1:
+        if (not sync_every_iter and self.profiler is None
+                and batches and len(shapes) == 1):
             xs_stack = tuple(
                 np.stack([np.asarray(b[0][i]) for b in batches])
                 for i in range(len(batches[0][0]))

@@ -18,6 +18,7 @@ mirroring the reference's weightDecay semantics.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -111,13 +112,20 @@ class LayerOptimizers:
 
 
 class Solver:
-    def __init__(self, model, *, optimize=None) -> None:
+    def __init__(self, model, *, optimize=None, profiler=None) -> None:
         """``optimize=`` applies training-safe graph rewrite passes at
         step-build time (``True``/``"training"`` -> the default set:
         space-to-depth stem + BN affine precompute; or an explicit pass
         list — inference-only passes are rejected). The model is rewritten
         in place to a numerically equivalent form; rewrites are in-memory
-        only and never serialized (nn/rewrite)."""
+        only and never serialized (nn/rewrite).
+
+        ``profiler=`` attaches a
+        :class:`~deeplearning4j_tpu.obs.step_profiler.StepProfiler`: each
+        ``fit_batch`` attributes its time to h2d / compute / host phases
+        (device phases fenced on the profiler's sampling schedule), and
+        ``fit`` skips the whole-epoch ``lax.scan`` fast path because one
+        fused dispatch has no per-step structure to attribute."""
         self.model = model
         if hasattr(model, "migrate_state"):
             model.migrate_state()
@@ -127,6 +135,7 @@ class Solver:
 
             self.applied_rewrites = rewrite_model_inplace(
                 model, optimize, context="training")
+        self.profiler = profiler
         self.optim = LayerOptimizers(model)
         self.opt_state = self.optim.init(model.params)
         self._step_cache: Dict[Any, Any] = {}
@@ -162,19 +171,36 @@ class Solver:
 
     def fit_batch(self, x, y, mask=None, label_mask=None, rnn_state=None) -> Tuple[float, Optional[dict]]:
         model = self.model
+        # phase attribution (StepProfiler): h2d / compute measured under a
+        # block_until_ready fence ONLY on the profiler's sampled steps so
+        # steady-state async dispatch stays unperturbed; host time every
+        # step. prof=None is the zero-overhead path.
+        prof = self.profiler
+        fence = prof.begin_step() if prof is not None else False
+        t0 = time.perf_counter() if prof is not None else 0.0
         x = as_input(x, model.dtype, model.keeps_int_input())
         y = jnp.asarray(y)
         mask_a = None if mask is None else jnp.asarray(mask, model.dtype)
         lmask_a = None if label_mask is None else jnp.asarray(label_mask, model.dtype)
+        if prof is not None and (fence or prof.sync_every == 0):
+            if fence:
+                jax.block_until_ready((x, y))
+            prof.record("h2d", time.perf_counter() - t0, sampled=fence)
         stateful = rnn_state is not None
         want_grads = model.listeners.requires_arrays
         fn = self._step_fn(mask_a is not None, lmask_a is not None, stateful,
                            want_grads)
         rng = model._rng.next_key()
+        tc = time.perf_counter() if prof is not None else 0.0
         out = fn(
             model.params, self.opt_state, model.state,
             rnn_state if stateful else {}, x, y, rng, mask_a, lmask_a,
         )
+        if prof is not None and (fence or prof.sync_every == 0):
+            if fence:
+                jax.block_until_ready(out)
+            prof.record("compute", time.perf_counter() - tc, sampled=fence)
+        th = time.perf_counter() if prof is not None else 0.0
         grads = None
         if want_grads:
             params, opt_state, state, new_rnn, score, grads = out
@@ -188,6 +214,9 @@ class Solver:
             # after reassignment: the pre-step buffers were donated to the
             # jitted step, so listeners must see the NEW params
             model.listeners.gradient_calculation(model, grads)
+        if prof is not None:
+            prof.record("host", time.perf_counter() - th)
+            prof.end_step()
         return score, new_rnn
 
     def fit_scan(self, features, labels, *, steps_per_call: Optional[int] = None) -> float:
@@ -254,8 +283,10 @@ class Solver:
 
         # Fast path: no listeners, no masks, standard backprop -> stack uniform
         # batches and run the whole epoch as one compiled scan (one dispatch).
+        # A step profiler needs per-step boundaries, so it opts out.
         if (
             not sync_every_iter
+            and self.profiler is None
             and mask is None
             and label_mask is None
             and model.conf.backprop_type is not BackpropType.TRUNCATED_BPTT

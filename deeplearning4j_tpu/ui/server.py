@@ -23,6 +23,7 @@ import numpy as np
 from ..obs.metrics import MetricsRegistry, get_registry
 from ..obs.prom import CONTENT_TYPE as _PROM_CONTENT_TYPE
 from ..obs.prom import render_prometheus
+from ..obs.tracing import Tracer, get_tracer
 from .stats import StatsStorage
 
 _PAGE = """<!DOCTYPE html>
@@ -103,7 +104,8 @@ class UIServer:
     _instance: Optional["UIServer"] = None
 
     def __init__(self, port: int = 9000, host: str = "127.0.0.1",
-                 registry: Optional[MetricsRegistry] = None) -> None:
+                 registry: Optional[MetricsRegistry] = None,
+                 tracer: Optional[Tracer] = None) -> None:
         # loopback by default: the dashboard has no auth; pass
         # host="0.0.0.0" explicitly to expose it beyond the machine
         self.port = port
@@ -113,6 +115,8 @@ class UIServer:
         # time, so the training dashboard process is scrapeable alongside
         # any serving endpoints it hosts
         self.registry = registry
+        # /v1/traces source; None = the process-global tracer's store
+        self.tracer = tracer
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -160,6 +164,31 @@ class UIServer:
                            for i, r in enumerate(records)],
         }
 
+    def traces_payload(self, query: str = "") -> Dict[str, Any]:
+        """``GET /v1/traces`` — same query surface as ``JsonModelServer``
+        (``min_ms``, ``route``, ``limit``), so the training process's
+        deploy/step traces are browsable next to its metrics."""
+        q = parse_qs(query or "")
+
+        def first(key, cast, default=None):
+            vals = q.get(key)
+            if not vals:
+                return default
+            try:
+                return cast(vals[0])
+            except (TypeError, ValueError):
+                return default
+
+        tracer = self.tracer if self.tracer is not None else get_tracer()
+        return {
+            "enabled": tracer.enabled,
+            "trace_count": len(tracer.store),
+            "traces": tracer.store.traces(
+                min_duration_ms=first("min_ms", float),
+                route=first("route", str),
+                limit=first("limit", int, 50)),
+        }
+
     # ---- server lifecycle -------------------------------------------------
     def start(self, block: bool = False) -> "UIServer":
         ui = self
@@ -192,6 +221,10 @@ class UIServer:
                         else get_registry()
                     self._send(render_prometheus(reg).encode(),
                                _PROM_CONTENT_TYPE)
+                elif url.path == "/v1/traces":
+                    self._send(json.dumps(
+                        ui.traces_payload(url.query)).encode(),
+                        "application/json")
                 else:
                     self.send_response(404)
                     self.end_headers()

@@ -1,0 +1,19 @@
+"""Tier-1 wiring for tools/check_trace_contract.py: the end-to-end trace
+propagation contract (README.md "Tracing" — one trace id client -> server
+-> engine over real HTTP, correct nesting, monotonic timestamps, bounded
+store, byte-identical off behavior) is enforced on every test run,
+mirroring test_serving_contract.py / test_metrics_contract.py."""
+
+import os
+import sys
+
+_TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+
+
+def test_trace_contract_smoke():
+    sys.path.insert(0, _TOOLS)
+    try:
+        import check_trace_contract
+    finally:
+        sys.path.remove(_TOOLS)
+    assert check_trace_contract.main(log=lambda m: None) == 0
