@@ -1,6 +1,10 @@
 from .dataset import DataSet, MultiDataSet
 from .fetchers import (Cifar10DataSetIterator, EmnistDataSetIterator,
                        SvhnDataSetIterator, TinyImageNetDataSetIterator)
+from .iterators import (AsyncDataSetIterator, DataSetIterator,
+                        ListDataSetIterator, MappedDataSetIterator,
+                        MultipleEpochsIterator, device_put_dataset)
+from .sharded import ShardedDataSetIterator, shard_paths
 from .image_transform import (
     BrightnessTransform,
     CropImageTransform,
@@ -19,6 +23,7 @@ from .records import (
     LineRecordReader,
     RecordReader,
     RecordReaderDataSetIterator,
+    resolve_data_workers,
 )
 from .transform import (
     Schema,
@@ -27,7 +32,16 @@ from .transform import (
 )
 
 __all__ = [
+    "AsyncDataSetIterator",
     "BrightnessTransform",
+    "DataSetIterator",
+    "ListDataSetIterator",
+    "MappedDataSetIterator",
+    "MultipleEpochsIterator",
+    "ShardedDataSetIterator",
+    "device_put_dataset",
+    "resolve_data_workers",
+    "shard_paths",
     "Cifar10DataSetIterator",
     "CollectionRecordReader",
     "CSVRecordReader",

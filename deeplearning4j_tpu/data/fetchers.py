@@ -89,7 +89,8 @@ class EmnistDataSetIterator(ListDataSetIterator):
 
     def __init__(self, split: str, batch: int, train: bool = True,
                  seed: int = 123, num_examples: Optional[int] = None,
-                 shuffle: bool = True) -> None:
+                 shuffle: bool = True,
+                 shard: Optional[Tuple[int, int]] = None) -> None:
         if split not in EMNIST_SPLITS:
             raise ValueError(
                 f"unknown EMNIST split {split!r}; one of {sorted(EMNIST_SPLITS)}")
@@ -107,9 +108,23 @@ class EmnistDataSetIterator(ListDataSetIterator):
             self.provenance = EMNIST_PROVENANCE
         if num_examples is not None:
             x, y = x[:num_examples], y[:num_examples]
+        x, y = _apply_shard(x, y, shard)
         labels = np.eye(k, dtype=np.float32)[y]
         self.num_classes = k
         super().__init__(DataSet(x, labels), batch, shuffle=shuffle, seed=seed)
+
+
+def _apply_shard(x, y, shard: Optional[Tuple[int, int]]):
+    """Per-host rows for multi-process training (sharded loading,
+    data/sharded.py): host ``i`` of ``count`` keeps every count-th
+    example — sizes within 1, every example on exactly one host, and
+    ``(0, 1)`` is the identity."""
+    if shard is None:
+        return x, y
+    index, count = shard
+    if count < 1 or not 0 <= index < count:
+        raise ValueError(f"invalid shard {shard!r}; want (index, count)")
+    return x[index::count], y[index::count]
 SVHN_PROVENANCE = "procedural-svhn-v1 (synthetic; no-network environment)"
 TINYIMAGENET_PROVENANCE = \
     "procedural-tinyimagenet-v1 (synthetic; no-network environment)"
@@ -145,7 +160,8 @@ class _ProceduralImageIterator(ListDataSetIterator):
                  provenance: str, default_train: int, default_eval: int,
                  batch: int, train: bool, seed: int,
                  num_examples: Optional[int], shuffle: bool,
-                 make_example=None) -> None:
+                 make_example=None,
+                 shard: Optional[Tuple[int, int]] = None) -> None:
         real = _load_npz(f"~/.dl4j_tpu/{npz_name}", None, train)
         if real is not None:
             x, y = real
@@ -162,6 +178,7 @@ class _ProceduralImageIterator(ListDataSetIterator):
             self.provenance = provenance
         if num_examples is not None:
             x, y = x[:num_examples], y[:num_examples]
+        x, y = _apply_shard(x, y, shard)
         labels = np.eye(num_classes, dtype=np.float32)[y]
         super().__init__(DataSet(x, labels), batch, shuffle=shuffle,
                          seed=seed)
@@ -175,10 +192,11 @@ class Cifar10DataSetIterator(_ProceduralImageIterator):
 
     def __init__(self, batch: int, train: bool = True, seed: int = 123,
                  num_examples: Optional[int] = None,
-                 shuffle: bool = True) -> None:
+                 shuffle: bool = True,
+                 shard: Optional[Tuple[int, int]] = None) -> None:
         super().__init__("cifar10.npz", 10, 32, CIFAR_PROVENANCE, 8192, 1024,
                          batch, train, seed, num_examples, shuffle,
-                         make_example=_cifar_example)
+                         make_example=_cifar_example, shard=shard)
 
 
 class SvhnDataSetIterator(_ProceduralImageIterator):
@@ -190,9 +208,11 @@ class SvhnDataSetIterator(_ProceduralImageIterator):
 
     def __init__(self, batch: int, train: bool = True, seed: int = 123,
                  num_examples: Optional[int] = None,
-                 shuffle: bool = True) -> None:
+                 shuffle: bool = True,
+                 shard: Optional[Tuple[int, int]] = None) -> None:
         super().__init__("svhn.npz", 10, 32, SVHN_PROVENANCE, 8192, 1024,
-                         batch, train, seed, num_examples, shuffle)
+                         batch, train, seed, num_examples, shuffle,
+                         shard=shard)
 
 
 class TinyImageNetDataSetIterator(_ProceduralImageIterator):
@@ -204,7 +224,9 @@ class TinyImageNetDataSetIterator(_ProceduralImageIterator):
 
     def __init__(self, batch: int, train: bool = True, seed: int = 123,
                  num_examples: Optional[int] = None,
-                 shuffle: bool = True) -> None:
+                 shuffle: bool = True,
+                 shard: Optional[Tuple[int, int]] = None) -> None:
         super().__init__("tinyimagenet.npz", 200, 64,
                          TINYIMAGENET_PROVENANCE, 4096, 512,
-                         batch, train, seed, num_examples, shuffle)
+                         batch, train, seed, num_examples, shuffle,
+                         shard=shard)

@@ -102,7 +102,19 @@ class AsyncDataSetIterator(DataSetIterator):
 
     Shutdown: a consumer abandoning iteration mid-epoch calls
     :meth:`close` (``reset`` does it implicitly) which stops and JOINS the
-    prefetch thread instead of leaking it behind a full queue.
+    prefetch thread instead of leaking it behind a full queue. ``close``
+    is idempotent and safe to call concurrently (including while the
+    producer is parked on a full queue).
+
+    Device buffer ring: with ``device_put_fn`` set, each batch's H2D
+    transfer is dispatched on the prefetch thread AT ENQUEUE TIME (JAX
+    transfers are async, so the copy for step N+1 overlaps compute for
+    step N — true double buffering). ``device_buffers=N`` bounds the
+    ring: at most N batches may be resident/in-flight in device memory
+    beyond the one the consumer holds, independent of the (host-side)
+    ``queue_size`` — deep host prefetch without unbounded HBM. A slot is
+    acquired before the transfer starts and released when the consumer
+    dequeues the batch.
     """
 
     _SENTINEL = object()
@@ -114,10 +126,15 @@ class AsyncDataSetIterator(DataSetIterator):
         device_put_fn: Optional[Callable[[DataSet], DataSet]] = None,
         registry: Optional[MetricsRegistry] = None,
         name: Optional[str] = None,
+        device_buffers: Optional[int] = None,
     ) -> None:
+        if device_buffers is not None and device_buffers < 1:
+            raise ValueError(
+                f"device_buffers must be >= 1, got {device_buffers}")
         self.underlying = underlying
         self.queue_size = queue_size
         self.device_put_fn = device_put_fn
+        self.device_buffers = device_buffers
         self.name = name or f"prefetch-{next(_prefetch_seq)}"
         self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
         self._thread: Optional[threading.Thread] = None
@@ -125,6 +142,9 @@ class AsyncDataSetIterator(DataSetIterator):
         self._next_item = None
         self._started = False
         self._stop = threading.Event()
+        self._close_lock = threading.Lock()
+        self._hits = 0  # dequeues served without waiting
+        self._dev_slots = self._make_ring()
         reg = registry if registry is not None else get_registry()
         self.registry = reg
         inst = self.name
@@ -159,6 +179,20 @@ class AsyncDataSetIterator(DataSetIterator):
             "Consumer-visible wait per dequeue (0 when a batch was "
             "already prefetched)", ("instance",)).labels(inst)
 
+    def _make_ring(self) -> Optional[threading.Semaphore]:
+        if self.device_buffers is None or self.device_put_fn is None:
+            return None
+        return threading.Semaphore(self.device_buffers)
+
+    def _acquire_slot(self, stop: threading.Event) -> bool:
+        """Take a device-ring slot; gives up when ``stop`` is set so an
+        abandoned consumer never parks the thread on a full ring."""
+        sem = self._dev_slots
+        while not stop.is_set():
+            if sem.acquire(timeout=0.05):
+                return True
+        return False
+
     def _put(self, item, stop: threading.Event) -> bool:
         """Bounded put that gives up when ``stop`` is set (an abandoned
         consumer never drains the queue, so a plain put() would park the
@@ -186,6 +220,11 @@ class AsyncDataSetIterator(DataSetIterator):
             while not stop.is_set() and self.underlying.has_next():
                 item = self.underlying.next()
                 if self.device_put_fn is not None:
+                    if (self._dev_slots is not None
+                            and not self._acquire_slot(stop)):
+                        return
+                    # async dispatch: the H2D copy starts NOW, on this
+                    # thread, and overlaps the consumer's compute
                     item = self.device_put_fn(item)
                 if not self._put(item, stop):
                     return
@@ -211,6 +250,7 @@ class AsyncDataSetIterator(DataSetIterator):
         q = self._queue
         try:
             item = q.get_nowait()
+            self._hits += 1
             self._h_wait.observe(0.0)
         except queue.Empty:
             t0 = time.perf_counter()
@@ -219,6 +259,8 @@ class AsyncDataSetIterator(DataSetIterator):
             self._c_starved.inc(waited)
             self._h_wait.observe(waited)
         self._g_depth.set(q.qsize())
+        if item is not self._SENTINEL and self._dev_slots is not None:
+            self._dev_slots.release()  # consumer owns the batch now
         if item is self._SENTINEL:
             if self._error is not None:
                 raise self._error
@@ -240,47 +282,58 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def close(self, timeout: float = 5.0) -> None:
         """Stop and join the prefetch thread WITHOUT consuming the rest of
-        the epoch. Safe to call any time; idempotent. The old behavior
-        (drain-to-exhaustion on reset) both leaked the thread behind a
-        full queue and forced the whole underlying epoch to be produced."""
+        the epoch. Safe to call any time, idempotent, and safe to call
+        CONCURRENTLY — including while the producer is parked on a full
+        queue or a full device ring (both park-points poll ``_stop``).
+        The old behavior (drain-to-exhaustion on reset) both leaked the
+        thread behind a full queue and forced the whole underlying epoch
+        to be produced."""
         self._stop.set()
-        t = self._thread
-        if t is not None:
-            deadline = time.monotonic() + timeout
-            while t.is_alive() and time.monotonic() < deadline:
-                try:
-                    self._queue.get_nowait()  # unblock a parked put
-                except queue.Empty:
-                    pass
-                t.join(timeout=0.05)
-        self._thread = None
-        self._started = False
-        self._next_item = None
-        self._g_depth.set(0)
+        with self._close_lock:
+            t = self._thread
+            if t is not None:
+                deadline = time.monotonic() + timeout
+                while t.is_alive() and time.monotonic() < deadline:
+                    try:
+                        self._queue.get_nowait()  # unblock a parked put
+                    except queue.Empty:
+                        pass
+                    t.join(timeout=0.05)
+            self._thread = None
+            self._started = False
+            self._next_item = None
+            self._g_depth.set(0)
 
     def reset(self) -> None:
         self.close()
-        self.underlying.reset()
-        self._queue = queue.Queue(maxsize=self.queue_size)
-        self._stop = threading.Event()
-        self._error = None
-        self._started = False
-        self._next_item = None
+        with self._close_lock:
+            self.underlying.reset()
+            self._queue = queue.Queue(maxsize=self.queue_size)
+            self._stop = threading.Event()
+            self._error = None
+            self._started = False
+            self._next_item = None
+            self._dev_slots = self._make_ring()
 
     def stats(self) -> dict:
         """Per-instance view over the registry children (one source of
-        truth; see README "Observability")."""
-        waits = self._h_wait.count
+        truth; see README "Observability"). All derived ratios are
+        guarded against the zero-fetch case (stats() before any next())."""
+        waits = int(self._h_wait.count)
         return {
             "queue_depth": self._queue.qsize(),
             "queue_capacity": self.queue_size,
             "queue_high_water": int(self._g_hwm.value),
+            "device_buffers": self.device_buffers,
             "batches": int(self._c_batches.value),
             "producer_blocked_s": float(self._c_blocked.value),
             "consumer_starvation_s": float(self._c_starved.value),
-            "fetches": int(waits),
+            "fetches": waits,
             "mean_fetch_wait_s": (float(self._h_wait.sum) / waits
-                                  if waits else 0.0),
+                                  if waits > 0 else 0.0),
+            # share of dequeues served without blocking: 1.0 means the
+            # prefetcher fully hid the input pipeline
+            "prefetch_hit_rate": (self._hits / waits if waits > 0 else None),
         }
 
     def batch_size(self) -> int:

@@ -25,6 +25,24 @@ from .dataset import DataSet
 Writable = Union[float, int, str, np.ndarray]
 Record = List[Writable]
 
+DATA_WORKERS_ENV = "DL4J_TPU_DATA_WORKERS"
+
+
+def resolve_data_workers(requested: Optional[int] = None) -> int:
+    """Decode/augment worker-pool sizing. An explicit ``requested`` wins;
+    otherwise the ``DL4J_TPU_DATA_WORKERS`` env var (the operator knob
+    for the host input tier); otherwise 1. Always >= 1."""
+    if requested is not None:
+        return max(1, int(requested))
+    env = os.environ.get(DATA_WORKERS_ENV)
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            raise ValueError(
+                f"{DATA_WORKERS_ENV}={env!r} is not an integer") from None
+    return 1
+
 
 class RecordReader:
     """SPI: restartable stream of records."""
@@ -162,7 +180,8 @@ class ImageRecordReader(RecordReader):
                  label_from_path: bool = True,
                  transform=None, seed: int = 0,
                  output_dtype: str = "float32",
-                 workers: int = 1) -> None:
+                 workers: Optional[int] = None,
+                 shuffle: bool = False) -> None:
         """``output_dtype="uint8"`` is the TPU-native fast path: pixels stay
         uint8 on host end to end (decode header parse + crop/flip as numpy
         VIEWS, one small contiguous copy), transfer to HBM at 1 byte/px,
@@ -175,7 +194,15 @@ class ImageRecordReader(RecordReader):
 
         ``workers > 1`` decodes+augments on a thread pool (the netpbm/PIL
         decode and the resize release the GIL), preserving record order —
-        the reference's multi-threaded NativeImageLoader ingestion."""
+        the reference's multi-threaded NativeImageLoader ingestion. The
+        default (``workers=None``) resolves through the
+        ``DL4J_TPU_DATA_WORKERS`` env var (:func:`resolve_data_workers`),
+        so deployments size the host decode tier without code changes;
+        record order is identical for every worker count.
+
+        ``shuffle=True`` permutes the path list ONCE at construction with
+        ``seed`` — a deterministic epoch order that is independent of
+        both ``workers`` and any prefetch depth stacked on top."""
         if (root is None) == (paths is None):
             raise ValueError("provide exactly one of root= or paths=")
         if output_dtype not in ("float32", "uint8"):
@@ -184,7 +211,7 @@ class ImageRecordReader(RecordReader):
         self.label_from_path = label_from_path
         self.transform = transform
         self.output_dtype = output_dtype
-        self.workers = int(workers)
+        self.workers = resolve_data_workers(workers)
         self._rng = np.random.RandomState(seed)
         # resolved once: PIL availability can't change mid-scan, and the
         # walk below tests this per file at ImageNet scale
@@ -199,6 +226,9 @@ class ImageRecordReader(RecordReader):
             self.paths = found
         else:
             self.paths = list(paths)  # type: ignore[arg-type]
+        if shuffle:
+            order = np.random.default_rng(seed).permutation(len(self.paths))
+            self.paths = [self.paths[i] for i in order]
         self._labels = sorted({os.path.basename(os.path.dirname(p))
                                for p in self.paths}) if label_from_path else []
         label_idx = {n: i for i, n in enumerate(self._labels)}
@@ -310,8 +340,13 @@ class ImageRecordReader(RecordReader):
         if self.workers > 1:
             yield from self._iter_parallel()
             return
+        # same per-image rng derivation as the worker pool, so the
+        # augmented stream is bit-identical for EVERY worker count (the
+        # loader-determinism contract; see tests/test_sharded_loader.py)
+        seeds = self._rng.randint(0, 2**31 - 1, size=len(self.paths))
         for i, p in enumerate(self.paths):
-            rec: Record = [self._load(p)]
+            rec: Record = [self._load(
+                p, rng=np.random.RandomState(seeds[i]))]
             if self.label_from_path:
                 rec.append(self._path_labels[i])
             yield rec

@@ -1,0 +1,174 @@
+"""Sharded per-host data loading — the MLPerf TPU-pod input design.
+
+Reference: "Scale MLPerf-0.6 models on Google TPU-v3 Pods" (PAPERS.md):
+at pod scale every host reads, decodes, and feeds ONLY its own mesh
+shard; no host ever materializes (or transfers) another host's rows.
+The from-files path here splits into two pieces:
+
+* :func:`shard_paths` — deterministic file partition by
+  ``(process_index, process_count)``: every file lands in exactly one
+  host shard, shard sizes differ by at most one, and a 1-host run is the
+  identity (so sharded loading is bit-exact against the unsharded
+  loader).
+* :class:`ShardedDataSetIterator` — wraps a per-host iterator (its
+  batches are this host's LOCAL rows) and assembles each batch into a
+  GLOBAL ``jax.Array`` against a batch-dim :class:`~jax.sharding.
+  Sharding`: one ``device_put`` per addressable shard (transfers start
+  immediately and overlap each other) stitched with
+  ``jax.make_array_from_single_device_arrays``. The result feeds
+  :class:`~deeplearning4j_tpu.parallel.trainer.DistributedTrainer`
+  directly — the trainer recognizes pre-sharded arrays and skips its own
+  full-batch ``device_put`` (previously every host staged the whole
+  global batch through one device transfer).
+
+Composes with :class:`~.iterators.AsyncDataSetIterator` (assembly on the
+prefetch thread → H2D for step N+1 overlaps compute for step N) and with
+:meth:`~deeplearning4j_tpu.obs.step_profiler.StepProfiler.wrap_iterator`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, TypeVar
+
+import numpy as np
+
+from .dataset import DataSet
+from .iterators import DataSetIterator
+
+T = TypeVar("T")
+
+
+def shard_paths(paths: Sequence[T], index: int, count: int) -> List[T]:
+    """Deterministic per-host partition of a file list.
+
+    Round-robin by position: host ``i`` of ``count`` takes
+    ``paths[i::count]``. Properties (enforced by tier-1):
+
+    * every path appears in exactly one shard,
+    * shard sizes differ by at most 1,
+    * ``count=1`` returns the list unchanged (bit-exact single-host run).
+
+    Callers must pass the SAME ``paths`` order on every host (e.g. the
+    sorted walk of :class:`~.records.ImageRecordReader`) — the partition
+    is positional, so order skew would double-read some files and drop
+    others.
+    """
+    if count < 1:
+        raise ValueError(f"count must be >= 1, got {count}")
+    if not 0 <= index < count:
+        raise ValueError(f"index must be in [0, {count}), got {index}")
+    return list(paths[index::count])
+
+
+class ShardedDataSetIterator(DataSetIterator):
+    """Per-host batches → globally-sharded device batches.
+
+    ``underlying`` yields this host's LOCAL rows of each global batch
+    (typically a :class:`~.records.RecordReaderDataSetIterator` over an
+    :class:`~.records.ImageRecordReader` built from
+    ``shard_paths(all_paths, process_index, process_count)``).
+    ``sharding`` is the batch-dim sharding the training step consumes —
+    pass :attr:`DistributedTrainer.data_sharding`. Each ``next()``:
+
+    1. optionally applies ``feature_fn``/``label_fn`` on host (dtype
+       prep — the assembled array feeds the jitted step as-is),
+    2. slices the local batch into this process's addressable shards and
+       ``device_put``\\ s each slice to its owning device (transfers are
+       async and start here, NOT at first use),
+    3. stitches the global array with
+       ``jax.make_array_from_single_device_arrays``.
+
+    The local batch size must equal the rows this process owns under
+    ``sharding`` (global batch = local batch × ``process_count``).
+    """
+
+    def __init__(self, underlying: DataSetIterator, sharding, *,
+                 process_count: Optional[int] = None,
+                 feature_fn: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+                 label_fn: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+                 ) -> None:
+        import jax
+
+        self.underlying = underlying
+        self.sharding = sharding
+        self.process_count = (int(process_count) if process_count is not None
+                              else jax.process_count())
+        if self.process_count < 1:
+            raise ValueError("process_count must be >= 1")
+        self.feature_fn = feature_fn
+        self.label_fn = label_fn
+
+    # ----- assembly ---------------------------------------------------
+    def _assemble(self, arr: np.ndarray):
+        """Local [rows, ...] host array → global jax.Array under
+        ``self.sharding`` via one device_put per addressable shard."""
+        import jax
+
+        arr = np.asarray(arr)
+        local_rows = arr.shape[0]
+        global_shape = (local_rows * self.process_count,) + arr.shape[1:]
+        idx_map = self.sharding.addressable_devices_indices_map(global_shape)
+        spans = []
+        for dev, idx in idx_map.items():
+            sl = idx[0] if idx else slice(None)
+            start = 0 if sl.start is None else int(sl.start)
+            stop = global_shape[0] if sl.stop is None else int(sl.stop)
+            spans.append((start, stop, dev))
+        offset = min(s for s, _, _ in spans)
+        owned = {(s, e) for s, e, _ in spans}  # devices may replicate a span
+        owned_rows = sum(e - s for s, e in owned)
+        if owned_rows != local_rows or any(
+                s - offset < 0 or e - offset > local_rows for s, e in owned):
+            n_shards = len(owned)
+            raise ValueError(
+                f"local batch of {local_rows} rows does not cover this "
+                f"process's {owned_rows} rows under the sharding "
+                f"({n_shards} local shard(s), process_count="
+                f"{self.process_count}); local batch must be "
+                f"global_batch / process_count and divide the data axis")
+        shards = [jax.device_put(arr[s - offset:e - offset], dev)
+                  for s, e, dev in spans]
+        return jax.make_array_from_single_device_arrays(
+            global_shape, self.sharding, shards)
+
+    def _assemble_ds(self, ds: DataSet) -> DataSet:
+        feats = np.asarray(ds.features)
+        labels = np.asarray(ds.labels)
+        if self.feature_fn is not None:
+            feats = np.asarray(self.feature_fn(feats))
+        if self.label_fn is not None:
+            labels = np.asarray(self.label_fn(labels))
+        return DataSet(
+            self._assemble(feats),
+            self._assemble(labels),
+            None if ds.features_mask is None
+            else self._assemble(np.asarray(ds.features_mask)),
+            None if ds.labels_mask is None
+            else self._assemble(np.asarray(ds.labels_mask)),
+        )
+
+    # ----- DataSetIterator protocol -----------------------------------
+    def has_next(self) -> bool:
+        return self.underlying.has_next()
+
+    def next(self) -> DataSet:
+        return self._assemble_ds(self.underlying.next())
+
+    def reset(self) -> None:
+        self.underlying.reset()
+
+    def batch_size(self) -> int:
+        """GLOBAL batch size (what the training step sees)."""
+        return self.underlying.batch_size() * self.process_count
+
+    def local_batch_size(self) -> int:
+        return self.underlying.batch_size()
+
+    def stats(self) -> dict:
+        s = getattr(self.underlying, "stats", None)
+        return s() if callable(s) else {}
+
+    def close(self, *a, **kw) -> None:
+        c = getattr(self.underlying, "close", None)
+        if callable(c):
+            c(*a, **kw)

@@ -97,10 +97,15 @@ class StepProfiler:
         self._hist = {p: hist.labels(self.name, p) for p in PHASES}
         self._totals: Dict[str, float] = {p: 0.0 for p in PHASES}
         self._counts: Dict[str, int] = {p: 0 for p in PHASES}
-        # device phases measured under a fence, tracked separately so the
-        # extrapolation never mixes dispatch-only and fenced samples
-        self._sampled_totals = {"h2d": 0.0, "compute": 0.0}
-        self._sampled_counts = {"h2d": 0, "compute": 0}
+        # phases measured on fenced steps, tracked separately so the
+        # extrapolation never mixes dispatch-only and fenced samples.
+        # "host" is here too: on unfenced steps the device is still
+        # executing in the background, and on a host whose cores the
+        # device computation shares (CPU backend, busy TPU hosts) the
+        # post-dispatch bookkeeping's WALL time absorbs device time —
+        # only the post-fence (idle-device) samples are honest.
+        self._sampled_totals = {"h2d": 0.0, "compute": 0.0, "host": 0.0}
+        self._sampled_counts = {"h2d": 0, "compute": 0, "host": 0}
         self.steps = 0
         self.sampled_steps = 0
         self._step_open = False
@@ -168,6 +173,9 @@ class StepProfiler:
         total_ms = sum(per_step_ms.values())
         share = {p: (v / total_ms if total_ms > 0 else 0.0)
                  for p, v in per_step_ms.items()}
+        # the step time were the input pipeline free (data already in
+        # HBM): what the bench reports as *_excl_transfer_wall
+        excl_input_ms = per_step_ms["compute"] + per_step_ms["host"]
         return {
             "steps": self.steps,
             "sampled_steps": self.sampled_steps,
@@ -176,9 +184,20 @@ class StepProfiler:
             "per_step_ms": {p: round(v, 4) for p, v in per_step_ms.items()},
             "share": {p: round(v, 4) for p, v in share.items()},
             "step_time_ms_est": round(total_ms, 4),
+            "step_time_ms_excl_input": round(excl_input_ms, 4),
             "input_bound_share": round(
                 share["data_wait"] + share["h2d"], 4),
         }
+
+    def samples_per_sec_excl_input(self, batch_size: int) -> Optional[float]:
+        """Projected throughput with the input pipeline (data_wait + h2d)
+        taken out of the step — the bench's
+        ``samples_per_sec_excl_transfer_wall``. None until a step with
+        nonzero compute/host time has been recorded."""
+        excl_ms = self.stats()["step_time_ms_excl_input"]
+        if excl_ms <= 0:
+            return None
+        return batch_size / (excl_ms / 1e3)
 
 
 class _ProfiledIterator:

@@ -77,8 +77,14 @@ class DistributedTrainer:
         strategy: Optional[GradientSyncStrategy] = None,
         param_sharding_rules: Optional[Sequence[Tuple[str, P]]] = None,
         data_axis: str = "data",
+        donate_inputs: bool = False,
     ) -> None:
         self.model = model
+        # donate the batch buffers to the jitted step (sharded-loader
+        # path: every batch is a fresh per-shard device_put, so XLA can
+        # reuse the input HBM across steps). Callers re-feeding the same
+        # device array each step must leave this off (see Solver).
+        self.donate_inputs = bool(donate_inputs)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.strategy = strategy or SyncAllReduce()
         self.data_axis = data_axis
@@ -191,7 +197,8 @@ class DistributedTrainer:
                     self._param_shardings(), self._replicated, self._replicated,
                     self._replicated, self._replicated,
                 ),
-                donate_argnums=(0, 1, 2, 3),
+                donate_argnums=(0, 1, 2, 3) + (
+                    (4, 5) if self.donate_inputs else ()),
             )
 
         # Explicit path: per-replica grads -> strategy.sync collective.
@@ -222,12 +229,30 @@ class DistributedTrainer:
             in_specs=(rep, rep, rep, rep, data, data, rep, rep),
             out_specs=(rep, rep, rep, rep, rep),
         )
-        return jax.jit(mapped, donate_argnums=(0, 1, 2, 3))
+        return jax.jit(mapped, donate_argnums=(0, 1, 2, 3) + (
+            (4, 5) if self.donate_inputs else ()))
 
     # ----- public API -------------------------------------------------
     @property
     def n_data_shards(self) -> int:
         return self.mesh.shape[self.data_axis]
+
+    @property
+    def data_sharding(self) -> NamedSharding:
+        """The batch-dim sharding the jitted step consumes — hand this to
+        :class:`~deeplearning4j_tpu.data.sharded.ShardedDataSetIterator`
+        so the input tier assembles batches directly against it (per-host
+        loading; no full-batch staging through one device)."""
+        return self._data_sharding
+
+    def _is_presharded(self, a) -> bool:
+        """True for a global jax.Array already laid out on this trainer's
+        data sharding (a ShardedDataSetIterator batch): host prep and
+        device_put are both skipped — the rows are already in HBM on
+        their owning shards."""
+        return (isinstance(a, jax.Array)
+                and getattr(a, "sharding", None) is not None
+                and a.sharding.is_equivalent_to(self._data_sharding, a.ndim))
 
     @property
     def _is_graph(self) -> bool:
@@ -241,28 +266,41 @@ class DistributedTrainer:
 
     def _prep_inputs(self, x, y):
         """Host-side dtype handling for both model families: returns
-        (x, y) as a single array each (Sequential) or tuples (Graph)."""
+        (x, y) as a single array each (Sequential) or tuples (Graph).
+        Pre-sharded global arrays pass through untouched (their dtype
+        prep happened host-side in the sharded loader, per shard)."""
         model = self.model
         if self._is_graph:
             xs = (x,) if not isinstance(x, (list, tuple)) else tuple(x)
             ys = (y,) if not isinstance(y, (list, tuple)) else tuple(y)
             names = model.conf.network_inputs
             xs = tuple(
+                xi if self._is_presharded(xi) else
                 as_input_np(xi, model.dtype,
                             model.keeps_int_input(names[i])
                             if i < len(names) else False)
                 for i, xi in enumerate(xs))
-            return xs, tuple(np.asarray(yi) for yi in ys)
+            return xs, tuple(
+                yi if self._is_presharded(yi) else np.asarray(yi)
+                for yi in ys)
+        if self._is_presharded(x):
+            return x, (y if self._is_presharded(y) else np.asarray(y))
         return as_input_np(x, model.dtype, self._keeps_int_input()), \
             np.asarray(y)
 
     def _put_data(self, tree):
-        """Shard a data array or tuple of arrays over the data axis."""
-        if self._multiprocess:
-            return jax.tree_util.tree_map(
-                lambda a: jax.make_array_from_process_local_data(
-                    self._data_sharding, a), tree)
-        return jax.device_put(tree, self._data_sharding)
+        """Shard a data array or tuple of arrays over the data axis.
+        Leaves already assembled against the data sharding (per-shard
+        device_put in the input tier) are NOT re-transferred."""
+        def put_one(a):
+            if self._is_presharded(a):
+                return a
+            if self._multiprocess:
+                return jax.make_array_from_process_local_data(
+                    self._data_sharding, a)
+            return jax.device_put(a, self._data_sharding)
+
+        return jax.tree_util.tree_map(put_one, tree)
 
     def fit_batch(self, x, y) -> float:
         if self._step is None:
@@ -274,7 +312,13 @@ class DistributedTrainer:
         x, y = self._prep_inputs(x, y)
         first = x[0] if isinstance(x, tuple) else x
         n = self.n_data_shards
-        if self._multiprocess:
+        if self._is_presharded(first):
+            # already a GLOBAL array assembled by the sharded input tier
+            if first.shape[0] % n:
+                raise ValueError(
+                    f"global batch {first.shape[0]} not divisible by "
+                    f"data axis {n}")
+        elif self._multiprocess:
             # each process feeds its LOCAL rows; the global batch is the
             # concatenation across processes (local_rows * process_count)
             global_rows = first.shape[0] * jax.process_count()
@@ -356,6 +400,30 @@ class DistributedTrainer:
                         f"than the data axis ({n}) could not be sharded and "
                         f"were dropped this epoch (total {self.dropped_rows})"
                     )
+            model.listeners.epoch_end(model)
+            model.epoch_count += 1
+        if last is not None:
+            model.score_value = float(last)
+        self.sync_to_model()
+        return model.score_value
+
+    def fit_iterator(self, iterator, *, epochs: int = 1) -> float:
+        """Train from a ``DataSetIterator`` WITHOUT host-side re-chunking —
+        the sharded input path. Each batch feeds ``fit_batch`` exactly as
+        produced; batches assembled by a
+        :class:`~deeplearning4j_tpu.data.sharded.ShardedDataSetIterator`
+        (global jax.Arrays on :attr:`data_sharding`) skip host prep and
+        ``device_put`` entirely, so per-step H2D happens only on the
+        loader's prefetch thread. Batch sizes must already divide the
+        data axis (the sharded assembly guarantees it)."""
+        model = self.model
+        sync = bool(model.listeners.listeners)
+        last = None
+        for _ in range(epochs):
+            model.listeners.epoch_start(model)
+            for ds in iterator:
+                last = self.fit_batch(ds.features, ds.labels)
+                self._fit_iteration_done(sync, last)
             model.listeners.epoch_end(model)
             model.epoch_count += 1
         if last is not None:

@@ -20,12 +20,17 @@ from .solver import LayerOptimizers, _normalize_gradients
 
 
 class GraphSolver:
-    def __init__(self, model, *, optimize=None, profiler=None) -> None:
+    def __init__(self, model, *, optimize=None, profiler=None,
+                 donate_inputs: bool = False) -> None:
         """``optimize=`` applies training-safe graph rewrite passes at
         step-build time (see Solver.__init__ / nn/rewrite). ``profiler=``
         attaches a :class:`~deeplearning4j_tpu.obs.step_profiler.
-        StepProfiler` for per-phase step attribution (see Solver)."""
+        StepProfiler` for per-phase step attribution (see Solver).
+        ``donate_inputs=True`` donates the batch buffers (xs/ys) so XLA
+        reuses input HBM across steps — see Solver.__init__ for the
+        freshness contract."""
         self.model = model
+        self.donate_inputs = bool(donate_inputs)
         if hasattr(model, "migrate_state"):
             model.migrate_state()
         self.applied_rewrites = []
@@ -58,7 +63,8 @@ class GraphSolver:
                     return new_params, new_opt, new_state, score, grads
                 return new_params, new_opt, new_state, score
 
-            self._step_cache[key] = jax.jit(step, donate_argnums=(0, 1, 2))
+            donate = (0, 1, 2) + ((3, 4) if self.donate_inputs else ())
+            self._step_cache[key] = jax.jit(step, donate_argnums=donate)
         return self._step_cache[key]
 
     def _scan_fn(self):
@@ -129,7 +135,8 @@ class GraphSolver:
             # after reassignment: pre-step buffers were donated to the step
             model.listeners.gradient_calculation(model, grads)
         if prof is not None:
-            prof.record("host", time.perf_counter() - th)
+            # sampled: post-fence host time is honest (see Solver)
+            prof.record("host", time.perf_counter() - th, sampled=fence)
             prof.end_step()
         return score
 

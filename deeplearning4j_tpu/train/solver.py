@@ -112,7 +112,8 @@ class LayerOptimizers:
 
 
 class Solver:
-    def __init__(self, model, *, optimize=None, profiler=None) -> None:
+    def __init__(self, model, *, optimize=None, profiler=None,
+                 donate_inputs: bool = False) -> None:
         """``optimize=`` applies training-safe graph rewrite passes at
         step-build time (``True``/``"training"`` -> the default set:
         space-to-depth stem + BN affine precompute; or an explicit pass
@@ -125,8 +126,20 @@ class Solver:
         ``fit_batch`` attributes its time to h2d / compute / host phases
         (device phases fenced on the profiler's sampling schedule), and
         ``fit`` skips the whole-epoch ``lax.scan`` fast path because one
-        fused dispatch has no per-step structure to attribute."""
+        fused dispatch has no per-step structure to attribute.
+
+        ``donate_inputs=True`` additionally donates the BATCH buffers
+        (x/y) to the jitted step, so XLA reuses the input HBM across
+        steps instead of allocating a fresh batch-sized block every step
+        — the steady-state input footprint becomes the prefetch ring
+        alone. Only safe when every step gets a FRESH batch array (the
+        from-files pipeline: each prefetch ``device_put`` makes a new
+        buffer); callers that re-feed the same device array every step
+        (synthetic micro-benches) must leave it off. Numpy inputs are
+        always safe — jit copies them to device first and donates its own
+        copy."""
         self.model = model
+        self.donate_inputs = bool(donate_inputs)
         if hasattr(model, "migrate_state"):
             model.migrate_state()
         self.applied_rewrites = []
@@ -161,7 +174,10 @@ class Solver:
                 return new_params, new_opt, new_state, new_rnn, score, grads
             return new_params, new_opt, new_state, new_rnn, score
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        donate = (0, 1, 2)
+        if self.donate_inputs:
+            donate += (4, 5)  # x, y (masks excluded: commonly reused)
+        return jax.jit(step, donate_argnums=donate)
 
     def _step_fn(self, has_mask, has_label_mask, stateful, return_grads=False):
         key = (has_mask, has_label_mask, stateful, return_grads)
@@ -215,7 +231,10 @@ class Solver:
             # jitted step, so listeners must see the NEW params
             model.listeners.gradient_calculation(model, grads)
         if prof is not None:
-            prof.record("host", time.perf_counter() - th)
+            # sampled: after the fence the device is idle, so this host
+            # segment's wall time is honest (unfenced steps share the
+            # core with the in-flight device computation)
+            prof.record("host", time.perf_counter() - th, sampled=fence)
             prof.end_step()
         return score, new_rnn
 

@@ -1,0 +1,20 @@
+"""Tier-1 wiring for tools/check_input_pipeline_contract.py: the prefetch
+tier's lifecycle + overlap contract (README.md "Input pipeline" — no leaked
+prefetch/worker threads after close()/reset() in any race, the starvation
+gauge fires when the consumer outruns the producer, and the double buffer
+keeps the data_wait share negligible on a fast-producer run), mirroring
+test_serving_contract.py / test_trace_contract.py."""
+
+import os
+import sys
+
+_TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+
+
+def test_input_pipeline_contract_smoke():
+    sys.path.insert(0, _TOOLS)
+    try:
+        import check_input_pipeline_contract
+    finally:
+        sys.path.remove(_TOOLS)
+    assert check_input_pipeline_contract.main(log=lambda m: None) == 0

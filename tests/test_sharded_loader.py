@@ -1,0 +1,364 @@
+"""Sharded per-host loading + loader determinism (ISSUE 7).
+
+Covers the input-tier contract of data/sharded.py and the worker-pool
+paths of data/records.py:
+
+* shard-partition completeness: every file in exactly one host shard,
+  shard sizes within 1, single-host partition is the identity;
+* loader determinism: identical epoch order and batch contents for
+  worker counts {1, 4} × prefetch depths {1, 4} under a fixed shuffle
+  seed (augmentation included — per-image rng derivation makes the
+  stream independent of worker scheduling);
+* numerical transparency: a 1-host ShardedDataSetIterator reproduces
+  the plain loader's batches bit-exactly;
+* multi-shard assembly: batches assembled over the 8-device CPU mesh
+  equal the host batch, carry the trainer's data sharding, and train
+  to the same score as the unsharded path;
+* donated input buffers are numerically transparent;
+* DL4J_TPU_DATA_WORKERS sizes the decode pool.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import (
+    AsyncDataSetIterator,
+    DataSet,
+    ListDataSetIterator,
+    ShardedDataSetIterator,
+    shard_paths,
+)
+from deeplearning4j_tpu.data.records import (
+    ImageRecordReader,
+    RecordReaderDataSetIterator,
+    resolve_data_workers,
+)
+from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+
+
+def _write_ppm(path, arr):
+    h, w, _ = arr.shape
+    with open(path, "wb") as f:
+        f.write(f"P6 {w} {h} 255\n".encode() + arr.tobytes())
+
+
+def _make_tree(tmp_path, n=32, size=16, classes=4):
+    rng = np.random.RandomState(7)
+    for c in range(classes):
+        os.makedirs(tmp_path / f"c{c}", exist_ok=True)
+    for i in range(n):
+        _write_ppm(str(tmp_path / f"c{i % classes}" / f"{i:03d}.ppm"),
+                   rng.randint(0, 256, (size, size, 3), np.uint8))
+    return str(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# shard_paths
+# ---------------------------------------------------------------------------
+
+class TestShardPaths:
+    def test_completeness_and_balance(self):
+        paths = [f"f{i:04d}" for i in range(103)]
+        for count in (1, 2, 4, 8, 5):
+            shards = [shard_paths(paths, i, count) for i in range(count)]
+            flat = [p for s in shards for p in s]
+            # every file in exactly one shard
+            assert sorted(flat) == sorted(paths)
+            assert len(set(flat)) == len(paths)
+            sizes = [len(s) for s in shards]
+            assert max(sizes) - min(sizes) <= 1, (count, sizes)
+
+    def test_single_host_is_identity(self):
+        paths = [f"f{i}" for i in range(17)]
+        assert shard_paths(paths, 0, 1) == paths
+
+    def test_deterministic(self):
+        paths = [f"f{i}" for i in range(40)]
+        assert shard_paths(paths, 2, 4) == shard_paths(paths, 2, 4)
+
+    def test_invalid_args(self):
+        with pytest.raises(ValueError):
+            shard_paths([], 0, 0)
+        with pytest.raises(ValueError):
+            shard_paths([], 3, 2)
+
+
+# ---------------------------------------------------------------------------
+# loader determinism: workers x prefetch depth x fixed shuffle seed
+# ---------------------------------------------------------------------------
+
+def _collect_epoch(root, *, workers, queue_size, seed=5, batch=8):
+    from deeplearning4j_tpu.data.image_transform import (
+        FlipImageTransform, PipelineImageTransform, RandomCropTransform,
+    )
+
+    aug = PipelineImageTransform(
+        (FlipImageTransform(mode=1), 0.5),
+        RandomCropTransform(height=12, width=12))
+    reader = ImageRecordReader(12, 12, 3, root=root, transform=aug,
+                               seed=seed, shuffle=True, workers=workers)
+    base = RecordReaderDataSetIterator(reader, batch_size=batch,
+                                       label_index=1, num_classes=4)
+    it = AsyncDataSetIterator(base, queue_size=queue_size,
+                              registry=MetricsRegistry())
+    try:
+        return [(np.asarray(ds.features), np.asarray(ds.labels))
+                for ds in it]
+    finally:
+        it.close()
+
+
+def test_epoch_identical_across_workers_and_depths(tmp_path):
+    root = _make_tree(tmp_path)
+    ref = _collect_epoch(root, workers=1, queue_size=1)
+    assert len(ref) == 4  # 32 images / batch 8
+    for workers in (1, 4):
+        for depth in (1, 4):
+            got = _collect_epoch(root, workers=workers, queue_size=depth)
+            assert len(got) == len(ref), (workers, depth)
+            for (fa, la), (fb, lb) in zip(ref, got):
+                np.testing.assert_array_equal(fa, fb)
+                np.testing.assert_array_equal(la, lb)
+
+
+def test_shuffle_seed_changes_order_deterministically(tmp_path):
+    root = _make_tree(tmp_path)
+    a = _collect_epoch(root, workers=1, queue_size=2, seed=5)
+    b = _collect_epoch(root, workers=1, queue_size=2, seed=6)
+    c = _collect_epoch(root, workers=4, queue_size=4, seed=6)
+    assert not all(
+        np.array_equal(fa, fb) for (fa, _), (fb, _) in zip(a, b))
+    for (fb, lb), (fc, lc) in zip(b, c):
+        np.testing.assert_array_equal(fb, fc)
+        np.testing.assert_array_equal(lb, lc)
+
+
+# ---------------------------------------------------------------------------
+# sharded assembly
+# ---------------------------------------------------------------------------
+
+def _data_sharding(n=8):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+    return NamedSharding(make_mesh(data=n), P("data"))
+
+
+def test_one_host_sharded_is_bit_exact(tmp_path):
+    """Sharded loading is numerically transparent: with one host and one
+    device shard, batches equal the plain loader's bit for bit."""
+    import jax
+
+    root = _make_tree(tmp_path)
+    device = jax.devices()[0]
+    from jax.sharding import SingleDeviceSharding
+
+    def make_base():
+        reader = ImageRecordReader(12, 12, 3, root=root, seed=3,
+                                   output_dtype="uint8")
+        return RecordReaderDataSetIterator(reader, batch_size=8,
+                                           label_index=1, num_classes=4)
+
+    plain = [(np.asarray(ds.features), np.asarray(ds.labels))
+             for ds in make_base()]
+    sharded = ShardedDataSetIterator(
+        make_base(), SingleDeviceSharding(device), process_count=1)
+    got = [(np.asarray(ds.features), np.asarray(ds.labels))
+           for ds in sharded]
+    assert len(got) == len(plain) > 0
+    for (fa, la), (fb, lb) in zip(plain, got):
+        np.testing.assert_array_equal(fa, fb)
+        np.testing.assert_array_equal(la, lb)
+
+
+def test_multi_shard_assembly_roundtrip():
+    """Assembly over the 8-device CPU mesh: the global array equals the
+    host batch, is laid out on the target sharding, and each device
+    holds exactly its slice."""
+    import jax
+
+    sh = _data_sharding(8)
+    x = np.arange(16 * 6, dtype=np.float32).reshape(16, 6)
+    y = np.eye(4, dtype=np.float32)[np.arange(16) % 4]
+    it = ShardedDataSetIterator(
+        ListDataSetIterator(DataSet(x, y), 16), sh, process_count=1)
+    ds = it.next()
+    assert isinstance(ds.features, jax.Array)
+    assert ds.features.sharding.is_equivalent_to(sh, ds.features.ndim)
+    np.testing.assert_array_equal(np.asarray(ds.features), x)
+    np.testing.assert_array_equal(np.asarray(ds.labels), y)
+    for s in ds.features.addressable_shards:
+        np.testing.assert_array_equal(np.asarray(s.data), x[s.index])
+
+
+def test_assembly_rejects_wrong_local_rows():
+    sh = _data_sharding(8)
+    x = np.zeros((16, 4), np.float32)
+    y = np.zeros((16, 2), np.float32)
+    it = ShardedDataSetIterator(
+        ListDataSetIterator(DataSet(x, y), 16), sh, process_count=4)
+    with pytest.raises(ValueError, match="local batch"):
+        it.next()
+
+
+def test_feature_fn_preps_dtype(tmp_path):
+    sh = _data_sharding(8)
+    x = (np.arange(16 * 4).reshape(16, 4) % 255).astype(np.uint8)
+    y = np.eye(2, dtype=np.float32)[np.arange(16) % 2]
+    it = ShardedDataSetIterator(
+        ListDataSetIterator(DataSet(x, y), 16), sh,
+        feature_fn=lambda a: a.astype(np.float32) / 255.0)
+    ds = it.next()
+    assert str(ds.features.dtype) == "float32"
+    np.testing.assert_allclose(np.asarray(ds.features),
+                               x.astype(np.float32) / 255.0)
+
+
+# ---------------------------------------------------------------------------
+# trainer integration: sharded batches skip host prep/put; same numbers
+# ---------------------------------------------------------------------------
+
+def test_trainer_fit_iterator_sharded_matches_unsharded():
+    from deeplearning4j_tpu.model.zoo import LeNet
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    from deeplearning4j_tpu.parallel.trainer import DistributedTrainer
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(32, 1, 28, 28).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 32)]
+
+    m1 = LeNet(seed=42).init()
+    t1 = DistributedTrainer(m1, mesh=make_mesh(data=8))
+    s1 = [float(t1.fit_batch(x[:16], y[:16])),
+          float(t1.fit_batch(x[16:], y[16:]))]
+    t1.sync_to_model()
+
+    m2 = LeNet(seed=42).init()
+    t2 = DistributedTrainer(m2, mesh=make_mesh(data=8), donate_inputs=True)
+    it = ShardedDataSetIterator(
+        ListDataSetIterator(DataSet(x, y), 16), t2.data_sharding)
+    assert it.batch_size() == 16
+    t2.fit_iterator(it, epochs=1)
+
+    # same data, same seed -> identical training trajectory
+    assert np.isfinite(s1).all()
+    for (ln, lp), (ln2, lp2) in zip(sorted(m1.params.items()),
+                                    sorted(m2.params.items())):
+        assert ln == ln2
+        for k in lp:
+            np.testing.assert_allclose(np.asarray(lp[k]),
+                                       np.asarray(lp2[k]),
+                                       rtol=1e-6, atol=1e-6)
+
+
+def test_trainer_presharded_detection():
+    import jax
+
+    from deeplearning4j_tpu.model.zoo import LeNet
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    from deeplearning4j_tpu.parallel.trainer import DistributedTrainer
+
+    model = LeNet(seed=1).init()
+    tr = DistributedTrainer(model, mesh=make_mesh(data=8))
+    x = np.zeros((16, 1, 28, 28), np.float32)
+    gx = jax.device_put(x, tr.data_sharding)
+    assert tr._is_presharded(gx)
+    assert not tr._is_presharded(x)
+    assert not tr._is_presharded(jax.device_put(x))  # single-device array
+    # passthrough: _put_data must return the SAME array, not re-transfer
+    assert tr._put_data(gx) is gx
+
+
+# ---------------------------------------------------------------------------
+# donated inputs are numerically transparent
+# ---------------------------------------------------------------------------
+
+def test_solver_donate_inputs_same_scores():
+    from deeplearning4j_tpu.model.zoo import LeNet
+    from deeplearning4j_tpu.train.solver import Solver
+
+    rng = np.random.RandomState(3)
+    x = rng.rand(8, 1, 28, 28).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)]
+
+    def run(donate):
+        model = LeNet(seed=9).init()
+        solver = Solver(model, donate_inputs=donate)
+        return [float(solver.fit_batch(x.copy(), y.copy())[0])
+                for _ in range(3)]
+
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-6)
+
+
+def test_graph_solver_donate_inputs_same_scores():
+    from deeplearning4j_tpu.nn.conf import (
+        Activation, DenseLayer, InputType, NeuralNetConfiguration,
+        OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.train import Adam
+    from deeplearning4j_tpu.train.graph_solver import GraphSolver
+
+    def make_conf():
+        return (
+            NeuralNetConfiguration.builder()
+            .seed(9)
+            .updater(Adam(1e-2))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=16, activation=Activation.TANH),
+                       "in")
+            .add_layer("out", OutputLayer(n_out=4), "d1")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(6))
+            .build()
+        )
+
+    rng = np.random.RandomState(3)
+    x = rng.rand(8, 6).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 8)]
+
+    def run(donate):
+        model = ComputationGraph(make_conf()).init()
+        solver = GraphSolver(model, donate_inputs=donate)
+        return [float(solver.fit_batch((x.copy(),), (y.copy(),)))
+                for _ in range(3)]
+
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# worker-pool sizing
+# ---------------------------------------------------------------------------
+
+class TestDataWorkersEnv:
+    def test_explicit_wins(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_DATA_WORKERS", "7")
+        assert resolve_data_workers(3) == 3
+
+    def test_env_overrides_default(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_DATA_WORKERS", "7")
+        assert resolve_data_workers() == 7
+
+    def test_default_is_one(self, monkeypatch):
+        monkeypatch.delenv("DL4J_TPU_DATA_WORKERS", raising=False)
+        assert resolve_data_workers() == 1
+
+    def test_floor_is_one(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_DATA_WORKERS", "0")
+        assert resolve_data_workers() == 1
+
+    def test_garbage_env_raises(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_DATA_WORKERS", "many")
+        with pytest.raises(ValueError, match="DL4J_TPU_DATA_WORKERS"):
+            resolve_data_workers()
+
+    def test_reader_uses_env(self, tmp_path, monkeypatch):
+        root = _make_tree(tmp_path, n=4)
+        monkeypatch.setenv("DL4J_TPU_DATA_WORKERS", "2")
+        reader = ImageRecordReader(12, 12, 3, root=root)
+        assert reader.workers == 2
