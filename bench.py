@@ -1483,6 +1483,105 @@ def measure_input_pipeline_overlap(n_images: int = 256, raw: int = 128,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def measure_zero1_updater_headroom(nin: int = 256, hidden: int = 1024,
+                                   nout: int = 256, batch_per_shard: int = 8,
+                                   warmup_steps: int = 2, bench_steps: int = 6,
+                                   force_devices: int = 0) -> dict:
+    """ZeRO-1 updater-headroom row (ISSUE 8 acceptance): per-chip
+    optimizer-state bytes with the weight update sharded 1/N over the
+    data axis vs fully replicated, the max-fit model multiplier that
+    headroom buys (params+opt budget: ``(P+O)/(P+O/N)``), fenced
+    step-time for both layouts at the full DP width, and the measured
+    compression ratio of both encoded gradient-exchange strategies
+    (adaptive-threshold and top-k). ``force_devices`` forces N virtual
+    host devices on the CPU fallback (the flag must land before backend
+    init — the measurement child has not touched jax yet)."""
+    if force_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={force_devices}"
+            ).strip()
+
+    import numpy as np
+
+    from deeplearning4j_tpu.nn import (
+        Activation, InputType, LossFunction, MultiLayerNetwork,
+        NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel import (
+        DistributedTrainer, ThresholdCompressedSync, TopKCompressedSync,
+        make_mesh)
+    from deeplearning4j_tpu.train import Adam
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-3))
+                .list()
+                .layer(DenseLayer(n_out=hidden, activation=Activation.TANH))
+                .layer(OutputLayer(n_out=nout, loss=LossFunction.MCXENT))
+                .set_input_type(InputType.feed_forward(nin)).build())
+        return MultiLayerNetwork(conf).init()
+
+    mesh = make_mesh()
+    n = int(mesh.shape["data"])
+    batch = batch_per_shard * n
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, nin).astype(np.float32)
+    y = np.eye(nout, dtype=np.float32)[rng.randint(0, nout, batch)]
+
+    def timed_steps(trainer, k: int) -> float:
+        _host_fence(trainer.params)
+        start = time.perf_counter()
+        for _ in range(k):
+            trainer.fit_batch(x, y)
+        _host_fence(trainer.params)
+        return (time.perf_counter() - start) / k
+
+    t_rep = DistributedTrainer(build(), mesh=mesh)
+    t_z = DistributedTrainer(build(), mesh=mesh, zero1=True)
+    timed_steps(t_rep, warmup_steps)
+    timed_steps(t_z, warmup_steps)
+    step_rep = timed_steps(t_rep, bench_steps)
+    step_z = timed_steps(t_z, bench_steps)
+
+    rep_bytes = t_rep.updater_state_bytes()
+    z_bytes = t_z.updater_state_bytes()
+    params_bytes = sum(
+        int(np.prod(np.shape(p), dtype=np.int64)) * np.dtype(p.dtype).itemsize
+        for lp in t_rep.model.params.values() for p in lp.values())
+    opt_global = t_z.updater_state_bytes(per_replica=False)
+    # per-chip params+opt budget: how much bigger a model fits once the
+    # updater term shards (ZeRO-1's headline number; Adam: O == 2P)
+    max_fit = (params_bytes + opt_global) / (params_bytes + z_bytes)
+
+    def comp_ratio(strategy):
+        t = DistributedTrainer(build(), mesh=mesh, strategy=strategy,
+                               zero1=True, metrics_every=0)
+        for _ in range(4):
+            t.fit_batch(x, y)
+        stats = t.compression_stats() or {}
+        r = stats.get("compression_ratio")
+        return round(r, 2) if r else None
+
+    return {
+        "n_devices": n,
+        "batch": batch,
+        "updater_state_bytes_replicated": int(rep_bytes),
+        "updater_state_bytes_zero1_per_chip": int(z_bytes),
+        "updater_shard_ratio": round(rep_bytes / max(z_bytes, 1), 2),
+        "params_bytes": int(params_bytes),
+        "max_fit_param_multiplier": round(max_fit, 3),
+        "step_ms_replicated": round(step_rep * 1e3, 3),
+        "step_ms_zero1": round(step_z * 1e3, 3),
+        "zero1_step_overhead": round(step_z / max(step_rep, 1e-9), 3),
+        "threshold_compression_ratio": comp_ratio(
+            ThresholdCompressedSync(threshold=1e-3, target_density=0.01)),
+        "topk_compression_ratio": comp_ratio(
+            TopKCompressedSync(density=0.01)),
+    }
+
+
 _MEASUREMENTS = {
     "lenet": measure_lenet,
     "resnet50": measure_resnet50,
@@ -1501,6 +1600,7 @@ _MEASUREMENTS = {
     "rewrite_passes": measure_rewrite_passes,
     "tracing_overhead": measure_tracing_overhead,
     "step_profile": measure_step_profile,
+    "zero1_updater_headroom": measure_zero1_updater_headroom,
 }
 
 
@@ -1597,6 +1697,12 @@ def _child_measure(name: str, platform: str) -> None:
             "step_profile": {"batch": 8, "n_images": 32, "raw": 64,
                              "out": 56, "bench_steps": 4, "synth_steps": 3,
                              "sync_every": 2},
+            # 8 virtual devices so the sharding is real on the 1-core
+            # host; shrink the model so the 8-way jits fit the timeout
+            "zero1_updater_headroom": {"force_devices": 8, "nin": 64,
+                                       "hidden": 256, "nout": 64,
+                                       "batch_per_shard": 4,
+                                       "bench_steps": 4},
         }.get(name, {})
     result = _MEASUREMENTS[name](**kwargs)
     print(json.dumps(result))
@@ -1645,6 +1751,8 @@ def main() -> None:
         "rewrite_passes": _run_measurement("rewrite_passes", platform),
         "tracing_overhead": _run_measurement("tracing_overhead", platform),
         "step_profile": _run_measurement("step_profile", platform),
+        "zero1_updater_headroom": _run_measurement(
+            "zero1_updater_headroom", platform),
     }
     if not fallback:  # chip-only rows
         extras["resnet50_b128"] = _run_measurement("resnet50_b128", platform)

@@ -24,12 +24,14 @@ Reference capabilities covered (SURVEY.md §2.3/§2.4, §3.4/§3.5):
 """
 
 from .sharded_embedding import ShardedEmbeddingTable, shard_rows
-from .mesh import MeshSpec, initialize_distributed, make_mesh
+from .mesh import (MeshSpec, initialize_distributed, make_mesh,
+                   zero1_partition_spec)
 from .strategies import (
     GradientSyncStrategy,
     ParameterAveragingSync,
     SyncAllReduce,
     ThresholdCompressedSync,
+    TopKCompressedSync,
 )
 from .sequence import ring_attention, ulysses_attention
 from .pipeline import (dense_block_stage, pipeline_apply,
@@ -55,7 +57,9 @@ __all__ = [
     "Servable",
     "SyncAllReduce",
     "ThresholdCompressedSync",
+    "TopKCompressedSync",
     "initialize_distributed",
     "make_mesh",
     "moe_expert_parallel_rules",
+    "zero1_partition_spec",
 ]

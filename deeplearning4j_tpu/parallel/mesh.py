@@ -17,7 +17,7 @@ from typing import Dict, Optional, Sequence, Tuple
 import numpy as np
 
 import jax
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec
 
 
 @dataclasses.dataclass(frozen=True)
@@ -131,6 +131,35 @@ def local_batch_slice(global_batch: int, mesh: Mesh, axis: str = "data") -> slic
     shards_per_proc = n // procs
     start = jax.process_index() * shards_per_proc * per
     return slice(start, start + shards_per_proc * per)
+
+
+def zero1_partition_spec(
+    shape: Tuple[int, ...],
+    n_shards: int,
+    axis: str = "data",
+    base: Optional[PartitionSpec] = None,
+) -> PartitionSpec:
+    """Updater-state sharding rule for ZeRO-1 cross-replica weight-update
+    sharding ("Automatic Cross-Replica Sharding of Weight Update in
+    Data-Parallel Training", PAPERS.md): shard dim 0 of a param-shaped
+    updater leaf over the data axis when the axis divides it evenly,
+    composing with an existing (tensor-parallel) ``base`` spec on the
+    remaining dims. Falls back to ``base`` unchanged when
+
+    * the leaf is scalar / zero-sized / dim 0 is not divisible, or
+    * ``base`` already shards dim 0 (row-parallel TP) — never double-shard
+      one dim over two axes here; XLA would need a 2D reshard for no
+      memory win on the dominant leaves.
+    """
+    base = base if base is not None else PartitionSpec()
+    if not shape or not shape[0] or shape[0] % max(n_shards, 1) or n_shards <= 1:
+        return base
+    existing = tuple(base)
+    if existing and existing[0] is not None:
+        return base
+    if existing:
+        return PartitionSpec(axis, *existing[1:])
+    return PartitionSpec(axis)
 
 
 _ENV_FLAG = "DL4J_TPU_FORCE_HOST_DEVICES"

@@ -29,7 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.dtypes import as_input, as_input_np
 from ..train.solver import LayerOptimizers, _normalize_gradients
-from .mesh import make_mesh, shmap
+from .mesh import make_mesh, shmap, zero1_partition_spec
 from .strategies import GradientSyncStrategy, SyncAllReduce
 
 
@@ -68,6 +68,33 @@ class DistributedTrainer:
         ``"layername/paramname"`` — first hit wins; unmatched params are
         replicated. Only valid with the default strategy (implicit-pjit
         path), where XLA derives all collectives from shardings.
+    zero1: ZeRO-1 cross-replica weight-update sharding ("Automatic
+        Cross-Replica Sharding of Weight Update in Data-Parallel
+        Training", PAPERS.md). Updater (optimizer) state is partitioned
+        1/N over the data axis — each replica updates only its parameter
+        slice and the updated slices are all-gathered — cutting the
+        dominant optimizer-memory term AND the update FLOPs per chip.
+        On the implicit (GSPMD) path this is pure sharding annotations:
+        opt_state leaves get ``P(data, ...)`` in/out shardings and the
+        gradients a matching sharding constraint, so XLA emits the
+        reduce-scatter → sharded update → all-gather schedule. On the
+        explicit strategy path the same schedule is spelled by hand
+        inside ``shard_map`` (dynamic-slice → sliced optax update →
+        ``all_gather``). Composes with tensor parallelism (TP-sharded
+        dims are preserved; dim 0 is sharded over ``data`` on top) and
+        with compressed gradient exchange; rejected for strategies whose
+        replicas apply *different* gradients between sync points
+        (``ParameterAveragingSync``), because a replica may only own a
+        param slice if every replica's update agrees. Leaves whose dim 0
+        the data axis does not divide, and layers whose updater is not
+        elementwise (``IUpdater.elementwise``), stay replicated.
+    registry: metrics registry (default: process-global) for the
+        ``dl4j_tpu_training_updater_state_bytes{sharded=}`` gauge and —
+        for compressed strategies — the
+        ``dl4j_tpu_training_grad_compression_ratio`` histogram.
+    metrics_every: record the compression ratio every N iterations
+        (reading it fetches the measured-density scalar from device;
+        0 disables the per-step recording entirely).
     """
 
     def __init__(
@@ -78,6 +105,9 @@ class DistributedTrainer:
         param_sharding_rules: Optional[Sequence[Tuple[str, P]]] = None,
         data_axis: str = "data",
         donate_inputs: bool = False,
+        zero1: bool = False,
+        registry=None,
+        metrics_every: int = 1,
     ) -> None:
         self.model = model
         # donate the batch buffers to the jitted step (sharded-loader
@@ -88,12 +118,20 @@ class DistributedTrainer:
         self.mesh = mesh if mesh is not None else make_mesh()
         self.strategy = strategy or SyncAllReduce()
         self.data_axis = data_axis
+        self.zero1 = bool(zero1)
         if data_axis not in self.mesh.axis_names:
             raise ValueError(f"mesh has no {data_axis!r} axis: {self.mesh.axis_names}")
         if param_sharding_rules and self.strategy.explicit:
             raise ValueError(
                 "param_sharding_rules (tensor parallelism) requires the default "
                 "SyncAllReduce strategy — explicit strategies replicate params"
+            )
+        if self.zero1 and not getattr(self.strategy, "replicated_grads", True):
+            raise ValueError(
+                "zero1 requires a strategy whose synced gradients are identical "
+                "on every replica; ParameterAveragingSync applies purely local "
+                "updates between sync points, so no replica may own a 1/N "
+                "parameter slice"
             )
         self.rules = [(re.compile(pat), spec) for pat, spec in (param_sharding_rules or [])]
 
@@ -112,21 +150,37 @@ class DistributedTrainer:
             raise ValueError(
                 "param_sharding_rules (TP) is single-process; multi-process "
                 "training is data-parallel with replicated params")
+        self._zero1_shapes = self._zero1_shardable_shapes()
+        self._zero1_flags = {
+            ln: {pn: tuple(np.shape(p)) in self._zero1_shapes[ln]
+                 for pn, p in lp.items()}
+            for ln, lp in model.params.items()
+        }
+        host_opt = self.optim.init(model.params)
+        self._opt_shardings = self._updater_shardings(host_opt)
         self.params = self._put_tree(model.params, self._param_shardings())
         self.state = self._put_tree(model.state, self._replicated)
-        self.opt_state = self._put_tree(
-            self.optim.init(model.params), self._replicated)
+        self.opt_state = self._put_tree(host_opt, self._opt_shardings)
         self.strat_state = self._put_tree(
             self.strategy.init_state(model.params), self._replicated)
         self.iteration = 0
         self._step = None
+        self.metrics_every = int(metrics_every)
+        self._init_metrics(registry)
 
     def _put_tree(self, tree, shardings):
         if not self._multiprocess:
             return jax.device_put(tree, shardings)
 
         def put_one(leaf, sh):
-            return jax.make_array_from_process_local_data(sh, np.asarray(leaf))
+            arr = np.asarray(leaf)
+            if not sh.is_fully_replicated:
+                # zero1-sharded updater leaf: every process holds the
+                # identical full value host-side (same-seed contract), so
+                # each addressable device picks its global slice
+                return jax.make_array_from_callback(
+                    arr.shape, sh, lambda idx, a=arr: a[idx])
+            return jax.make_array_from_process_local_data(sh, arr)
 
         if isinstance(shardings, NamedSharding):
             return jax.tree_util.tree_map(
@@ -151,6 +205,71 @@ class DistributedTrainer:
             }
 
         return {ln: one(lp, ln) for ln, lp in self.model.params.items()}
+
+    # ----- ZeRO-1 updater sharding -----------------------------------
+    def _zero1_shardable_shapes(self):
+        """Per layer: the set of param shapes ZeRO-1 may shard — dim 0
+        divisible by the data axis, layer trainable under an elementwise
+        update chain, and dim 0 not already taken by a TP rule. Updater
+        leaves are matched to params BY SHAPE (optax moments/traces are
+        param-shaped), so one predicate keeps grads/params/opt slices
+        aligned on the explicit path and the sharding annotations
+        consistent on the implicit path."""
+        n = self.n_data_shards
+        out = {}
+        for lname, lparams in self.model.params.items():
+            shapes = set()
+            if (self.zero1 and n > 1 and lname in self.optim.txs
+                    and self.optim.elementwise.get(lname, False)):
+                for pname, p in lparams.items():
+                    shp = tuple(np.shape(p))
+                    base = self._spec_for(f"{lname}/{pname}")
+                    if zero1_partition_spec(shp, n, self.data_axis, base) != base:
+                        shapes.add(shp)
+            out[lname] = shapes
+        return out
+
+    def _zero1_spec(self, lname: str, shape: Tuple[int, ...],
+                    base: Optional[P] = None) -> P:
+        base = base if base is not None else P()
+        if shape in self._zero1_shapes.get(lname, ()):
+            return zero1_partition_spec(
+                shape, self.n_data_shards, self.data_axis, base)
+        return base
+
+    def _updater_shardings(self, host_opt):
+        """Sharding tree for opt_state: under zero1, param-shaped leaves
+        shard dim 0 over the data axis (composed with the param's TP spec
+        when rules shard other dims); everything else — scalars (step
+        counts), non-divisible leaves, non-elementwise layers — stays
+        replicated. Without zero1: fully replicated (the historical
+        layout, and what pre-zero1 checkpoints expect)."""
+        if not self.zero1:
+            return self._replicated
+        out = {}
+        for lname, lstate in host_opt.items():
+            base_by_shape = {}
+            if self.rules:
+                for pname, p in self.model.params[lname].items():
+                    base_by_shape.setdefault(
+                        tuple(np.shape(p)), self._spec_for(f"{lname}/{pname}"))
+
+            def spec_one(leaf, _l=lname, _b=base_by_shape):
+                shp = tuple(np.shape(leaf))
+                return NamedSharding(
+                    self.mesh, self._zero1_spec(_l, shp, _b.get(shp)))
+
+            out[lname] = jax.tree_util.tree_map(spec_one, lstate)
+        return out
+
+    def _updater_pspecs(self):
+        """PartitionSpec mirror of :meth:`_updater_shardings` for the
+        explicit (shard_map) path's in/out specs."""
+        if not self.zero1:
+            return P()
+        return jax.tree_util.tree_map(
+            lambda sh: sh.spec, self._opt_shardings,
+            is_leaf=lambda x: isinstance(x, NamedSharding))
 
     # ----- step compilation ------------------------------------------
     def _build_step(self):
@@ -177,24 +296,48 @@ class DistributedTrainer:
         if not strategy.explicit:
             # Implicit path: sharded batch + (possibly rule-sharded) params;
             # the mean-loss gradient IS the all-reduced gradient — XLA emits
-            # the psum/all-gathers from the shardings (GSPMD).
+            # the psum/all-gathers from the shardings (GSPMD). Under zero1
+            # the opt_state in/out shardings plus a matching gradient
+            # sharding constraint turn the update into the ZeRO-1 schedule:
+            # reduce-scatter(grads) → 1/N-sharded update → all-gather(params)
+            # — all placed by XLA from the annotations.
+            grad_cons = None
+            if self.zero1:
+                grad_cons = {
+                    ln: {pn: (NamedSharding(
+                            self.mesh,
+                            self._zero1_spec(ln, tuple(np.shape(p)),
+                                             self._spec_for(f"{ln}/{pn}")))
+                          if self._zero1_flags[ln][pn] else None)
+                         for pn, p in lp.items()}
+                    for ln, lp in model.params.items()
+                }
+
             def step(params, opt_state, state, strat_state, x, y, rng, it):
                 score, new_state, grads = local_grads(params, state, x, y, rng)
                 grads = _normalize_gradients(
                     grads, conf.gradient_normalization, conf.gradient_normalization_threshold
                 )
+                if grad_cons is not None:
+                    grads = {
+                        ln: {pn: (g if grad_cons[ln].get(pn) is None else
+                                  jax.lax.with_sharding_constraint(
+                                      g, grad_cons[ln][pn]))
+                             for pn, g in lg.items()}
+                        for ln, lg in grads.items()
+                    }
                 new_params, new_opt = optim.update(grads, opt_state, params)
                 return new_params, new_opt, new_state, strat_state, score
 
             return jax.jit(
                 step,
                 in_shardings=(
-                    self._param_shardings(), self._replicated, self._replicated,
+                    self._param_shardings(), self._opt_shardings, self._replicated,
                     self._replicated, self._data_sharding, self._data_sharding,
                     self._replicated, self._replicated,
                 ),
                 out_shardings=(
-                    self._param_shardings(), self._replicated, self._replicated,
+                    self._param_shardings(), self._opt_shardings, self._replicated,
                     self._replicated, self._replicated,
                 ),
                 donate_argnums=(0, 1, 2, 3) + (
@@ -202,6 +345,14 @@ class DistributedTrainer:
             )
 
         # Explicit path: per-replica grads -> strategy.sync collective.
+        # Under zero1, the post-sync gradients agree on every replica, so
+        # each replica dynamic-slices its 1/N of (grads, params), applies
+        # the optax update against its resident opt_state slice (arriving
+        # pre-sliced via the P(data) in_specs), and all-gathers the
+        # updated param slices — the hand-spelled ZeRO-1 schedule.
+        n = self.n_data_shards
+        flags = self._zero1_flags if self.zero1 else None
+
         def shard_step(params, opt_state, state, strat_state, x, y, rng, it):
             rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
             score, new_state, grads = local_grads(params, state, x, y, rng)
@@ -209,7 +360,29 @@ class DistributedTrainer:
             grads = _normalize_gradients(
                 grads, conf.gradient_normalization, conf.gradient_normalization_threshold
             )
-            new_params, new_opt = optim.update(grads, opt_state, params)
+            if flags is not None:
+                idx = jax.lax.axis_index(axis)
+
+                def slc(leaf):
+                    size = leaf.shape[0] // n
+                    return jax.lax.dynamic_slice_in_dim(
+                        leaf, idx * size, size, axis=0)
+
+                params_l = {ln: {pn: (slc(p) if flags[ln][pn] else p)
+                                 for pn, p in lp.items()}
+                            for ln, lp in params.items()}
+                grads_l = {ln: {pn: (slc(g) if flags[ln][pn] else g)
+                                for pn, g in lg.items()}
+                           for ln, lg in grads.items()}
+                new_params, new_opt = optim.update(grads_l, opt_state, params_l)
+                new_params = {
+                    ln: {pn: (jax.lax.all_gather(p, axis, axis=0, tiled=True)
+                              if flags[ln][pn] else p)
+                         for pn, p in lp.items()}
+                    for ln, lp in new_params.items()
+                }
+            else:
+                new_params, new_opt = optim.update(grads, opt_state, params)
             new_params = strategy.sync_params(new_params, it, axis)
             # state (e.g. batchnorm running stats) follows the local shard;
             # average it so replicas agree, like the reference's param
@@ -223,11 +396,12 @@ class DistributedTrainer:
 
         rep = P()
         data = P(self.data_axis)
+        opt_specs = self._updater_pspecs()
         mapped = _shmap(
             shard_step,
             self.mesh,
-            in_specs=(rep, rep, rep, rep, data, data, rep, rep),
-            out_specs=(rep, rep, rep, rep, rep),
+            in_specs=(rep, opt_specs, rep, rep, data, data, rep, rep),
+            out_specs=(rep, opt_specs, rep, rep, rep),
         )
         return jax.jit(mapped, donate_argnums=(0, 1, 2, 3) + (
             (4, 5) if self.donate_inputs else ()))
@@ -338,6 +512,7 @@ class DistributedTrainer:
         self.params, self.opt_state, self.state, self.strat_state, score = self._step(
             self.params, self.opt_state, self.state, self.strat_state, x, y, rng, it
         )
+        self._record_compression()
         return score
 
     def fit(self, data, labels=None, *, epochs: int = 1) -> float:
@@ -514,8 +689,84 @@ class DistributedTrainer:
         self.model.params = jax.device_get(self.params)
         self.model.state = jax.device_get(self.state)
 
+    # ----- observability ---------------------------------------------
+    def _init_metrics(self, registry) -> None:
+        from ..obs import get_registry
+
+        self.registry = registry if registry is not None else get_registry()
+        gauge = self.registry.gauge(
+            "dl4j_tpu_training_updater_state_bytes",
+            "Updater (optimizer) state bytes resident per data-parallel "
+            "replica", labelnames=("sharded",))
+        gauge.labels("true" if self.zero1 else "false").set(
+            float(self.updater_state_bytes()))
+        self._comp_hist = None
+        if getattr(self.strategy, "compressed", False):
+            self._comp_hist = self.registry.histogram(
+                "dl4j_tpu_training_grad_compression_ratio",
+                "Measured gradient-exchange compression ratio "
+                "(elements per exchanged element) per recorded step",
+                labelnames=("strategy",),
+                buckets=(1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                         1000.0, 10000.0),
+            ).labels(type(self.strategy).__name__)
+
+    def _record_compression(self) -> None:
+        if (self._comp_hist is None or self.metrics_every <= 0
+                or self.iteration % self.metrics_every):
+            return
+        stats = self.compression_stats() or {}
+        ratio = stats.get("compression_ratio")
+        if ratio:
+            self._comp_hist.observe(float(ratio))
+
+    def updater_state_bytes(self, *, per_replica: bool = True) -> int:
+        """Bytes of updater (optimizer) state — per replica (the HBM that
+        actually sits on each data-parallel replica; under zero1 the
+        sharded leaves count 1/N) or global logical bytes."""
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(self.opt_state):
+            if isinstance(leaf, jax.Array) and per_replica:
+                shard = leaf.sharding.shard_shape(leaf.shape)
+                total += int(np.prod(shard, dtype=np.int64)) * leaf.dtype.itemsize
+            else:
+                total += np.asarray(leaf).nbytes if not isinstance(
+                    leaf, jax.Array) else leaf.nbytes
+        return int(total)
+
+    def compression_stats(self) -> Optional[dict]:
+        """The strategy's compression view (threshold / measured density /
+        ratio) or ``None`` for uncompressed strategies. Reads device
+        scalars — a blocking fetch, so call it off the hot loop (or let
+        ``metrics_every`` pace the automatic recording)."""
+        fn = getattr(self.strategy, "compression_stats", None)
+        return fn(self.strat_state) if fn is not None else None
+
+    def stats(self) -> dict:
+        """Operational snapshot: iteration/shard counts, ZeRO-1 state and
+        per-replica updater bytes, plus the strategy's compression stats
+        when it has any."""
+        out = {
+            "iteration": self.iteration,
+            "dropped_rows": self.dropped_rows,
+            "data_shards": self.n_data_shards,
+            "strategy": type(self.strategy).__name__,
+            "zero1": self.zero1,
+            "updater_state_bytes": self.updater_state_bytes(),
+            "updater_state_bytes_global": self.updater_state_bytes(
+                per_replica=False),
+        }
+        comp = self.compression_stats()
+        if comp is not None:
+            out["compression"] = comp
+        return out
+
     def threshold_value(self) -> Optional[float]:
-        """Current adaptive threshold (compressed strategy only)."""
-        if isinstance(self.strat_state, dict) and "threshold" in self.strat_state:
-            return float(self.strat_state["threshold"])
-        return None
+        """Current adaptive threshold, for any strategy exposing one via
+        ``compression_stats()`` (``None`` otherwise — e.g. top-k
+        compression has a fixed density, no threshold)."""
+        comp = self.compression_stats() or {}
+        t = comp.get("threshold")
+        if t is None and isinstance(self.strat_state, dict):
+            t = self.strat_state.get("threshold")  # custom strategies
+        return None if t is None else float(t)

@@ -25,9 +25,22 @@ class CheckpointListener(TrainingListener):
         keep_last: Optional[int] = None,
         save_updater: bool = True,
         log_fn=None,
+        trainer: Optional[Any] = None,
     ) -> None:
+        """``trainer=`` attaches the live
+        :class:`~deeplearning4j_tpu.parallel.trainer.DistributedTrainer`:
+        each save first writes the trainer's device params/state back onto
+        the model (``sync_to_model`` — under ZeRO-1 or parameter averaging
+        this is where the sharded/diverged replicas are reassembled into
+        the single replicated view the zip artifact holds). Without it, a
+        DistributedTrainer fit would checkpoint the model's STALE pre-fit
+        params, because the trainer only syncs back at fit() end. Note the
+        zip artifact never carries the trainer's sharded opt_state — use
+        :class:`~deeplearning4j_tpu.train.orbax_checkpoint.OrbaxCheckpointer`
+        for resumable sharded training state."""
         if not (save_every_n_iterations or save_every_n_epochs or save_every_n_seconds):
             raise ValueError("Configure at least one save frequency")
+        self.trainer = trainer
         self.directory = directory
         self.every_iter = save_every_n_iterations
         self.every_epoch = save_every_n_epochs
@@ -42,6 +55,9 @@ class CheckpointListener(TrainingListener):
     def _save(self, model, iteration: int, epoch: int) -> None:
         from ..model.serializer import write_model
 
+        if self.trainer is not None:
+            self.trainer.sync_to_model()
+            model = self.trainer.model
         fname = os.path.join(
             self.directory, f"checkpoint_iter{iteration}_epoch{epoch}.zip"
         )

@@ -35,6 +35,59 @@ def _ocp():
     return ocp
 
 
+def _synth_from_metadata(node):
+    """Zeros restore-template matching a SAVED subtree's structure, built
+    from checkpoint item metadata (dict → dict, list/tuple → list,
+    array metadata → replicated zeros of its shape/dtype). Used to read
+    strategy-state entries the live trainer does not keep, so orbax's
+    exact-structure restore succeeds and the extras can be discarded."""
+    import jax.numpy as jnp
+
+    if node is None:
+        return None
+    if isinstance(node, dict):
+        return {k: _synth_from_metadata(v) for k, v in node.items()}
+    if isinstance(node, (list, tuple)):
+        return [_synth_from_metadata(v) for v in node]
+    shape = getattr(node, "shape", None)
+    dtype = getattr(node, "dtype", None)
+    return jnp.zeros(tuple(shape) if shape is not None else (), dtype)
+
+
+def _leaf_shapes(tree):
+    return [tuple(getattr(leaf, "shape", ()) or ())
+            for leaf in jax.tree_util.tree_leaves(tree)]
+
+
+def _check_section_compat(name: str, template, saved, step, meta_hint: str):
+    """Clear incompatibility errors BEFORE orbax's opaque structural ones.
+    Sharded↔replicated (ZeRO-1) layouts are interchangeable — every leaf
+    is saved at its GLOBAL shape, so restore-to-template re-shards freely
+    across data-axis widths. A leaf-count or global-shape mismatch
+    therefore means the model / updater / strategy differs, which no
+    reshard can fix."""
+    t_shapes, s_shapes = _leaf_shapes(template), _leaf_shapes(saved)
+    if len(t_shapes) != len(s_shapes):
+        raise ValueError(
+            f"checkpoint step {step} is incompatible with the live trainer: "
+            f"'{name}' holds {len(s_shapes)} saved leaves vs {len(t_shapes)} "
+            f"live ({meta_hint}). ZeRO-1 sharded and replicated layouts "
+            f"interchange freely (leaves are saved at global shape), so this "
+            f"is a different model, updater or strategy — rebuild the "
+            f"trainer to match the checkpoint.")
+    bad = [(i, s, t) for i, (s, t) in enumerate(zip(s_shapes, t_shapes))
+           if s != t]
+    if bad:
+        i, s, t = bad[0]
+        raise ValueError(
+            f"checkpoint step {step} is incompatible with the live trainer: "
+            f"'{name}' leaf {i} was saved with global shape {s} but the live "
+            f"trainer expects {t} ({len(bad)} mismatched leaves total; "
+            f"{meta_hint}). Global shapes are mesh-independent — sharded↔"
+            f"replicated round trips never change them — so the model or "
+            f"updater configuration differs from the one checkpointed.")
+
+
 class OrbaxCheckpointer:
     """``OrbaxCheckpointer(dir).save(step, trainer)`` / ``restore(trainer)``.
 
@@ -68,6 +121,12 @@ class OrbaxCheckpointer:
                 "strat_state": getattr(trainer, "strat_state", {}),
             }
             meta = {"iteration": int(getattr(trainer, "iteration", step))}
+            # layout provenance: restores are layout-independent, but the
+            # hints make incompatibility errors diagnosable
+            if hasattr(trainer, "zero1"):
+                meta["zero1"] = bool(trainer.zero1)
+            if hasattr(trainer, "n_data_shards"):
+                meta["data_axis"] = int(trainer.n_data_shards)
             model = getattr(trainer, "model", None)
             rng = getattr(model, "_rng", None)
             if rng is not None:  # resume the exact noise stream (dropout)
@@ -105,31 +164,72 @@ class OrbaxCheckpointer:
     def restore(self, trainer: Any, step: Optional[int] = None) -> Dict:
         """Restore IN PLACE onto the trainer's live shardings: every leaf
         comes back as a jax.Array already placed per the trainer's current
-        mesh (restore-to-sharding — no host-side gather)."""
+        mesh (restore-to-sharding — no host-side gather).
+
+        **Layout independence (ZeRO-1):** arrays are saved at their global
+        shapes, so a checkpoint written by a ``zero1=True`` trainer
+        restores into a replicated one and vice versa — the template's
+        live shardings drive an explicit reshard/reassemble on read.
+        Incompatible *structure* (different model/updater/strategy) fails
+        with a clear :class:`ValueError` before orbax's opaque one.
+
+        **Strategy-state migration:** ``strat_state`` dict keys are
+        reconciled by name — saved keys the live strategy keeps are
+        restored, keys the live strategy added since the save (e.g. the
+        compression ``density`` introduced with ZeRO-1) keep their fresh
+        values, and saved keys the live strategy lacks are read and
+        discarded (so e.g. a threshold-compressed checkpoint resumes
+        under top-k with its residuals intact)."""
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
         ocp = _ocp()
         if hasattr(trainer, "params"):
+            live_ss = getattr(trainer, "strat_state", {})
             template = {
                 "params": trainer.params,
                 "opt_state": trainer.opt_state,
                 "state": trainer.state,
-                "strat_state": getattr(trainer, "strat_state", {}),
+                "strat_state": live_ss,
             }
-            restored = self._mgr.restore(
-                step,
-                args=ocp.args.Composite(
-                    arrays=ocp.args.StandardRestore(template),
-                    meta=ocp.args.JsonRestore(),
-                ),
-            )
+            saved_struct = None
+            try:
+                saved_struct = getattr(self._mgr.item_metadata(step),
+                                       "arrays", None)
+            except Exception:
+                pass  # metadata unavailable: fall through to plain restore
+            if saved_struct is not None:
+                meta_hint = self._meta_hint(step)
+                for section in ("params", "opt_state", "state"):
+                    if section in saved_struct:
+                        _check_section_compat(
+                            section, template[section],
+                            saved_struct[section], step, meta_hint)
+                if "strat_state" in saved_struct:
+                    template["strat_state"] = self._reconcile_strat_state(
+                        live_ss, saved_struct["strat_state"])
+                else:  # pre-strat_state checkpoint: nothing to read
+                    template.pop("strat_state", None)
+            try:
+                restored = self._mgr.restore(
+                    step,
+                    args=ocp.args.Composite(
+                        arrays=ocp.args.StandardRestore(template),
+                        meta=ocp.args.JsonRestore(),
+                    ),
+                )
+            except (ValueError, KeyError, TypeError) as e:
+                raise ValueError(
+                    f"checkpoint step {step} under {self.directory} does not "
+                    f"match the live trainer's structure "
+                    f"({self._meta_hint(step)}): {e}") from e
             tree = restored["arrays"]
             trainer.params = tree["params"]
             trainer.opt_state = tree["opt_state"]
             trainer.state = tree["state"]
             if "strat_state" in tree:
-                trainer.strat_state = tree["strat_state"]
+                trainer.strat_state = self._merge_strat_state(
+                    live_ss, tree["strat_state"])
             meta = restored["meta"] or {}
             if "iteration" in meta:
                 trainer.iteration = int(meta["iteration"])
@@ -151,6 +251,52 @@ class OrbaxCheckpointer:
             ),
         )
         return restored["arrays"]["params"]
+
+    # ---- compatibility helpers --------------------------------------------
+    def _meta_hint(self, step: int) -> str:
+        """Provenance hint for error messages: the saved layout metadata."""
+        try:
+            ocp = _ocp()
+            meta = self._mgr.restore(
+                step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()),
+            )["meta"] or {}
+            return (f"saved zero1={meta.get('zero1')}, "
+                    f"data_axis={meta.get('data_axis')}, "
+                    f"iteration={meta.get('iteration')}")
+        except Exception:
+            return "saved layout metadata unavailable"
+
+    def _reconcile_strat_state(self, live_ss, saved_md):
+        """Restore template for strat_state matching the SAVED structure:
+        keys both sides share use the live leaves (live shardings drive
+        placement), saved-only keys are synthesized from metadata (read
+        then discarded by :meth:`_merge_strat_state`), live-only keys are
+        simply not read (they keep their fresh values)."""
+        if isinstance(saved_md, dict) and isinstance(live_ss, dict):
+            return {k: (live_ss[k] if k in live_ss
+                        else _synth_from_metadata(v))
+                    for k, v in saved_md.items()}
+        if not jax.tree_util.tree_leaves(live_ss):
+            # live strategy keeps no state (SyncAllReduce): read the saved
+            # state into synthesized zeros and drop it
+            return _synth_from_metadata(saved_md)
+        if not _leaf_shapes(saved_md):
+            return _synth_from_metadata(saved_md)  # saved empty container
+        return live_ss  # same-structure fast path (orbax enforces)
+
+    @staticmethod
+    def _merge_strat_state(live_ss, restored_ss):
+        """Post-restore merge: the live strategy's key set wins — restored
+        values for keys it keeps, fresh values for keys the checkpoint
+        predates, nothing for keys it no longer has."""
+        if isinstance(live_ss, dict) and isinstance(restored_ss, dict):
+            return {k: restored_ss.get(k, v) for k, v in live_ss.items()}
+        if jax.tree_util.tree_leaves(live_ss) and not isinstance(
+                restored_ss, type(live_ss)):
+            return live_ss
+        if not jax.tree_util.tree_leaves(live_ss):
+            return live_ss  # stateless live strategy: discard restored
+        return restored_ss
 
     def close(self) -> None:
         self._mgr.close()

@@ -77,12 +77,19 @@ class LayerOptimizers:
     def __init__(self, model) -> None:
         conf = model.conf
         self.txs: Dict[str, optax.GradientTransformation] = {}
+        # per-layer: is the whole update chain elementwise per tensor
+        # element? (The ZeRO-1 slicing contract — see IUpdater.elementwise.
+        # The weight-decay prologue is elementwise, so the chain inherits
+        # the updater's flag.)
+        self.elementwise: Dict[str, bool] = {}
         global_updater = updater_from_any(conf.updater) if conf.updater is not None else Sgd()
         for name, layer in model.named_param_layers():
             if layer.frozen:
                 self.txs[name] = optax.set_to_zero()
+                self.elementwise[name] = True
                 continue
             updater = updater_from_any(layer.updater) if layer.updater is not None else global_updater
+            self.elementwise[name] = bool(getattr(updater, "elementwise", False))
             parts = []
             wd = layer.weight_decay
             if wd:

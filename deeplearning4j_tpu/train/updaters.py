@@ -45,6 +45,35 @@ class IUpdater:
     def has_state(self) -> bool:
         return True
 
+    @property
+    def elementwise(self) -> bool:
+        """True when the update math is independent per tensor element
+        (every built-in updater: Adam family moments, momentum traces,
+        RMS accumulators are all elementwise in grads/params/state).
+
+        This is the contract ZeRO-1 weight-update sharding relies on:
+        an elementwise update applied to each replica's 1/N slice of
+        (grads, params, state) followed by an all-gather of the param
+        slices is exactly the replicated update. An updater whose state
+        couples elements across the tensor (e.g. a factored second
+        moment) must override this to ``False`` — the trainer then keeps
+        that layer's updater state replicated."""
+        return True
+
+    def state_partition_spec(self, param_shape, n_shards: int, axis: str = "data",
+                             base=None):
+        """Partition spec for a param-shaped state leaf under ZeRO-1:
+        dim 0 sharded over the data axis when divisible (see
+        :func:`~deeplearning4j_tpu.parallel.mesh.zero1_partition_spec`),
+        replicated otherwise. Non-elementwise updaters pin their state to
+        ``base`` (replicated / TP-inherited)."""
+        from ..parallel.mesh import zero1_partition_spec
+
+        if not self.elementwise:
+            import jax.sharding as _shd
+            return base if base is not None else _shd.PartitionSpec()
+        return zero1_partition_spec(tuple(param_shape), n_shards, axis, base)
+
 
 @register_config
 @dataclasses.dataclass(frozen=True)

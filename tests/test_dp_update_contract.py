@@ -1,0 +1,21 @@
+"""Tier-1 wiring for tools/check_dp_update_contract.py: the ZeRO-1
+sharded-weight-update + compressed-gradient-exchange contract (README.md
+"Distributed training" — zero1 trajectory equals the replicated one on
+both trainer paths, per-replica updater bytes shrink ~1/N, top-k residual
+feedback conserves mass, checkpoints are layout-independent with clear
+incompatibility errors, and the updater-bytes/compression-ratio series
+export), mirroring test_metrics_contract.py / test_trace_contract.py."""
+
+import os
+import sys
+
+_TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+
+
+def test_dp_update_contract_smoke():
+    sys.path.insert(0, _TOOLS)
+    try:
+        import check_dp_update_contract
+    finally:
+        sys.path.remove(_TOOLS)
+    assert check_dp_update_contract.main(log=lambda m: None) == 0
