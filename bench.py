@@ -1582,6 +1582,114 @@ def measure_zero1_updater_headroom(nin: int = 256, hidden: int = 1024,
     }
 
 
+def measure_generate_decode(vocab: int = 512, hidden: int = 256,
+                            layers: int = 4, heads: int = 8,
+                            max_len: int = 512, batch: int = 8,
+                            prompt_len: int = 32, decode_steps: int = 64,
+                            warmup_steps: int = 4,
+                            attn_len: int = None) -> dict:
+    """Autoregressive decode row (ISSUE 9 acceptance): tokens/sec/chip at a
+    FIXED batch through the KV-cached incremental path, the prefill-vs-
+    decode millisecond split (the two phases TPU serving capacity planning
+    provisions separately), and the flash-decode kernel vs the reference
+    impl on the decode attention shapes. All decode steps share ONE
+    compiled [B, 1] program — the static-shape cache contract."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.generate import GenerationSession
+    from deeplearning4j_tpu.model.zoo import TransformerLM
+    from deeplearning4j_tpu.ops import (decode_attention_reference,
+                                        flash_decode_attention)
+
+    model = TransformerLM(vocab_size=vocab, hidden=hidden, n_layers=layers,
+                          n_heads=heads, max_len=max_len).init()
+    sess = GenerationSession(model, max_len=max_len)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, vocab, prompt_len).tolist()
+               for _ in range(batch)]
+
+    def run_prefill():
+        start = time.perf_counter()
+        carry, logits, lens = sess.prefill(prompts)
+        _host_fence(logits)
+        return time.perf_counter() - start, carry, lens
+
+    _, carry0, lens = run_prefill()  # compile
+    prefill_ms = []
+    for _ in range(REPEATS):
+        sec, carry0, lens = run_prefill()
+        prefill_ms.append(sec * 1e3)
+    prefill_ms_med = statistics.median(prefill_ms)
+
+    tokens = jnp.asarray(rng.randint(1, vocab, batch), jnp.int32)
+    carry = carry0
+    for _ in range(warmup_steps):  # compile + settle
+        carry, logits = sess.decode(carry, tokens)
+        tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    _host_fence(tokens)
+
+    def decode_block():
+        nonlocal carry, tokens
+        start = time.perf_counter()
+        for _ in range(decode_steps):
+            carry, logits = sess.decode(carry, tokens)
+            tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        _host_fence(tokens)
+        return time.perf_counter() - start
+
+    rate, spread = _median_rate(decode_block, batch * decode_steps)
+    decode_ms_per_token = 1e3 / (rate / batch)
+
+    # flash decode kernel vs reference on the decode attention shapes
+    L = attn_len or max_len
+    d = hidden // heads
+    q = jnp.asarray(rng.randn(batch, heads, 1, d), jnp.float32)
+    k = jnp.asarray(rng.randn(batch, heads, L, d), jnp.float32)
+    v = jnp.asarray(rng.randn(batch, heads, L, d), jnp.float32)
+    pos = jnp.full((batch,), L - 1, jnp.int32)
+    flash = jax.jit(lambda *a: flash_decode_attention(*a))
+    ref = jax.jit(lambda *a: decode_attention_reference(*a))
+
+    def attn_ms(fn, iters=16):
+        _host_fence(fn(q, k, v, pos))
+        vals = []
+        for _ in range(REPEATS):
+            start = time.perf_counter()
+            for _ in range(iters):
+                out = fn(q, k, v, pos)
+            _host_fence(out)
+            vals.append((time.perf_counter() - start) / iters * 1e3)
+        return statistics.median(vals)
+
+    ref_ms = attn_ms(ref)
+    flash_ms = attn_ms(flash)
+
+    on_tpu = jax.default_backend() == "tpu"
+    return {
+        "tokens_per_sec_per_chip": round(rate, 2),
+        "tokens_per_sec_spread": spread,
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "max_len": max_len,
+        "decode_steps": decode_steps,
+        "prefill_ms": round(prefill_ms_med, 3),
+        "decode_ms_per_token": round(decode_ms_per_token, 3),
+        "prefill_vs_decode_ratio": round(
+            prefill_ms_med / max(decode_ms_per_token, 1e-9), 2),
+        "model": {"vocab": vocab, "hidden": hidden, "layers": layers,
+                  "heads": heads},
+        "decode_attn_ref_ms": round(ref_ms, 3),
+        "decode_attn_flash_ms": round(flash_ms, 3),
+        "flash_decode_speedup": round(ref_ms / max(flash_ms, 1e-9), 3),
+        "note": ("flash kernel compiled on TPU" if on_tpu else
+                 "flash kernel in Pallas interpret mode off-TPU — the "
+                 "speedup column is only meaningful on the chip"),
+    }
+
+
 _MEASUREMENTS = {
     "lenet": measure_lenet,
     "resnet50": measure_resnet50,
@@ -1601,6 +1709,7 @@ _MEASUREMENTS = {
     "tracing_overhead": measure_tracing_overhead,
     "step_profile": measure_step_profile,
     "zero1_updater_headroom": measure_zero1_updater_headroom,
+    "generate_decode": measure_generate_decode,
 }
 
 
@@ -1703,6 +1812,12 @@ def _child_measure(name: str, platform: str) -> None:
                                        "hidden": 256, "nout": 64,
                                        "batch_per_shard": 4,
                                        "bench_steps": 4},
+            # interpret-mode Pallas is slow on CPU: tiny model + short
+            # cache keep the flash-vs-ref column inside the timeout
+            "generate_decode": {"vocab": 64, "hidden": 64, "layers": 2,
+                                "heads": 4, "max_len": 64, "batch": 4,
+                                "prompt_len": 8, "decode_steps": 12,
+                                "warmup_steps": 2, "attn_len": 32},
         }.get(name, {})
     result = _MEASUREMENTS[name](**kwargs)
     print(json.dumps(result))
@@ -1753,6 +1868,7 @@ def main() -> None:
         "step_profile": _run_measurement("step_profile", platform),
         "zero1_updater_headroom": _run_measurement(
             "zero1_updater_headroom", platform),
+        "generate_decode": _run_measurement("generate_decode", platform),
     }
     if not fallback:  # chip-only rows
         extras["resnet50_b128"] = _run_measurement("resnet50_b128", platform)

@@ -1,0 +1,47 @@
+"""Autoregressive generation: KV-cached decode, seeded sampling, serving.
+
+The decode workload class (chat/completion-style serving) runs through
+three pieces:
+
+* :class:`GenerationSession` (session.py) — static-shape KV-cache decode
+  over a MultiLayerNetwork (bucketed prefill + [B, 1] incremental steps).
+* sampling.py — seeded greedy/temperature/top-k/top-p samplers, single
+  and batched-per-row (the continuous-batching engine form).
+* :class:`~deeplearning4j_tpu.parallel.decode.DecodeEngine` — the
+  continuous-batching serving loop behind ``POST /v1/generate``.
+"""
+
+from .sampling import (
+    greedy,
+    make_sampler,
+    sample_tokens,
+    temperature,
+    top_k,
+    top_p,
+)
+from .session import GenerationSession, bucket_length
+
+
+def __getattr__(name):
+    # lazy: parallel.decode imports generate (sampling/session); a direct
+    # top-level import here would be circular
+    if name in ("DecodeEngine", "GenerationHandle"):
+        from ..parallel.decode import DecodeEngine, GenerationHandle
+
+        return {"DecodeEngine": DecodeEngine,
+                "GenerationHandle": GenerationHandle}[name]
+    raise AttributeError(name)
+
+
+__all__ = [
+    "DecodeEngine",
+    "GenerationHandle",
+    "GenerationSession",
+    "bucket_length",
+    "greedy",
+    "make_sampler",
+    "sample_tokens",
+    "temperature",
+    "top_k",
+    "top_p",
+]

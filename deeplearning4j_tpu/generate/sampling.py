@@ -1,0 +1,132 @@
+"""Seeded token-sampling utilities for autoregressive decode.
+
+All functions operate on the LAST axis of a logits array and are pure /
+jit-safe. Log-probabilities work everywhere plain logits do: for a
+softmax-output model, ``log(p)`` differs from the true logits by a
+per-row constant, which temperature scaling, top-k/top-p truncation and
+``jax.random.categorical`` are all invariant to — so the decode path can
+sample straight from the output layer's probabilities without
+re-deriving pre-activation logits.
+
+Determinism contract: every sampler takes an explicit PRNG key (or a
+``(seed, step)`` pair in the batched engine form), so a request that
+declares a seed replays the identical token stream regardless of which
+other sequences happen to share its decode batch — the property that
+makes continuous batching debuggable.
+
+Tie semantics (documented, enforced by tests): ``top_k`` keeps every
+token tied with the k-th largest logit (the support may exceed k on
+ties); ``top_p`` keeps the smallest prefix of the sorted distribution
+whose cumulative mass reaches ``p``, including the token that crosses
+the threshold, plus any tokens tied with the last kept probability.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e30  # finite -inf: masked logits stay exp-safe
+
+
+def _scaled(logits: jax.Array, temp) -> jax.Array:
+    return logits / jnp.maximum(jnp.asarray(temp, logits.dtype), 1e-6)
+
+
+def greedy(logits: jax.Array) -> jax.Array:
+    """Argmax over the last axis — the deterministic decode mode."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def temperature(logits: jax.Array, key: jax.Array, temp: float = 1.0) -> jax.Array:
+    """Sample from softmax(logits / temp)."""
+    return jax.random.categorical(key, _scaled(logits, temp),
+                                  axis=-1).astype(jnp.int32)
+
+
+def _top_k_logits(z: jax.Array, k) -> jax.Array:
+    v = z.shape[-1]
+    kk = jnp.clip(jnp.asarray(k, jnp.int32), 1, v)
+    sorted_z = jnp.sort(z, axis=-1)[..., ::-1]
+    thr = jnp.take_along_axis(
+        sorted_z, jnp.broadcast_to(kk - 1, z.shape[:-1])[..., None], axis=-1)
+    return jnp.where(z >= thr, z, _NEG)
+
+
+def top_k(logits: jax.Array, key: jax.Array, k: int,
+          temp: float = 1.0) -> jax.Array:
+    """Sample among the k highest-logit tokens (ties at the k-th kept)."""
+    return jax.random.categorical(
+        key, _top_k_logits(_scaled(logits, temp), k), axis=-1).astype(jnp.int32)
+
+
+def _top_p_logits(z: jax.Array, p) -> jax.Array:
+    probs = jax.nn.softmax(z, axis=-1)
+    sp = jnp.sort(probs, axis=-1)[..., ::-1]
+    cs = jnp.cumsum(sp, axis=-1)
+    # keep while the mass BEFORE this token is < p (always keeps the top-1,
+    # includes the token that crosses the threshold)
+    keep = (cs - sp) < jnp.asarray(p, probs.dtype)
+    thr = jnp.min(jnp.where(keep, sp, jnp.inf), axis=-1, keepdims=True)
+    return jnp.where(probs >= thr, z, _NEG)
+
+
+def top_p(logits: jax.Array, key: jax.Array, p: float,
+          temp: float = 1.0) -> jax.Array:
+    """Nucleus sampling: smallest prefix of the sorted distribution with
+    cumulative probability >= p."""
+    return jax.random.categorical(
+        key, _top_p_logits(_scaled(logits, temp), p), axis=-1).astype(jnp.int32)
+
+
+def _sample_one(logits, seed, step, greedy_flag, temp, k, p):
+    """One row of the batched engine sampler. ``k == 0`` disables top-k,
+    ``p >= 1`` disables top-p; both compose (top-k first, then top-p over
+    the surviving support). Keyed by fold_in(PRNGKey(seed), step) so the
+    stream depends only on (seed, position), never on batch composition."""
+    key = jax.random.fold_in(jax.random.PRNGKey(seed.astype(jnp.uint32)), step)
+    z = _scaled(logits.astype(jnp.float32), temp)
+    z = jnp.where(k > 0, _top_k_logits(z, jnp.maximum(k, 1)), z)
+    z = jnp.where(p < 1.0, _top_p_logits(z, jnp.clip(p, 1e-6, 1.0)), z)
+    sampled = jax.random.categorical(key, z)
+    return jnp.where(greedy_flag, jnp.argmax(logits), sampled).astype(jnp.int32)
+
+
+def sample_tokens(
+    logits: jax.Array,       # [B, V]
+    seeds: jax.Array,        # [B] uint32 per-request seed
+    steps: jax.Array,        # [B] int32 per-request decode step index
+    greedy_mask: jax.Array,  # [B] bool — True rows take argmax
+    temp: jax.Array,         # [B] float temperature
+    k: jax.Array,            # [B] int32 top-k (0 = off)
+    p: jax.Array,            # [B] float top-p (>= 1 = off)
+) -> jax.Array:
+    """Batched per-row sampler for the continuous-batching decode engine:
+    every row carries its own sampling spec, so requests with different
+    (greedy/temperature/top-k/top-p, seed) settings share one compiled
+    decode step."""
+    return jax.vmap(_sample_one)(logits, seeds.astype(jnp.uint32),
+                                 steps.astype(jnp.int32), greedy_mask,
+                                 temp.astype(jnp.float32),
+                                 k.astype(jnp.int32), p.astype(jnp.float32))
+
+
+def make_sampler(*, greedy_mode: Optional[bool] = None,
+                 temp: float = 1.0, k: int = 0, p: float = 1.0):
+    """Single-spec convenience: returns ``fn(logits [B, V], seeds, steps)``
+    applying one sampling configuration to every row."""
+    use_greedy = bool(greedy_mode) if greedy_mode is not None else (
+        k == 0 and p >= 1.0 and temp == 0.0)
+
+    def fn(logits, seeds, steps):
+        b = logits.shape[0]
+        return sample_tokens(
+            logits, seeds, steps,
+            jnp.full((b,), use_greedy, bool),
+            jnp.full((b,), temp, jnp.float32),
+            jnp.full((b,), k, jnp.int32),
+            jnp.full((b,), p, jnp.float32))
+
+    return fn

@@ -1,0 +1,251 @@
+"""GenerationSession — KV-cached autoregressive decode over a
+MultiLayerNetwork.
+
+The session turns any sequential model whose layers implement
+``decode_state`` (causal attention blocks, LSTM/GRU/SimpleRnn, positional
+embeddings) into an incremental generator:
+
+* **carry** — one preallocated pytree ``{layer: layer.decode_state(B,
+  max_len, dtype)}``: static-shape KV caches ``[B, H, max_len, d]`` with
+  per-row position counters for attention layers, ``(h, c)`` for the
+  recurrent ones. Threaded through ``forward_pure``'s ``rnn_state``
+  channel, so the model code is the SAME code that trains — decode is a
+  calling convention, not a fork of the forward.
+* **prefill** — the prompt runs once at a BUCKETED length (powers of two,
+  mirroring the serving engine's ``bucket_sizes()`` discipline) with a
+  validity mask for the right-pad, writing every position's K/V into the
+  cache; the first sampled token comes from the logits at each row's last
+  valid position. One compile per bucket, ever.
+* **decode** — each subsequent token is a ``[B, 1]`` forward against the
+  cache (``lax.dynamic_update_slice`` write + single-query flash decode
+  attention); ONE compiled shape for the whole generation regardless of
+  position, so no request ever pays a recompile mid-stream.
+
+Prefill/decode equivalence (greedy token-for-token identity with a full
+re-forward at every position) is enforced in tier-1
+``tests/test_generation.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layers.output import BaseOutputLayer
+from ..nn.activations import Activation
+from .sampling import sample_tokens
+
+_NEG = -1e30
+
+
+def bucket_length(n: int, limit: int) -> int:
+    """Smallest power-of-two >= n, capped at ``limit`` (the prompt-length
+    analog of ParallelInference._bucket: stable shapes, no recompiles)."""
+    b = 1
+    while b < n and b < limit:
+        b <<= 1
+    return min(b, limit)
+
+
+class GenerationSession:
+    def __init__(self, model, *, max_len: int = 256) -> None:
+        model._check_init()
+        migrate = getattr(model, "migrate_state", None)
+        if callable(migrate):
+            migrate()
+        self.model = model
+        self.max_len = int(max_len)
+        last = model.layers[-1]
+        if not isinstance(last, BaseOutputLayer):
+            raise ValueError("generation needs an output layer last")
+        self.vocab_size = int(last.n_out)
+        act = last.activation or Activation.SOFTMAX
+        self._out_is_probs = act == Activation.SOFTMAX
+        self._layer_names = model.layer_names()
+        self._fns: Dict = {}
+        # at least one layer must expose decode state, otherwise "decode"
+        # would silently re-run from scratch each step
+        if not any(l.decode_state(1, 1, model.dtype) for l in model.layers):
+            raise ValueError(
+                "no layer exposes decode_state — model cannot be decoded "
+                "incrementally (attention layers need causal=True)")
+
+    # ----- carry ------------------------------------------------------
+    def decode_state(self, batch: int):
+        """Fresh per-sequence decode carry for ``batch`` rows."""
+        out = {}
+        for name, layer in zip(self._layer_names, self.model.layers):
+            st = layer.decode_state(batch, self.max_len, self.model.dtype)
+            if st:
+                out[name] = st
+        return out
+
+    def bucket_sizes(self, limit: Optional[int] = None) -> List[int]:
+        """Prompt-length buckets a warmup should compile (powers of two up
+        to ``limit``, default ``max_len``)."""
+        limit = self.max_len if limit is None else min(limit, self.max_len)
+        sizes: List[int] = []
+        b = 1
+        while b < limit:
+            sizes.append(b)
+            b <<= 1
+        sizes.append(limit)
+        return sizes
+
+    # ----- model plumbing ---------------------------------------------
+    def _prep(self, ids: jax.Array) -> jax.Array:
+        """ids [b, t] -> model input: kept as int ids for embedding-first
+        models, one-hot [b, V, t] otherwise (the char-RNN convention)."""
+        ids = jnp.asarray(ids, jnp.int32)
+        if self.model.keeps_int_input():
+            return ids
+        oh = jax.nn.one_hot(ids, self.vocab_size, dtype=self.model.dtype)
+        return oh.transpose(0, 2, 1)
+
+    def _logits(self, out: jax.Array) -> jax.Array:
+        """Model output [b, V, t] -> per-position logits [b, V, t] (log of
+        probs for softmax outputs — equivalent under temperature scaling,
+        truncation and argmax; see sampling.py)."""
+        if self._out_is_probs:
+            return jnp.log(jnp.maximum(out, 1e-30))
+        return out
+
+    # ----- jitted steps -----------------------------------------------
+    def _prefill_fn(self, t_bucket: int):
+        key = ("prefill", t_bucket)
+        if key not in self._fns:
+            def fn(params, state, carry, ids, lengths):
+                mask = (jnp.arange(t_bucket, dtype=jnp.int32)[None, :]
+                        < lengths[:, None]).astype(self.model.dtype)
+                out, _, new_rnn = self.model.forward_pure(
+                    params, state, self._prep(ids), train=False, rng=None,
+                    mask=mask, rnn_state=carry)
+                logits = self._logits(out)  # [b, V, t]
+                last = jnp.take_along_axis(
+                    logits, (lengths - 1)[:, None, None].astype(jnp.int32),
+                    axis=2)[:, :, 0]  # [b, V]
+                return new_rnn, last
+
+            self._fns[key] = jax.jit(fn)
+        return self._fns[key]
+
+    def _decode_fn(self):
+        if "decode" not in self._fns:
+            def fn(params, state, carry, tokens):
+                out, _, new_rnn = self.model.forward_pure(
+                    params, state, self._prep(tokens[:, None]), train=False,
+                    rng=None, mask=None, rnn_state=carry)
+                return new_rnn, self._logits(out)[:, :, 0]
+
+            self._fns["decode"] = jax.jit(fn)
+        return self._fns["decode"]
+
+    def _write_row_fn(self):
+        """jit: scatter a 1-row carry (a fresh prefill) into slot ``i`` of
+        a B-row carry — the continuous-batching slot install."""
+        if "write_row" not in self._fns:
+            def fn(carry, row, i):
+                def put(c, r):
+                    z = jnp.zeros((), i.dtype)
+                    idx = (i,) + (z,) * (c.ndim - 1)
+                    return jax.lax.dynamic_update_slice(
+                        c, r.astype(c.dtype), idx)
+
+                return jax.tree_util.tree_map(put, carry, row)
+
+            self._fns["write_row"] = jax.jit(fn)
+        return self._fns["write_row"]
+
+    def _freeze_fn(self):
+        """jit: keep carry rows where ``active`` is False unchanged (an
+        idle slot must not advance its cache/positions)."""
+        if "freeze" not in self._fns:
+            def fn(new, old, active):
+                def sel(n, o):
+                    a = active.reshape((-1,) + (1,) * (n.ndim - 1))
+                    return jnp.where(a, n, o)
+
+                return jax.tree_util.tree_map(sel, new, old)
+
+            self._fns["freeze"] = jax.jit(fn)
+        return self._fns["freeze"]
+
+    # ----- host API ----------------------------------------------------
+    def prefill(self, prompts: Sequence[Sequence[int]], *, batch: Optional[int] = None):
+        """Run the (ragged) prompts through the model once, building the
+        decode carry. Returns ``(carry, logits [b, V], lengths [b])`` with
+        prompts right-padded to the shared bucket length."""
+        lengths = np.asarray([len(p) for p in prompts], np.int32)
+        if lengths.min() < 1:
+            raise ValueError("empty prompt")
+        b = len(prompts) if batch is None else batch
+        tb = bucket_length(int(lengths.max()), self.max_len)
+        ids = np.zeros((b, tb), np.int32)
+        for i, p in enumerate(prompts):
+            ids[i, : len(p)] = np.asarray(p, np.int32)
+        lens = np.ones((b,), np.int32)
+        lens[: len(prompts)] = lengths
+        carry = self.decode_state(b)
+        carry, logits = self._prefill_fn(tb)(
+            self.model.params, self.model.state, carry,
+            jnp.asarray(ids), jnp.asarray(lens))
+        return carry, logits, lens
+
+    def decode(self, carry, tokens):
+        """One incremental step: ``tokens [b]`` -> (carry', logits [b, V])."""
+        return self._decode_fn()(self.model.params, self.model.state, carry,
+                                 jnp.asarray(tokens, jnp.int32))
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_tokens: int,
+        *,
+        greedy: bool = True,
+        temperature: float = 1.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int = 0,
+        eos_id: Optional[int] = None,
+    ) -> List[List[int]]:
+        """Convenience batch generation (the serving engine drives the
+        prefill/decode primitives itself for continuous batching). Stops a
+        row at ``eos_id`` or ``max_tokens``, never past ``max_len``."""
+        b = len(prompts)
+        carry, logits, lens = self.prefill(prompts)
+        seeds = jnp.full((b,), seed, jnp.uint32) + jnp.arange(b, dtype=jnp.uint32)
+        gmask = jnp.full((b,), bool(greedy))
+        temps = jnp.full((b,), temperature, jnp.float32)
+        ks = jnp.full((b,), top_k, jnp.int32)
+        ps = jnp.full((b,), top_p, jnp.float32)
+        out: List[List[int]] = [[] for _ in range(b)]
+        done = [False] * b
+        pos = lens.copy()
+        tokens = None
+        for step in range(max_tokens):
+            if tokens is None:
+                toks = sample_tokens(logits, seeds,
+                                     jnp.zeros((b,), jnp.int32),
+                                     gmask, temps, ks, ps)
+            else:
+                carry, logits = self.decode(carry, tokens)
+                toks = sample_tokens(logits, seeds,
+                                     jnp.full((b,), step, jnp.int32),
+                                     gmask, temps, ks, ps)
+            toks_h = np.asarray(toks)
+            for i in range(b):
+                if done[i]:
+                    continue
+                t = int(toks_h[i])
+                out[i].append(t)
+                pos[i] += 1
+                if (eos_id is not None and t == eos_id) or pos[i] >= self.max_len:
+                    done[i] = True
+            if all(done):
+                break
+            tokens = toks
+        return out

@@ -4,6 +4,7 @@ No pretrained-weight downloads (zero-egress environment); architectures are
 construction-parity with the reference and train from scratch.
 """
 
+from ...generate.sampling import greedy, temperature, top_k, top_p
 from .bert import BertEncoder
 from .darknet import Darknet19, TinyYOLO
 from .inception_resnet import InceptionResNetV1
@@ -12,6 +13,7 @@ from .misc import FaceNetNN4Small2, SimpleCNN, YOLO2
 from .resnet50 import ResNet50
 from .squeezenet import SqueezeNet
 from .textgen_lstm import TextGenerationLSTM
+from .transformer_lm import TransformerLM
 from .unet import UNet
 from .vgg16 import AlexNet, VGG16, VGG19
 from .xception import Xception
@@ -29,7 +31,12 @@ __all__ = [
     "SqueezeNet",
     "TextGenerationLSTM",
     "TinyYOLO",
+    "TransformerLM",
     "UNet",
+    "greedy",
+    "temperature",
+    "top_k",
+    "top_p",
     "VGG16",
     "VGG19",
     "YOLO2",

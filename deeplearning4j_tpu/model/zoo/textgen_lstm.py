@@ -3,10 +3,15 @@
 Reference: org.deeplearning4j.zoo.model.TextGenerationLSTM
 (BASELINE.json:9, "GravesLSTM char-RNN"): stacked GravesLSTM (peephole)
 layers over one-hot character input with an RnnOutputLayer, trained via
-truncated BPTT.
+truncated BPTT. :meth:`generate` adds the sampling path the reference
+example script hand-rolled: seeded greedy/temperature/top-k/top-p
+decoding over the carried (h, c) state — the prompt is consumed once and
+each further character costs one single-step forward.
 """
 
 from __future__ import annotations
+
+from typing import List, Optional, Sequence
 
 from ...nn import Activation, InputType, LossFunction, NeuralNetConfiguration, WeightInit
 from ...nn.conf import BackpropType
@@ -45,3 +50,27 @@ class TextGenerationLSTM:
 
     def init(self) -> MultiLayerNetwork:
         return MultiLayerNetwork(self.conf()).init()
+
+    @staticmethod
+    def generate(
+        model: MultiLayerNetwork,
+        prompts: Sequence[Sequence[int]],
+        max_tokens: int,
+        *,
+        max_len: int = 256,
+        greedy: bool = True,
+        temperature: float = 1.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int = 0,
+        eos_id: Optional[int] = None,
+    ) -> List[List[int]]:
+        """Sample continuations for character-id prompts from a trained
+        char-RNN (ids one-hot encoded internally; the recurrent (h, c)
+        carry threads through the decode so the prefix never re-runs)."""
+        from ...generate import GenerationSession
+
+        session = GenerationSession(model, max_len=max_len)
+        return session.generate(
+            prompts, max_tokens, greedy=greedy, temperature=temperature,
+            top_k=top_k, top_p=top_p, seed=seed, eos_id=eos_id)

@@ -1,0 +1,73 @@
+"""TransformerLM — causal decoder-only language model for autoregressive
+generation serving.
+
+The zoo's BERT is bidirectional (MLM) and lives on the ComputationGraph,
+which has no transient-state carry — neither can be decoded
+incrementally. This model is the KV-cache-native counterpart: a
+sequential stack of pre-LN causal :class:`TransformerDecoderBlockLayer`
+blocks (residuals internal), so the ``rnn_state`` channel threads one
+static-shape KV cache per block through
+:class:`~deeplearning4j_tpu.generate.session.GenerationSession`.
+
+GPT-style layout: token embedding + learned positional embedding →
+N causal blocks → final LayerNorm → softmax over the vocab (trainable
+with SPARSE_MCXENT next-token labels).
+"""
+
+from __future__ import annotations
+
+from ...nn import Activation, LossFunction, NeuralNetConfiguration, WeightInit
+from ...nn.layers import (
+    EmbeddingSequenceLayer,
+    LayerNormLayer,
+    PositionalEmbeddingLayer,
+    RnnOutputLayer,
+    TransformerDecoderBlockLayer,
+)
+from ...nn.sequential import MultiLayerNetwork
+from ...train.updaters import Adam
+
+
+class TransformerLM:
+    def __init__(
+        self,
+        vocab_size: int = 1000,
+        hidden: int = 256,
+        n_layers: int = 4,
+        n_heads: int = 4,
+        ffn_size: int = 0,
+        max_len: int = 256,
+        seed: int = 123,
+        updater=None,
+        dtype: str = "float32",
+    ) -> None:
+        self.vocab_size = vocab_size
+        self.hidden = hidden
+        self.n_layers = n_layers
+        self.n_heads = n_heads
+        self.ffn_size = ffn_size or 4 * hidden
+        self.max_len = max_len
+        self.seed = seed
+        self.updater = updater or Adam(1e-4)
+        self.dtype = dtype
+
+    def conf(self):
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed).data_type(self.dtype).updater(self.updater)
+             .weight_init(WeightInit.XAVIER).list())
+        b.layer(EmbeddingSequenceLayer(n_in=self.vocab_size,
+                                       n_out=self.hidden))
+        b.layer(PositionalEmbeddingLayer(n_out=self.hidden,
+                                         max_len=self.max_len))
+        for _ in range(self.n_layers):
+            b.layer(TransformerDecoderBlockLayer(
+                n_in=self.hidden, n_heads=self.n_heads,
+                ffn_size=self.ffn_size))
+        b.layer(LayerNormLayer(n_out=self.hidden))
+        b.layer(RnnOutputLayer(n_in=self.hidden, n_out=self.vocab_size,
+                               loss=LossFunction.SPARSE_MCXENT,
+                               activation=Activation.SOFTMAX))
+        return b.build()
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()

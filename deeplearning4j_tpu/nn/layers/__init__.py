@@ -3,6 +3,7 @@ from .attention import (
     LearnedSelfAttentionLayer,
     RecurrentAttentionLayer,
     SelfAttentionLayer,
+    TransformerDecoderBlockLayer,
     dot_product_attention,
 )
 from .conv import (

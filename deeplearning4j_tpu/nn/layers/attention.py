@@ -35,6 +35,7 @@ def dot_product_attention(
     v: jax.Array,  # [b, h, tk, dv]
     mask: Optional[jax.Array] = None,  # [b, tk]
     scaled: bool = True,
+    causal: bool = False,
 ) -> jax.Array:
     # Routed through the helper seam (ops.mha_attention): builtin XLA einsum
     # path or the Pallas flash kernel, mirroring the reference's per-layer
@@ -42,7 +43,42 @@ def dot_product_attention(
     from ...ops import mha_attention
 
     scale = 1.0 / math.sqrt(q.shape[-1]) if scaled else 1.0
-    return mha_attention(q, k, v, mask=mask, scale=scale)
+    return mha_attention(q, k, v, mask=mask, scale=scale, causal=causal)
+
+
+def _cache_write(cache: jax.Array, new: jax.Array, pos: jax.Array) -> jax.Array:
+    """Write ``new`` [b, h, t, d] into the static-shape cache [b, h, L, d]
+    at per-row positions ``pos + [0, t)`` — the position-indexed
+    ``lax.dynamic_update_slice`` that keeps every decode step the same
+    compiled shape regardless of how far each sequence has advanced."""
+    def row(c, n, p):
+        z = jnp.zeros((), p.dtype)  # homogeneous index dtypes (x64-safe)
+        return jax.lax.dynamic_update_slice(c, n, (z, p, z))
+
+    return jax.vmap(row)(cache, new.astype(cache.dtype),
+                         pos.astype(jnp.int32))
+
+
+def _cached_attention(q, k_new, v_new, state, mask):
+    """Shared KV-cache attention step: write this call's K/V into the
+    cache at each row's position, then attend causally against the cache.
+    Returns (output, new_state). ``mask`` (the prompt's [b, t] validity
+    mask) bounds how far ``pos`` advances, so right-padded prefill rows
+    keep their true length and the pad slots are overwritten by later
+    decode steps before anything ever attends to them."""
+    from ...ops import decode_attention
+
+    t = q.shape[2]
+    pos = state["pos"].astype(jnp.int32)
+    cache_k = _cache_write(state["cache_k"], k_new, pos)
+    cache_v = _cache_write(state["cache_v"], v_new, pos)
+    # query i at absolute position pos+i attends cache [0, pos+i]; the
+    # single-token hot path (t == 1) dispatches to the flash decode kernel
+    o = decode_attention(q, cache_k, cache_v, pos)
+    valid = (jnp.asarray(t, jnp.int32) if mask is None
+             else jnp.sum(mask > 0, axis=1).astype(jnp.int32))
+    new_state = {"cache_k": cache_k, "cache_v": cache_v, "pos": pos + valid}
+    return o, new_state
 
 
 def _split_heads(x: jax.Array, n_heads: int) -> jax.Array:
@@ -59,13 +95,21 @@ def _merge_heads(x: jax.Array) -> jax.Array:
 @dataclasses.dataclass(frozen=True, kw_only=True)
 class SelfAttentionLayer(Layer):
     """Multi-head dot-product self-attention (reference: SelfAttentionLayer).
-    Input/output [b, f, t]. With ``project_input`` learns Wq/Wk/Wv/Wo."""
+    Input/output [b, f, t]. With ``project_input`` learns Wq/Wk/Wv/Wo.
+
+    ``causal=True`` masks attention to positions <= the query's (an
+    autoregressive decoder block) and unlocks the KV-cached incremental
+    decode path: when the per-sequence decode carry from
+    :meth:`decode_state` is threaded in through ``apply``'s state, each
+    call writes its K/V into the static-shape cache and attends against
+    it instead of re-running the prefix."""
 
     n_in: int = 0
     n_out: int = 0
     n_heads: int = 1
     head_size: int = 0
     project_input: bool = True
+    causal: bool = False
 
     def __post_init__(self):
         if self.n_out and not self.head_size:
@@ -104,6 +148,16 @@ class SelfAttentionLayer(Layer):
             "Wo": init_weights(ks[3], (hs, self.n_out), wi, hs, self.n_out, None, dtype),
         }
 
+    def decode_state(self, batch: int, max_len: int, dtype: Any) -> State:
+        if not self.causal:
+            return {}  # bidirectional attention has no incremental decode
+        d = (self.head_size if self.project_input
+             else self.n_in // self.n_heads)
+        shape = (batch, self.n_heads, max_len, d)
+        return {"cache_k": jnp.zeros(shape, dtype),
+                "cache_v": jnp.zeros(shape, dtype),
+                "pos": jnp.zeros((batch,), jnp.int32)}
+
     def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
         x = apply_input_dropout(self, x, ctx)
         xt = x.transpose(0, 2, 1)  # [b, t, f]
@@ -113,12 +167,21 @@ class SelfAttentionLayer(Layer):
             v = _split_heads(xt @ params["Wv"], self.n_heads)
         else:
             q = k = v = _split_heads(xt, self.n_heads)
-        o = dot_product_attention(q, k, v, mask=ctx.mask)
+        if "cache_k" in state:
+            if not self.causal:
+                raise ValueError(
+                    "KV-cached decode requires causal=True — bidirectional "
+                    "attention cannot be decoded incrementally")
+            o, new_state = _cached_attention(q, k, v, state, ctx.mask)
+        else:
+            o = dot_product_attention(q, k, v, mask=ctx.mask,
+                                      causal=self.causal)
+            new_state = state
         o = _merge_heads(o)
         if self.project_input:
             o = o @ params["Wo"]
         act = self.activation or Activation.IDENTITY
-        return act(o).transpose(0, 2, 1), state
+        return act(o).transpose(0, 2, 1), new_state
 
 
 @register_config
@@ -198,13 +261,21 @@ class LearnedSelfAttentionLayer(Layer):
 @register_config
 @dataclasses.dataclass(frozen=True, kw_only=True)
 class RecurrentAttentionLayer(Layer):
-    """Recurrent cell attending over the full input sequence at each step
+    """Recurrent cell attending over the input sequence at each step
     (reference: RecurrentAttentionLayer): h_t = act(x_t W + h_{t-1} RW +
-    attn(h_{t-1}, X) Wa + b)."""
+    attn(h_{t-1}, X) Wa + b).
+
+    The ``h`` carry threads through ``apply`` state (rnnTimeStep
+    semantics — streaming calls resume instead of re-running the prefix).
+    ``causal=True`` restricts step t's attention to inputs [0, t] — the
+    autoregressive mode required for incremental decode, where the decode
+    carry from :meth:`decode_state` additionally caches past inputs so a
+    single-step call attends over everything seen so far."""
 
     n_in: int = 0
     n_out: int = 0
     n_heads: int = 1
+    causal: bool = False
 
     def output_type(self, input_type: InputType) -> InputType:
         return RecurrentType(size=self.n_out, timesteps=input_type.timesteps)
@@ -230,26 +301,161 @@ class RecurrentAttentionLayer(Layer):
             "b": jnp.full((self.n_out,), self.bias_init, dtype),
         }
 
+    def decode_state(self, batch: int, max_len: int, dtype: Any) -> State:
+        if not self.causal:
+            return {}  # future-peeking attention has no incremental decode
+        return {"h": jnp.zeros((batch, self.n_out), dtype),
+                "cache_x": jnp.zeros((batch, max_len, self.n_in), dtype),
+                "pos": jnp.zeros((batch,), jnp.int32)}
+
     def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
         x = apply_input_dropout(self, x, ctx)
         b, f, t = x.shape
         act = self.activation or Activation.TANH
         xt = x.transpose(2, 0, 1)  # [t, b, f]
         x_proj = jnp.einsum("tbf,fo->tbo", xt, params["W"]) + params["b"]
-        keys = x.transpose(0, 2, 1)  # [b, t, f]
         mask = ctx.mask
+        cache = state.get("cache_x")
+        if cache is not None and not self.causal:
+            raise ValueError("cached decode requires causal=True — a step "
+                             "cannot attend inputs that do not exist yet")
+        pos = None
+        if cache is None:
+            keys = x.transpose(0, 2, 1)  # [b, t, f]
+        else:
+            pos = state["pos"].astype(jnp.int32)
+            keys = jax.vmap(lambda c, n, p: jax.lax.dynamic_update_slice(
+                c, n, (p, jnp.zeros((), p.dtype))))(
+                    cache, x.transpose(0, 2, 1).astype(cache.dtype), pos)
+        t_keys = keys.shape[1]
+        neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
 
-        def step(h, xp):
-            # attention of h over the input sequence
+        # freeze h through right-pad steps only on the cached (prefill)
+        # path: the full-sequence training path keeps its semantics; the
+        # padding-key mask only applies when keys == this call's input
+        # (cached keys are masked by the causal frontier instead)
+        use_m = mask is not None and cache is not None
+
+        def step(h, inp):
+            if use_m:
+                xp, i, m = inp
+            else:
+                (xp, i), m = inp, None
+            # attention of h over the (cached) input sequence
             scores = jnp.einsum("bo,fo,btf->bt", h, params["Wa"], keys) / math.sqrt(f)
-            if mask is not None:
-                neg = jnp.asarray(jnp.finfo(scores.dtype).min, scores.dtype)
+            if mask is not None and cache is None:
                 scores = jnp.where(mask > 0, scores, neg)
+            if self.causal:
+                limit = i if pos is None else pos[:, None] + i
+                ids = jnp.arange(t_keys, dtype=jnp.int32)[None, :]
+                scores = jnp.where(ids <= limit, scores, neg)
             w = jax.nn.softmax(scores, axis=-1)
             attended = jnp.einsum("bt,btf->bf", w, keys)  # [b, f]
             h_new = act(xp + h @ params["RW"] + attended @ params["Wa"])
+            if m is not None:
+                mm = m[:, None]
+                h_new = mm * h_new + (1.0 - mm) * h
             return h_new, h_new
 
-        h0 = jnp.zeros((b, self.n_out), x.dtype)
-        _, hs = jax.lax.scan(step, h0, x_proj)
-        return hs.transpose(1, 2, 0), state
+        h0 = state.get("h")
+        if h0 is None:
+            h0 = jnp.zeros((b, self.n_out), x.dtype)
+        steps = jnp.arange(t, dtype=jnp.int32)
+        xs = ((x_proj, steps, mask.T.astype(x.dtype)) if use_m
+              else (x_proj, steps))
+        h_f, hs = jax.lax.scan(step, h0, xs)
+        out_state: State = {"h": h_f}
+        if cache is not None:
+            valid = (jnp.asarray(t, jnp.int32) if mask is None
+                     else jnp.sum(mask > 0, axis=1).astype(jnp.int32))
+            out_state.update({"cache_x": keys, "pos": pos + valid})
+        return hs.transpose(1, 2, 0), out_state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class TransformerDecoderBlockLayer(Layer):
+    """Pre-LN causal transformer decoder block as ONE sequential layer:
+    x + CausalAttn(LN(x)), then x + FFN(LN(x)) — residuals internal, so
+    autoregressive stacks compose in a MultiLayerNetwork (whose
+    ``rnn_state`` channel threads the KV cache; ComputationGraph has no
+    transient-state carry). Input/output [b, n_in, t].
+
+    Decode: :meth:`decode_state` preallocates the static-shape
+    ``[b, heads, max_len, head_dim]`` K/V cache + position counter; with
+    it threaded in, each ``apply`` writes the new K/V at the per-row
+    position (``lax.dynamic_update_slice``) and runs single-query flash
+    decode attention against the cache — the prefix is never re-run."""
+
+    n_in: int = 0
+    n_heads: int = 1
+    ffn_size: int = 0
+    eps: float = 1e-5
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return RecurrentType(size=self.n_in, timesteps=input_type.timesteps)
+
+    def with_input(self, input_type: InputType) -> "TransformerDecoderBlockLayer":
+        out = self
+        if not out.n_in:
+            out = dataclasses.replace(out, n_in=input_type.size)
+        if not out.ffn_size:
+            out = dataclasses.replace(out, ffn_size=4 * out.n_in)
+        return out
+
+    def has_params(self) -> bool:
+        return True
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return ("ln1_g", "ln1_b", "Wq", "Wk", "Wv", "Wo",
+                "ln2_g", "ln2_b", "W1", "b1", "W2", "b2")
+
+    def weight_param_names(self) -> Tuple[str, ...]:
+        return ("Wq", "Wk", "Wv", "Wo", "W1", "W2")
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        wi = self.weight_init or WeightInit.XAVIER
+        h, ffn = self.n_in, self.ffn_size
+        ks = jax.random.split(key, 6)
+        return {
+            "ln1_g": jnp.ones((h,), dtype), "ln1_b": jnp.zeros((h,), dtype),
+            "Wq": init_weights(ks[0], (h, h), wi, h, h, None, dtype),
+            "Wk": init_weights(ks[1], (h, h), wi, h, h, None, dtype),
+            "Wv": init_weights(ks[2], (h, h), wi, h, h, None, dtype),
+            "Wo": init_weights(ks[3], (h, h), wi, h, h, None, dtype),
+            "ln2_g": jnp.ones((h,), dtype), "ln2_b": jnp.zeros((h,), dtype),
+            "W1": init_weights(ks[4], (h, ffn), wi, h, ffn, None, dtype),
+            "b1": jnp.zeros((ffn,), dtype),
+            "W2": init_weights(ks[5], (ffn, h), wi, ffn, h, None, dtype),
+            "b2": jnp.zeros((h,), dtype),
+        }
+
+    def decode_state(self, batch: int, max_len: int, dtype: Any) -> State:
+        d = self.n_in // self.n_heads
+        shape = (batch, self.n_heads, max_len, d)
+        return {"cache_k": jnp.zeros(shape, dtype),
+                "cache_v": jnp.zeros(shape, dtype),
+                "pos": jnp.zeros((batch,), jnp.int32)}
+
+    def _ln(self, x, g, b):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + self.eps) * g + b
+
+    def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        x = apply_input_dropout(self, x, ctx)
+        xt = x.transpose(0, 2, 1)  # [b, t, h]
+        h1 = self._ln(xt, params["ln1_g"], params["ln1_b"])
+        q = _split_heads(h1 @ params["Wq"], self.n_heads)
+        k = _split_heads(h1 @ params["Wk"], self.n_heads)
+        v = _split_heads(h1 @ params["Wv"], self.n_heads)
+        if "cache_k" in state:
+            o, new_state = _cached_attention(q, k, v, state, ctx.mask)
+        else:
+            o = dot_product_attention(q, k, v, mask=ctx.mask, causal=True)
+            new_state = state
+        r1 = xt + _merge_heads(o) @ params["Wo"]
+        h2 = self._ln(r1, params["ln2_g"], params["ln2_b"])
+        act = self.activation or Activation.GELU
+        ffn = act(h2 @ params["W1"] + params["b1"]) @ params["W2"] + params["b2"]
+        return (r1 + ffn).transpose(0, 2, 1), new_state

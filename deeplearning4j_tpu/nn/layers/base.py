@@ -93,6 +93,18 @@ class Layer:
     def init_state(self, dtype: Any) -> State:
         return {}
 
+    def decode_state(self, batch: int, max_len: int, dtype: Any) -> State:
+        """Transient per-sequence carry for incremental autoregressive
+        decode: a static-shape KV cache + position counter for attention
+        layers, the (h, c) recurrent carry for RNN layers, a position
+        offset for positional embeddings. Threaded through ``apply`` via
+        the ``rnn_state`` channel (never persisted), so one preallocated
+        pytree serves an entire generation — shapes depend only on
+        ``(batch, max_len)``, never on how far decoding has advanced.
+        Layers without decode-time state return {} (stateless layers are
+        applied per step as-is)."""
+        return {}
+
     def has_params(self) -> bool:
         return False
 

@@ -236,9 +236,22 @@ class PositionalEmbeddingLayer(Layer):
     def init(self, key: jax.Array, dtype: Any) -> Params:
         return {"P": 0.02 * jax.random.normal(key, (self.max_len, self.n_out), dtype)}
 
+    def decode_state(self, batch: int, max_len: int, dtype: Any) -> State:
+        # incremental decode needs each row's absolute position to pick the
+        # right embedding for a single-token step
+        return {"pos": jnp.zeros((batch,), jnp.int32)}
+
     def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
         t = x.shape[-1]
-        return x + params["P"][:t].T[None], state
+        pos = state.get("pos")
+        if pos is None:
+            return x + params["P"][:t].T[None], state
+        pos = pos.astype(jnp.int32)
+        idx = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]  # [b, t]
+        pe = jnp.take(params["P"], jnp.clip(idx, 0, self.max_len - 1), axis=0)
+        valid = (jnp.asarray(t, jnp.int32) if ctx.mask is None
+                 else jnp.sum(ctx.mask > 0, axis=1).astype(jnp.int32))
+        return x + pe.transpose(0, 2, 1), {"pos": pos + valid}
 
 
 @register_config

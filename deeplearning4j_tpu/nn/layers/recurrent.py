@@ -135,6 +135,11 @@ class LSTMLayer(Layer):
             p["P"] = 0.01 * jax.random.normal(k3, (3, self.n_out), dtype)
         return p
 
+    def decode_state(self, batch: int, max_len: int, dtype: Any) -> State:
+        # the LSTM decode carry is just (h, c) — no per-position cache
+        return {"h": jnp.zeros((batch, self.n_out), dtype),
+                "c": jnp.zeros((batch, self.n_out), dtype)}
+
     def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
         x = apply_input_dropout(self, x, ctx)
         b, _, t = x.shape
@@ -216,6 +221,9 @@ class GRULayer(Layer):
                           self.weight_init_distribution, dtype)
         b_shape = (2, 3 * n) if self.reset_after else (3 * n,)
         return {"W": w, "RW": rw, "b": jnp.zeros(b_shape, dtype)}
+
+    def decode_state(self, batch: int, max_len: int, dtype: Any) -> State:
+        return {"h": jnp.zeros((batch, self.n_out), dtype)}
 
     def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
         x = apply_input_dropout(self, x, ctx)
@@ -302,6 +310,9 @@ class SimpleRnnLayer(Layer):
                                self.weight_init_distribution, dtype),
             "b": jnp.full((self.n_out,), self.bias_init, dtype),
         }
+
+    def decode_state(self, batch: int, max_len: int, dtype: Any) -> State:
+        return {"h": jnp.zeros((batch, self.n_out), dtype)}
 
     def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
         x = apply_input_dropout(self, x, ctx)

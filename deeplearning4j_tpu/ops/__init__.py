@@ -18,7 +18,10 @@ from .helpers import (
 )
 from .flash_attention import (
     attention_impl,
+    decode_attention,
+    decode_attention_reference,
     flash_attention,
+    flash_decode_attention,
     mha_attention,
     mha_attention_reference,
     set_attention_impl,
@@ -33,6 +36,9 @@ from .moe_dispatch import (
 
 __all__ = [
     "attention_impl",
+    "decode_attention",
+    "decode_attention_reference",
+    "flash_decode_attention",
     "available_helpers",
     "get_helper",
     "helper_name",

@@ -659,6 +659,164 @@ def flash_attention(
                   bwd_block_q, bwd_block_k, interpret)
 
 
+# ---------------------------------------------------------------------------
+# KV-cache decode attention (single-query-block flash)
+# ---------------------------------------------------------------------------
+
+
+def decode_attention_reference(
+    q: jax.Array,           # [b, h, tq, d] — queries at positions start+i
+    k: jax.Array,           # [b, h, L, d]  — static-shape KV cache
+    v: jax.Array,           # [b, h, L, dv]
+    start_pos: jax.Array,   # [b] int32 — absolute position of q's first row
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Builtin XLA decode attention against a cached K/V: query ``i`` of row
+    ``b`` sits at absolute position ``start_pos[b] + i`` and attends cache
+    entries ``[0, start_pos[b] + i]`` inclusive. Cache slots past the
+    frontier (pad garbage, not-yet-written zeros) are masked out, so the
+    cache can stay a fixed ``[b, h, max_len, d]`` allocation for the whole
+    generation — no shape ever depends on how far decoding has advanced."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    tq, L = q.shape[2], k.shape[2]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    q_ids = jax.lax.broadcasted_iota(jnp.int32, (tq, L), 0)
+    k_ids = jax.lax.broadcasted_iota(jnp.int32, (tq, L), 1)
+    limit = start_pos.astype(jnp.int32)[:, None, None, None] + q_ids[None, None]
+    keep = k_ids[None, None] <= limit
+    neg = jnp.asarray(_NEG, scores.dtype)
+    scores = jnp.where(keep, scores, neg)
+    weights = jax.nn.softmax(scores, axis=-1)
+    # rows with no valid key (start_pos < 0 — an inactive slot) output 0
+    any_valid = jnp.any(scores > _NEG * 0.5, axis=-1, keepdims=True)
+    weights = jnp.where(any_valid, weights, 0.0)
+    return jnp.einsum("bhqk,bhkv->bhqv", weights, v)
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+                   acc_scr, *, scale, block_k):
+    """One (batch·head, k-block) grid step of single-query flash decode.
+
+    The k axis is the innermost (sequential) grid dim so the VMEM online-
+    softmax accumulators carry across k blocks, exactly like the training
+    forward kernel — but the q block is a single row (the token being
+    decoded) and the valid cache length arrives as an SMEM scalar, so
+    k-blocks entirely past the decode frontier skip their matmuls: the
+    per-step work is O(position), not O(max_len)."""
+    ki = pl.program_id(1)
+    length = len_ref[0, 0]  # valid cache entries = start_pos + 1
+
+    @pl.when(ki == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr, _NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(ki * block_k < length)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * scale    # [1, d]
+        ks = k_ref[0].astype(jnp.float32)           # [block_k, d]
+        vs = v_ref[0].astype(jnp.float32)           # [block_k, dv]
+        s = jax.lax.dot_general(
+            q, ks, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)     # [1, block_k]
+        k_ids = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        s = jnp.where(k_ids < length, s, _NEG)
+        m, l, acc = m_scr[...], l_scr[...], acc_scr[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(s > _NEG * 0.5, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        m_scr[...] = m_new
+        l_scr[...] = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc * alpha + jax.lax.dot_general(
+            p, vs, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == pl.num_programs(1) - 1)
+    def _():
+        o_ref[0] = (acc_scr[...] /
+                    jnp.maximum(l_scr[...], 1e-30)).astype(o_ref.dtype)
+
+
+def flash_decode_attention(
+    q: jax.Array,           # [b, h, 1, d]
+    k: jax.Array,           # [b, h, L, d]
+    v: jax.Array,           # [b, h, L, dv]
+    start_pos: jax.Array,   # [b] int32
+    scale: Optional[float] = None,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Pallas single-query-block decode attention (same contract as
+    :func:`decode_attention_reference` with ``tq == 1``)."""
+    if q.shape[2] != 1:
+        raise ValueError("flash_decode_attention is the tq=1 kernel; use "
+                         "decode_attention for multi-row queries")
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if _VMEM is None:  # jaxlib without pallas TPU support
+        return decode_attention_reference(q, k, v, start_pos, scale=scale)
+    b, h, _, d = q.shape
+    L, dv = k.shape[2], v.shape[3]
+    block_k = min(block_k, max(L, 1))
+    kp = _pad_to(k, 2, block_k)
+    vp = _pad_to(v, 2, block_k)
+    L_p = kp.shape[2]
+    qp = q.reshape(b * h, 1, d)
+    kp = kp.reshape(b * h, L_p, d)
+    vp = vp.reshape(b * h, L_p, dv)
+    lengths = (start_pos.astype(jnp.int32) + 1).reshape(b, 1)
+
+    kern = functools.partial(_decode_kernel, scale=float(scale),
+                             block_k=block_k)
+    kw = dict(memory_space=_VMEM)
+    out = pl.pallas_call(
+        kern,
+        grid=(b * h, L_p // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda bh, ki, _h=h: (bh // _h, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, d), lambda bh, ki: (bh, 0, 0), **kw),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0), **kw),
+            pl.BlockSpec((1, block_k, dv), lambda bh, ki: (bh, ki, 0), **kw),
+        ],
+        out_specs=pl.BlockSpec((1, 1, dv), lambda bh, ki: (bh, 0, 0), **kw),
+        out_shape=jax.ShapeDtypeStruct((b * h, 1, dv), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, dv), jnp.float32),
+        ],
+        interpret=interpret,
+    )(lengths, qp, kp, vp)
+    return out.reshape(b, h, 1, dv)
+
+
+def decode_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    start_pos: jax.Array,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Helper-seam dispatch for KV-cache decode attention (mirrors
+    :func:`mha_attention`): the Pallas single-query kernel when "flash" is
+    selected (or automatically on TPU) and the single-row query fits it,
+    the builtin XLA spelling otherwise. ``set_attention_impl`` switches
+    every decode step in the process, so flash-vs-reference parity checks
+    run the same model code both ways."""
+    impl = _IMPL
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() == "tpu" else "xla"
+    if impl == "flash" and q.shape[2] == 1:
+        return flash_decode_attention(q, k, v, start_pos, scale=scale)
+    return decode_attention_reference(q, k, v, start_pos, scale=scale)
+
+
 def mha_attention(
     q: jax.Array,
     k: jax.Array,

@@ -38,6 +38,7 @@ from .pipeline import (dense_block_stage, pipeline_apply,
                        pipeline_stages_init, shard_stage_params)
 from .trainer import DistributedTrainer, moe_expert_parallel_rules
 from .inference import InferenceMode, ParallelInference, Servable
+from .decode import DecodeEngine, GenerationHandle
 
 __all__ = [
     "ShardedEmbeddingTable",

@@ -1,0 +1,574 @@
+"""DecodeEngine — continuous-batching autoregressive decode serving.
+
+The generation counterpart of :class:`~deeplearning4j_tpu.parallel.
+inference.ParallelInference`: where that engine batches INDEPENDENT
+one-shot forwards, this one multiplexes LONG-LIVED sequences at
+different positions into one static-shape KV cache.
+
+Engine loop (one worker thread, the decode analog of the reference's
+batching observable):
+
+* **admit** — pending requests (fail-fast admitted through the shared
+  :class:`~deeplearning4j_tpu.core.resilience.AdmissionController`; full
+  window sheds with ``AdmissionRejectedError`` -> HTTP 503 + Retry-After)
+  claim free cache slots. Each prefills at a BUCKETED prompt length
+  (``session.bucket_sizes()``, mirroring the server's batch buckets) and
+  its 1-row carry is scattered into the slot — arriving requests never
+  stall sequences mid-generation for longer than one prefill.
+* **step** — ONE ``[B, 1]`` forward advances every active slot (rows at
+  completely different positions share the compiled step; idle/finished
+  rows are frozen by an active mask), per-row seeded sampling picks each
+  next token, and tokens stream to per-request event queues.
+* **retire** — eos / ``max_tokens`` / ``max_len`` complete a request;
+  an expired :class:`Deadline` terminates it cleanly mid-stream with
+  partial output (reason "deadline"); a cancelled handle (client
+  disconnect) frees its slot on the next loop turn. Retirement releases
+  the admission slot — cache capacity is never leaked to dead clients.
+
+Failures run through a :class:`CircuitBreaker`: a poisoned decode step
+fails the affected requests and opens the breaker, so new submits shed
+instead of queueing behind a broken jit.
+
+Observability: ``dl4j_tpu_generate_tokens_total``, per-token decode
+latency + prefill latency histograms and an in-flight-sequences gauge in
+the registry; traced requests get ``engine.prefill`` and
+``engine.decode`` child spans in ``/v1/traces``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.resilience import (
+    AdmissionController,
+    CircuitBreaker,
+    CircuitOpenError,
+    CircuitState,
+    Deadline,
+)
+from ..generate.sampling import sample_tokens
+from ..generate.session import GenerationSession
+from ..obs.metrics import MetricsRegistry, get_registry
+from ..obs.tracing import Tracer, current_context, get_tracer, trace_now
+
+_engine_seq = itertools.count()
+
+_OUTCOMES = ("completed", "deadline", "cancelled", "shed", "failed",
+             "circuit_rejected")
+
+
+class GenerationHandle:
+    """Per-request streaming handle: the engine pushes ``{"token", "index"}``
+    events and one terminal ``{"done": True, "reason", "count"}`` event;
+    the consumer iterates :meth:`events` (a server handler streams them as
+    chunks) or blocks on :meth:`result`. :meth:`cancel` (e.g. on client
+    disconnect) asks the engine to retire the request and free its cache
+    slot at the next loop turn."""
+
+    def __init__(self, request_id: str, deadline: Deadline) -> None:
+        self.request_id = request_id
+        self.deadline = deadline
+        self.tokens: List[int] = []
+        self.reason: Optional[str] = None
+        self._events: "queue.Queue[dict]" = queue.Queue()
+        self._done = threading.Event()
+        self._cancelled = threading.Event()
+
+    # ----- engine side -----
+    def _emit(self, index: int, token: int) -> None:
+        self.tokens.append(int(token))
+        self._events.put({"token": int(token), "index": int(index)})
+
+    def _finish(self, reason: str, error: Optional[str] = None) -> None:
+        self.reason = reason
+        ev = {"done": True, "reason": reason, "count": len(self.tokens)}
+        if error:
+            ev["error"] = error
+        self._events.put(ev)
+        self._done.set()
+
+    # ----- consumer side -----
+    def cancel(self) -> None:
+        self._cancelled.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def events(self, timeout: Optional[float] = None):
+        """Yield events in order until (and including) the terminal one."""
+        while True:
+            ev = self._events.get(timeout=timeout)
+            yield ev
+            if ev.get("done"):
+                return
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self._done.wait(timeout=timeout):
+            raise TimeoutError("generation not finished")
+        return list(self.tokens)
+
+
+class _Request:
+    __slots__ = ("prompt", "max_tokens", "eos_id", "handle", "seed",
+                 "greedy", "temp", "top_k", "top_p", "trace_ctx",
+                 "t_submit", "t_decode_start")
+
+    def __init__(self, prompt, max_tokens, eos_id, handle, seed, greedy,
+                 temp, top_k, top_p, trace_ctx) -> None:
+        self.prompt = prompt
+        self.max_tokens = max_tokens
+        self.eos_id = eos_id
+        self.handle = handle
+        self.seed = seed
+        self.greedy = greedy
+        self.temp = temp
+        self.top_k = top_k
+        self.top_p = top_p
+        self.trace_ctx = trace_ctx
+        self.t_submit = trace_now() if trace_ctx is not None else 0.0
+        self.t_decode_start = 0.0
+
+
+class DecodeEngine:
+    def __init__(
+        self,
+        model,
+        *,
+        max_len: int = 256,
+        slots: int = 8,
+        default_timeout: Optional[float] = None,
+        default_max_tokens: int = 64,
+        admission: Optional[AdmissionController] = None,
+        queue_limit: int = 64,
+        circuit_breaker: Optional[CircuitBreaker] = None,
+        clock: Callable[[], float] = time.monotonic,
+        registry: Optional[MetricsRegistry] = None,
+        name: Optional[str] = None,
+        tracer: Optional[Tracer] = None,
+        step_hook: Optional[Callable[[], None]] = None,
+    ) -> None:
+        self.session = GenerationSession(model, max_len=max_len)
+        self.max_len = int(max_len)
+        self.slots = int(slots)
+        self.default_timeout = default_timeout
+        self.default_max_tokens = int(default_max_tokens)
+        self._clock = clock
+        self._tracer = tracer  # None -> process-global at call time
+        self._step_hook = step_hook  # test seam: runs after each decode step
+        self.name = name or f"decode-{next(_engine_seq)}"
+        self._admission = admission or AdmissionController(
+            max_pending=queue_limit, clock=clock)
+        self._breaker = circuit_breaker or CircuitBreaker(clock=clock)
+        self._init_metrics(registry if registry is not None else get_registry())
+
+        # device-side batch state: one preallocated carry, per-row specs
+        self._carry = self.session.decode_state(self.slots)
+        self._row_template = self.session.decode_state(1)
+        self._active = np.zeros((self.slots,), bool)
+        self._last = np.zeros((self.slots,), np.int32)
+        self._steps = np.zeros((self.slots,), np.int32)
+        self._seeds = np.zeros((self.slots,), np.uint32)
+        self._greedy = np.ones((self.slots,), bool)
+        self._temps = np.ones((self.slots,), np.float32)
+        self._ks = np.zeros((self.slots,), np.int32)
+        self._ps = np.ones((self.slots,), np.float32)
+        self._requests: List[Optional[_Request]] = [None] * self.slots
+
+        self._pending: "deque[_Request]" = deque()
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._shutdown = False
+        self._draining = False
+        self._fns = {}
+        self._thread = threading.Thread(
+            target=self._loop, name=f"{self.name}-loop", daemon=True)
+        self._thread.start()
+
+    # ----- metrics ----------------------------------------------------
+    def _init_metrics(self, reg: MetricsRegistry) -> None:
+        self.registry = reg
+        inst = self.name
+        req = reg.counter(
+            "dl4j_tpu_generate_requests_total",
+            "Generation requests by outcome", ("instance", "outcome"))
+        self._c = {o: req.labels(inst, o) for o in _OUTCOMES}
+        self._c_tokens = reg.counter(
+            "dl4j_tpu_generate_tokens_total",
+            "Tokens emitted across all generation requests",
+            ("instance",)).labels(inst)
+        self._g_inflight = reg.gauge(
+            "dl4j_tpu_generate_in_flight_sequences",
+            "Generation requests admitted and not yet finished",
+            ("instance",)).labels(inst)
+        self._g_active = reg.gauge(
+            "dl4j_tpu_generate_active_slots",
+            "Cache slots currently decoding", ("instance",)).labels(inst)
+        self._h_decode = reg.histogram(
+            "dl4j_tpu_generate_decode_latency_seconds",
+            "Per-token decode latency (one continuous-batched step emits "
+            "one token per active sequence)", ("instance",)).labels(inst)
+        self._h_prefill = reg.histogram(
+            "dl4j_tpu_generate_prefill_latency_seconds",
+            "Prompt prefill latency (bucketed length, batch of one)",
+            ("instance",)).labels(inst)
+
+    @property
+    def tracer(self) -> Tracer:
+        return self._tracer if self._tracer is not None else get_tracer()
+
+    # ----- jitted steps -----------------------------------------------
+    def _prefill_fn(self, tb: int):
+        key = ("prefill", tb)
+        if key not in self._fns:
+            sess = self.session
+            model = sess.model
+
+            def fn(params, state, row_carry, ids, lengths, seed, gflag,
+                   temp, k, p):
+                mask = (jnp.arange(tb, dtype=jnp.int32)[None, :]
+                        < lengths[:, None]).astype(model.dtype)
+                out, _, new_rnn = model.forward_pure(
+                    params, state, sess._prep(ids), train=False, rng=None,
+                    mask=mask, rnn_state=row_carry)
+                logits = sess._logits(out)
+                last = jnp.take_along_axis(
+                    logits, (lengths - 1)[:, None, None].astype(jnp.int32),
+                    axis=2)[:, :, 0]
+                tok = sample_tokens(last, seed, jnp.zeros((1,), jnp.int32),
+                                    gflag, temp, k, p)
+                return new_rnn, tok[0]
+
+            self._fns[key] = jax.jit(fn)
+        return self._fns[key]
+
+    def _decode_step_fn(self):
+        if "decode" not in self._fns:
+            sess = self.session
+            model = sess.model
+
+            def fn(params, state, carry, tokens, active, seeds, steps,
+                   gmask, temps, ks, ps):
+                out, _, new_rnn = model.forward_pure(
+                    params, state, sess._prep(tokens[:, None]), train=False,
+                    rng=None, mask=None, rnn_state=carry)
+                logits = sess._logits(out)[:, :, 0]
+                toks = sample_tokens(logits, seeds, steps, gmask, temps, ks,
+                                     ps)
+                # idle/finished slots must not advance their cache or (h, c)
+                def sel(n, o):
+                    a = active.reshape((-1,) + (1,) * (n.ndim - 1))
+                    return jnp.where(a, n, o)
+
+                new_rnn = jax.tree_util.tree_map(sel, new_rnn, carry)
+                return new_rnn, jnp.where(active, toks, 0)
+
+            self._fns["decode"] = jax.jit(fn)
+        return self._fns["decode"]
+
+    def _write_row_fn(self):
+        if "write" not in self._fns:
+            def fn(carry, row, i):
+                def put(c, r):
+                    z = jnp.zeros((), i.dtype)
+                    idx = (i,) + (z,) * (c.ndim - 1)
+                    return jax.lax.dynamic_update_slice(
+                        c, r.astype(c.dtype), idx)
+
+                return jax.tree_util.tree_map(put, carry, row)
+
+            self._fns["write"] = jax.jit(fn)
+        return self._fns["write"]
+
+    # ----- client side ------------------------------------------------
+    def submit(
+        self,
+        prompt: Sequence[int],
+        *,
+        max_tokens: Optional[int] = None,
+        greedy: bool = True,
+        temperature: float = 1.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int = 0,
+        eos_id: Optional[int] = None,
+        timeout: Optional[float] = None,
+        deadline: Optional[Deadline] = None,
+        request_id: Optional[str] = None,
+    ) -> GenerationHandle:
+        """Fail-fast enqueue (the ``output_async`` analog): raises
+        :class:`AdmissionRejectedError` when the pending window is full and
+        :class:`CircuitOpenError` while the decode step is known-poisoned.
+        Returns immediately; tokens stream through the handle."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) >= self.max_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} >= max_len {self.max_len} — "
+                "no room to generate")
+        if deadline is None:
+            deadline = Deadline.after(
+                timeout if timeout is not None else self.default_timeout,
+                clock=self._clock)
+        mt = self.default_max_tokens if max_tokens is None else int(max_tokens)
+        mt = max(1, min(mt, self.max_len - len(prompt)))
+        handle = GenerationHandle(request_id or f"{self.name}-req", deadline)
+        tracer = self.tracer
+        ctx = current_context() if tracer.enabled else None
+        req = _Request(prompt, mt, eos_id, handle, int(seed) & 0xFFFFFFFF,
+                       bool(greedy), float(temperature), int(top_k),
+                       float(top_p), ctx)
+        with self._lock:
+            if self._shutdown or self._draining:
+                raise RuntimeError("DecodeEngine is shut down" if
+                                   self._shutdown else
+                                   "DecodeEngine is draining")
+            if self._breaker.state is CircuitState.OPEN:
+                self._c["circuit_rejected"].inc()
+                raise CircuitOpenError(retry_after=self._breaker.retry_after())
+            try:
+                self._admission.admit()
+            except Exception:
+                self._c["shed"].inc()
+                raise
+            self._g_inflight.inc()
+            self._pending.append(req)
+        self._wake.set()
+        return handle
+
+    def generate(self, prompt: Sequence[int], **kw) -> List[int]:
+        """Blocking convenience: submit + wait for the full token list."""
+        return self.submit(prompt, **kw).result()
+
+    # ----- engine loop ------------------------------------------------
+    def _finish(self, req: _Request, reason: str,
+                error: Optional[str] = None) -> None:
+        req.handle._finish(reason, error)
+        outcome = reason if reason in _OUTCOMES else "completed"
+        self._c[outcome].inc()
+        self._admission.release()
+        self._g_inflight.dec()
+        if req.trace_ctx is not None and req.t_decode_start:
+            rec = self.tracer.make_record(
+                "engine.decode", req.trace_ctx, req.t_decode_start,
+                trace_now(),
+                attrs={"engine": self.name, "tokens": len(req.handle.tokens),
+                       "reason": reason}, error=reason == "failed")
+            self.tracer.record_spans([rec])
+
+    def _free_slot(self) -> Optional[int]:
+        for i in range(self.slots):
+            if self._requests[i] is None:
+                return i
+        return None
+
+    def _admit(self) -> None:
+        while True:
+            slot = self._free_slot()
+            with self._lock:
+                if not self._pending:
+                    return
+                if slot is None:
+                    return
+                req = self._pending.popleft()
+            if req.handle.cancelled:
+                self._finish(req, "cancelled")
+                continue
+            if req.handle.deadline.expired():
+                self._finish(req, "deadline")
+                continue
+            try:
+                self._prefill_into(slot, req)
+            except Exception as e:  # noqa: BLE001 — fail the request, not the loop
+                self._breaker.record_failure()
+                self._finish(req, "failed", error=str(e))
+
+    def _prefill_into(self, slot: int, req: _Request) -> None:
+        sess = self.session
+        tb = min(
+            next(s for s in sess.bucket_sizes() if s >= len(req.prompt)),
+            self.max_len)
+        ids = np.zeros((1, tb), np.int32)
+        ids[0, : len(req.prompt)] = req.prompt
+        t0 = time.perf_counter()
+        tt0 = trace_now() if req.trace_ctx is not None else 0.0
+        row, tok = self._prefill_fn(tb)(
+            sess.model.params, sess.model.state, self._row_template,
+            jnp.asarray(ids), jnp.asarray([len(req.prompt)], jnp.int32),
+            jnp.asarray([req.seed], jnp.uint32),
+            jnp.asarray([req.greedy], bool),
+            jnp.asarray([req.temp], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32),
+            jnp.asarray([req.top_p], jnp.float32))
+        self._carry = self._write_row_fn()(
+            self._carry, row, jnp.asarray(slot, jnp.int32))
+        first = int(tok)
+        self._h_prefill.observe(time.perf_counter() - t0)
+        self._breaker.record_success()
+        if req.trace_ctx is not None:
+            rec = self.tracer.make_record(
+                "engine.prefill", req.trace_ctx, tt0, trace_now(),
+                attrs={"engine": self.name, "slot": slot,
+                       "prompt_len": len(req.prompt), "bucket": tb})
+            self.tracer.record_spans([rec])
+            req.t_decode_start = trace_now()
+        # install the slot, emit the first token
+        self._requests[slot] = req
+        self._active[slot] = True
+        self._last[slot] = first
+        self._steps[slot] = 1  # next sample is decode step 1
+        self._seeds[slot] = req.seed
+        self._greedy[slot] = req.greedy
+        self._temps[slot] = req.temp
+        self._ks[slot] = req.top_k
+        self._ps[slot] = req.top_p
+        self._g_active.set(int(self._active.sum()))
+        self._c_tokens.inc()
+        req.handle._emit(0, first)
+        self._retire_if_done(slot, first, emitted=1)
+
+    def _retire_if_done(self, slot: int, last_token: int, emitted: int) -> None:
+        req = self._requests[slot]
+        if req is None:
+            return
+        reason = None
+        if req.handle.cancelled:
+            reason = "cancelled"
+        elif req.eos_id is not None and last_token == req.eos_id:
+            reason = "completed"
+        elif emitted >= req.max_tokens:
+            reason = "completed"
+        elif len(req.prompt) + emitted >= self.max_len:
+            reason = "completed"
+        elif req.handle.deadline.expired():
+            reason = "deadline"
+        if reason is not None:
+            self._requests[slot] = None
+            self._active[slot] = False
+            self._g_active.set(int(self._active.sum()))
+            self._finish(req, reason)
+
+    def _step(self) -> None:
+        sess = self.session
+        t0 = time.perf_counter()
+        try:
+            self._carry, toks = self._decode_step_fn()(
+                sess.model.params, sess.model.state, self._carry,
+                jnp.asarray(self._last), jnp.asarray(self._active),
+                jnp.asarray(self._seeds), jnp.asarray(self._steps),
+                jnp.asarray(self._greedy), jnp.asarray(self._temps),
+                jnp.asarray(self._ks), jnp.asarray(self._ps))
+            toks_h = np.asarray(toks)
+        except Exception as e:  # noqa: BLE001 — poisoned step: fail active requests
+            self._breaker.record_failure()
+            for slot in range(self.slots):
+                req = self._requests[slot]
+                if req is not None:
+                    self._requests[slot] = None
+                    self._active[slot] = False
+                    self._finish(req, "failed", error=str(e))
+            self._g_active.set(0)
+            return
+        dt = time.perf_counter() - t0
+        self._h_decode.observe(dt)
+        self._breaker.record_success()
+        n_active = 0
+        for slot in np.nonzero(self._active)[0]:
+            req = self._requests[slot]
+            tok = int(toks_h[slot])
+            emitted = len(req.handle.tokens)
+            req.handle._emit(emitted, tok)
+            self._last[slot] = tok
+            self._steps[slot] += 1
+            self._c_tokens.inc()
+            n_active += 1
+            self._retire_if_done(slot, tok, emitted + 1)
+        if self._step_hook is not None:
+            self._step_hook()
+
+    def _loop(self) -> None:
+        while True:
+            if not self._active.any():
+                with self._lock:
+                    has_pending = bool(self._pending)
+                if not has_pending:
+                    if self._shutdown:
+                        return
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+                    continue
+            self._admit()
+            if self._active.any():
+                self._step()
+            # also sweep cancelled requests on slots that produced nothing
+            for slot in range(self.slots):
+                req = self._requests[slot]
+                if req is not None and (req.handle.cancelled or
+                                        req.handle.deadline.expired()):
+                    self._retire_if_done(slot, -1, len(req.handle.tokens))
+
+    # ----- lifecycle / introspection ----------------------------------
+    def bucket_sizes(self) -> List[int]:
+        return self.session.bucket_sizes()
+
+    @property
+    def circuit_state(self) -> CircuitState:
+        return self._breaker.state
+
+    def stats(self) -> dict:
+        counts = {k: int(c.value) for k, c in self._c.items()}
+        counts.update({
+            "in_flight": self._admission.pending,
+            "active_slots": int(self._active.sum()),
+            "slots": self.slots,
+            "tokens": int(self._c_tokens.value),
+            "max_len": self.max_len,
+            "circuit_state": self._breaker.state.value,
+            "draining": self._draining,
+        })
+        return counts
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop admitting, wait for in-flight generations to finish."""
+        with self._lock:
+            self._draining = True
+        end = None if timeout is None else time.monotonic() + timeout
+        while self._admission.pending > 0:
+            if end is not None and time.monotonic() > end:
+                return False
+            time.sleep(0.01)
+        return True
+
+    def shutdown(self, *, drain: bool = True,
+                 drain_timeout: Optional[float] = 30.0) -> None:
+        if drain and not self._shutdown:
+            self.drain(timeout=drain_timeout)
+        with self._lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+            pending = list(self._pending)
+            self._pending.clear()
+        for req in pending:
+            self._finish(req, "cancelled")
+        for slot in range(self.slots):
+            req = self._requests[slot]
+            if req is not None:
+                req.handle.cancel()
+        self._wake.set()
+        self._thread.join(timeout=10)

@@ -51,6 +51,25 @@ ModelManager` endpoints add
                              resident version (live or canary; 404 when
                              that version is not currently serving) and
                              ``X-Request-Id`` is the canary routing key.
+
+Generation serving (README "Generation serving"): a
+:class:`~deeplearning4j_tpu.parallel.decode.DecodeEngine` passed as
+``generator=`` adds
+
+  POST /v1/generate → {"prompt": [ids...], "max_tokens"?, "greedy"?,
+                       "temperature"?, "top_k"?, "top_p"?, "seed"?,
+                       "eos_id"?, "deadline_ms"?, "stream"? (default
+                       true)}
+                      streamed as newline-delimited JSON token events
+                      ({"token", "index"}... {"done", "reason",
+                      "count"}) over one response; same 400/503 shed +
+                      Retry-After contract BEFORE the stream starts, and
+                      a deadline expiring MID-stream terminates cleanly
+                      with the partial output (reason "deadline").
+                      ``stream: false`` returns one JSON body instead.
+                      Client disconnect cancels the request and frees
+                      its cache slot. Contract enforced by
+                      tools/check_generate_contract.py.
 """
 
 from __future__ import annotations
@@ -118,9 +137,15 @@ class JsonModelServer:
                  registry: Optional[MetricsRegistry] = None,
                  name: Optional[str] = None,
                  managers: Optional[dict] = None,
-                 tracer: Optional[Tracer] = None) -> None:
+                 tracer: Optional[Tracer] = None,
+                 generator=None,
+                 generate_path: str = "/v1/generate") -> None:
         self.model = model
         self.path = path
+        # DecodeEngine for POST /v1/generate (caller-owned lifecycle,
+        # like managers= — the server routes to it and drains it on stop)
+        self._generator = generator
+        self.generate_path = generate_path
         self.default_deadline = float(default_deadline)
         self._clock = clock
         self._draining = False
@@ -255,7 +280,84 @@ class JsonModelServer:
                 self._send(404, {"error": f"unknown path {self.path}"})
                 return None
 
+            def _handle_generate(self):
+                # ---- parse: any failure here is the CLIENT's fault -> 400
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length))
+                    prompt = [int(t) for t in payload["prompt"]]
+                    deadline = self._deadline(payload)
+                    stream = bool(payload.get("stream", True))
+                    kw = dict(
+                        max_tokens=payload.get("max_tokens"),
+                        greedy=bool(payload.get("greedy", True)),
+                        temperature=float(payload.get("temperature", 1.0)),
+                        top_k=int(payload.get("top_k", 0)),
+                        top_p=float(payload.get("top_p", 1.0)),
+                        seed=int(payload.get("seed", 0)),
+                        eos_id=payload.get("eos_id"),
+                    )
+                except Exception as e:
+                    self._send(400, {"error": f"malformed request: {e}"})
+                    return
+                # ---- admit: shed/draining answer BEFORE any stream bytes
+                try:
+                    if outer._draining:
+                        raise RuntimeError("draining")
+                    handle = outer._generator.submit(
+                        prompt, deadline=deadline,
+                        request_id=self._request_id, **kw)
+                except ValueError as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                except AdmissionRejectedError as e:
+                    self._send_unavailable(f"overloaded: {e}", e.retry_after)
+                    return
+                except CircuitOpenError as e:
+                    self._send_unavailable(f"circuit open: {e}",
+                                           e.retry_after)
+                    return
+                except RuntimeError as e:
+                    if "drain" in str(e) or "shut down" in str(e):
+                        self._send_unavailable("draining", 1.0)
+                    else:
+                        self._send(500, {"error": f"internal error: {e}"})
+                    return
+                except Exception as e:
+                    self._send(500, {"error": f"internal error: {e}"})
+                    return
+                if not stream:
+                    tokens = handle.result(
+                        timeout=(deadline.remaining() or 30.0) + 30.0)
+                    self._send(200, {"tokens": tokens,
+                                     "count": len(tokens),
+                                     "reason": handle.reason})
+                    return
+                # ---- stream: newline-delimited JSON events until done.
+                # A write failure means the client went away — cancel so
+                # the engine frees the cache slot instead of generating
+                # for nobody.
+                self._sent_code = 200
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("X-Request-Id", self._request_id)
+                self.end_headers()
+                try:
+                    for ev in handle.events(
+                            timeout=(deadline.remaining() or 30.0) + 30.0):
+                        self.wfile.write(json.dumps(ev).encode() + b"\n")
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionError, OSError):
+                    handle.cancel()
+                except Exception:
+                    handle.cancel()
+                    raise
+
             def _handle_post(self):
+                if (self.path == outer.generate_path
+                        and outer._generator is not None):
+                    self._handle_generate()
+                    return
                 submit = self._submit_fn()
                 if submit is None:
                     return
@@ -373,6 +475,8 @@ class JsonModelServer:
         if self._managers:
             s["models"] = {n: m.stats()
                            for n, m in sorted(self._managers.items())}
+        if self._generator is not None:
+            s["generate"] = self._generator.stats()
         s["draining"] = self._draining
         return s
 
@@ -394,6 +498,8 @@ class JsonModelServer:
                 self._pi.drain(timeout=drain_timeout)
             for m in self._managers.values():
                 m.engine.drain(timeout=drain_timeout)
+            if self._generator is not None:
+                self._generator.drain(timeout=drain_timeout)
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._pi is not None:
@@ -514,3 +620,81 @@ class JsonRemoteInference:
         if "error" in payload:
             raise RuntimeError(payload["error"])
         return np.asarray(payload["output"], np.float32)
+
+    def _generate_endpoint(self, path: str) -> str:
+        from urllib.parse import urlparse, urlunparse
+
+        u = urlparse(self.endpoint)
+        return urlunparse((u.scheme, u.netloc, path, "", "", ""))
+
+    def generate(self, prompt, *, max_tokens: Optional[int] = None,
+                 greedy: bool = True, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+                 eos_id: Optional[int] = None,
+                 timeout: Optional[float] = None,
+                 path: str = "/v1/generate"):
+        """Streamed generation against ``POST /v1/generate``: yields the
+        server's ordered token events ({"token", "index"}...
+        {"done", "reason", "count"}). 503 (shed/draining — only possible
+        BEFORE the first event) retries under the deadline like
+        :meth:`predict`; 400 raises ``ValueError``. The enclosing
+        ``client.request`` span propagates ``traceparent`` so the server's
+        trace gains the engine.prefill/engine.decode children."""
+        payload = {"prompt": [int(t) for t in prompt], "stream": True,
+                   "greedy": greedy, "temperature": temperature,
+                   "top_k": top_k, "top_p": top_p, "seed": seed}
+        if max_tokens is not None:
+            payload["max_tokens"] = max_tokens
+        if eos_id is not None:
+            payload["eos_id"] = eos_id
+        body = json.dumps(payload).encode()
+        deadline = Deadline.after(
+            timeout if timeout is not None else self.timeout,
+            clock=self._clock)
+        endpoint = self._generate_endpoint(path)
+        tracer = self.tracer
+
+        def open_stream():
+            rem = deadline.remaining()
+            if rem is not None and rem <= 0:
+                raise DeadlineExceededError("client deadline exceeded")
+            headers = {"Content-Type": "application/json"}
+            if rem is not None:
+                headers["X-Deadline-Ms"] = str(int(rem * 1000))
+            parent = current_context() if tracer.enabled else None
+            if parent is not None:
+                headers["traceparent"] = encode_traceparent(parent.child())
+            req = urllib_request.Request(endpoint, data=body,
+                                         headers=headers)
+            try:
+                return urllib_request.urlopen(req, timeout=rem)
+            except HTTPError as e:
+                detail = ""
+                try:
+                    detail = json.loads(e.read()).get("error", "")
+                except Exception:
+                    pass
+                if e.code == 503:
+                    ra = e.headers.get("Retry-After")
+                    raise ServiceUnavailableError(
+                        detail or "service unavailable",
+                        retry_after=float(ra) if ra else None) from e
+                if e.code == 400:
+                    raise ValueError(detail or "bad request") from e
+                raise RuntimeError(f"HTTP {e.code}: {detail}") from e
+
+        with tracer.span("client.request",
+                         attrs={"endpoint": endpoint}):
+            resp = self.retry_policy.execute(
+                open_stream,
+                retry_on=(ServiceUnavailableError, URLError, ConnectionError),
+                deadline=deadline, sleep=self._sleep)
+            with resp:
+                for line in resp:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    ev = json.loads(line)
+                    yield ev
+                    if ev.get("done"):
+                        return

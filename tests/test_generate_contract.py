@@ -1,0 +1,20 @@
+"""Tier-1 wiring for tools/check_generate_contract.py: the streaming
+generation-serving contract (README.md "Generation serving" — ordered
+token events over real HTTP, mid-stream deadline with partial output,
+admission shed -> 503 + Retry-After, disconnect frees the cache slot,
+metric/trace surfaces) is enforced on every test run, mirroring
+test_serving_contract.py / test_trace_contract.py."""
+
+import os
+import sys
+
+_TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+
+
+def test_generate_contract_smoke():
+    sys.path.insert(0, _TOOLS)
+    try:
+        import check_generate_contract
+    finally:
+        sys.path.remove(_TOOLS)
+    assert check_generate_contract.main(log=lambda m: None) == 0

@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Generation-serving contract check (README.md "Generation serving").
+
+Boots a JsonModelServer with a DecodeEngine on CPU and drives REAL HTTP
+against ``POST /v1/generate``, asserting:
+
+  * a streamed request yields ORDERED token events ({"token", "index"}
+    with index 0..n-1) terminated by exactly one {"done": true} event,
+    and the tokens match the single-sequence GenerationSession (greedy
+    determinism over HTTP),
+  * a deadline expiring MID-stream terminates the stream cleanly with
+    partial output (reason "deadline", 1 <= count < max_tokens) — the
+    response stays well-formed NDJSON to the last byte,
+  * admission shed answers 503 + Retry-After BEFORE any stream bytes,
+    and the engine recovers once load drains,
+  * a client DISCONNECT mid-stream cancels the request and frees its
+    cache slot (in-flight drops to 0; a follow-up request on the same
+    slot completes),
+  * the generate metric series (tokens total, in-flight gauge, decode
+    latency histogram) land in ``GET /metrics``, and a traced request
+    shows ``engine.prefill``/``engine.decode`` child spans in
+    ``GET /v1/traces``.
+
+Runs standalone (``python tools/check_generate_contract.py``) and as a
+tier-1 pytest via tests/test_generate_contract.py.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+from urllib import request as urllib_request
+from urllib.error import HTTPError
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+MAX_LEN = 24
+
+
+def _stream(port, payload, headers=None, timeout=60):
+    req = urllib_request.Request(
+        f"http://127.0.0.1:{port}/v1/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    events = []
+    with urllib_request.urlopen(req, timeout=timeout) as r:
+        assert r.status == 200
+        for line in r:
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+    return events
+
+
+def _wait_for(cond, timeout=15.0, what="condition"):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def main(log=print) -> int:
+    from deeplearning4j_tpu.model.zoo import TransformerLM
+    from deeplearning4j_tpu.generate import GenerationSession
+    from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+    from deeplearning4j_tpu.obs.tracing import Tracer
+    from deeplearning4j_tpu.parallel import DecodeEngine
+    from deeplearning4j_tpu.remote import JsonModelServer
+
+    model = TransformerLM(vocab_size=23, hidden=32, n_layers=2, n_heads=4,
+                          max_len=MAX_LEN).init()
+    registry = MetricsRegistry()
+    tracer = Tracer(sample_rate=1.0)
+    slow = {"delay": 0.0}  # step_hook knob: per-decode-step stall
+    engine = DecodeEngine(model, max_len=MAX_LEN, slots=2, queue_limit=3,
+                          registry=registry, tracer=tracer, name="gen",
+                          step_hook=lambda: time.sleep(slow["delay"]))
+    server = JsonModelServer(generator=engine, registry=registry,
+                             tracer=tracer, name="gen-server").start()
+    port = server.port
+    try:
+        # ---- 1. ordered token events, greedy-deterministic over HTTP
+        events = _stream(port, {"prompt": [1, 2, 3], "max_tokens": 6,
+                                "seed": 0})
+        dones = [e for e in events if e.get("done")]
+        assert len(dones) == 1 and events[-1] is dones[0], \
+            f"exactly one terminal event expected: {events}"
+        toks = [e for e in events if "token" in e]
+        assert [e["index"] for e in toks] == list(range(6)), \
+            f"unordered token events: {events}"
+        assert dones[0]["count"] == 6 and dones[0]["reason"] == "completed"
+        sess = GenerationSession(model, max_len=MAX_LEN)
+        expected = sess.generate([[1, 2, 3]], 6, greedy=True)[0]
+        assert [e["token"] for e in toks] == expected, \
+            f"HTTP stream {toks} != session {expected}"
+        log("ordered streaming + greedy determinism over HTTP ok")
+
+        # ---- 2. deadline mid-stream: clean termination, partial output
+        slow["delay"] = 0.05
+        events = _stream(port, {"prompt": [1, 2, 3],
+                                "max_tokens": MAX_LEN,
+                                "deadline_ms": 400})
+        slow["delay"] = 0.0
+        done = events[-1]
+        assert done.get("done") and done["reason"] == "deadline", \
+            f"expected deadline termination: {done}"
+        n = done["count"]
+        assert 1 <= n < MAX_LEN - 3, f"expected partial output, got {n}"
+        toks = [e for e in events if "token" in e]
+        assert [e["index"] for e in toks] == list(range(n)), \
+            "partial stream must stay ordered"
+        log(f"mid-stream deadline ok (clean stop after {n} tokens)")
+
+        # ---- 3. admission shed -> 503 + Retry-After before any stream
+        slow["delay"] = 0.05
+        bg = []
+        for _ in range(3):  # 2 slots + 1 queued fill the window (limit 3)
+            t = threading.Thread(
+                target=lambda: _stream(port, {"prompt": [1, 2],
+                                              "max_tokens": MAX_LEN - 4}),
+                daemon=True)
+            t.start()
+            bg.append(t)
+        _wait_for(lambda: engine.stats()["in_flight"] >= 3, what="load")
+        try:
+            _stream(port, {"prompt": [9], "max_tokens": 2})
+            raise AssertionError("expected 503 while window is full")
+        except HTTPError as e:
+            assert e.code == 503, f"expected 503, got {e.code}"
+            assert e.headers.get("Retry-After") is not None
+            body = json.loads(e.read())
+            assert body.get("retryable") is True
+        slow["delay"] = 0.0
+        for t in bg:
+            t.join(timeout=60)
+        _wait_for(lambda: engine.stats()["in_flight"] == 0, what="drain")
+        assert engine.stats()["shed"] >= 1
+        # recovered: the next request is served
+        events = _stream(port, {"prompt": [4, 5], "max_tokens": 2})
+        assert events[-1]["reason"] == "completed"
+        log("admission shed -> 503 + Retry-After, recovery ok")
+
+        # ---- 4. client disconnect frees the cache slot
+        slow["delay"] = 0.05
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("POST", "/v1/generate",
+                     body=json.dumps({"prompt": [1, 2, 3],
+                                      "max_tokens": MAX_LEN - 4}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.fp.readline()  # first token event arrived — it is decoding
+        # the until-EOF body means the socket lives on the RESPONSE object
+        # (http.client detaches it from the connection) — close both to
+        # actually hang up mid-stream
+        resp.close()
+        conn.close()
+        _wait_for(lambda: engine.stats()["active_slots"] == 0,
+                  what="slot release after disconnect")
+        slow["delay"] = 0.0
+        _wait_for(lambda: engine.stats()["in_flight"] == 0,
+                  what="in-flight release after disconnect")
+        assert engine.stats()["cancelled"] >= 1
+        events = _stream(port, {"prompt": [6, 7], "max_tokens": 3})
+        assert events[-1]["reason"] == "completed", \
+            "slot must serve new work after a disconnect"
+        log("disconnect cancels + frees cache slot ok")
+
+        # ---- 5. metrics + traces surfaces
+        with urllib_request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+            text = r.read().decode()
+        for series in ("dl4j_tpu_generate_tokens_total",
+                       "dl4j_tpu_generate_in_flight_sequences",
+                       "dl4j_tpu_generate_decode_latency_seconds"):
+            assert series in text, f"missing metric series {series}"
+        tracer.flush()
+        with urllib_request.urlopen(
+                f"http://127.0.0.1:{port}/v1/traces?route=/v1/generate",
+                timeout=30) as r:
+            traces = json.loads(r.read())["traces"]
+        assert traces, "no /v1/generate traces recorded"
+        span_names = {s["name"] for t in traces for s in t["spans"]}
+        assert "engine.prefill" in span_names, span_names
+        assert "engine.decode" in span_names, span_names
+        log("metrics exposition + engine decode spans ok")
+
+        # ---- 6. malformed input -> 400, never a stream
+        try:
+            _stream(port, {"prompt": "not-a-list"})
+            raise AssertionError("expected 400")
+        except HTTPError as e:
+            assert e.code == 400
+        log("malformed request -> 400 ok")
+        return 0
+    finally:
+        server.stop()
+        engine.shutdown(drain=False)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
