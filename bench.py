@@ -1690,6 +1690,163 @@ def measure_generate_decode(vocab: int = 512, hidden: int = 256,
     }
 
 
+def measure_engine_pool_scaling(n_requests: int = 240, threads: int = 4,
+                                replicas: int = 4, distinct_payloads: int = 8,
+                                overload_requests: int = 120) -> dict:
+    """Replica-pool serving row (ISSUE 10 acceptance): sustained RPS
+    through EnginePool at 1 vs N replicas (pool dispatch overhead at N=1
+    vs a bare engine must stay <10%; scaling is only meaningful where
+    cores allow — this host's count is reported), cache hit-rate speedup
+    on a repeated-payload workload, and shed-by-priority counts under a
+    forced overload — with every signal checked visible on /metrics."""
+    import itertools as _it
+    import threading as _th
+
+    import numpy as np
+
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+    from deeplearning4j_tpu.obs.prom import render_prometheus
+    from deeplearning4j_tpu.parallel import EnginePool, ParallelInference
+
+    conf = (NeuralNetConfiguration.builder().seed(5).list()
+            .layer(DenseLayer(n_in=8, n_out=16))
+            .layer(OutputLayer(n_in=16, n_out=4))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    payloads = [rng.randn(1, 8).astype(np.float32)
+                for _ in range(max(threads, 16))]
+
+    def hammer(submit, n, nthreads) -> float:
+        """Sustained RPS: nthreads callers drain a shared request
+        counter; returns the median rate of REPEATS passes."""
+        def one_pass():
+            counter = _it.count()
+            errs = []
+
+            def worker():
+                while True:
+                    i = next(counter)
+                    if i >= n:
+                        return
+                    try:
+                        submit(payloads[i % len(payloads)])
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(e)
+                        return
+            ts = [_th.Thread(target=worker) for _ in range(nthreads)]
+            start = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if errs:
+                raise errs[0]
+            return n / (time.perf_counter() - start)
+        return statistics.median(one_pass() for _ in range(REPEATS))
+
+    # batch_limit=1 keeps every forward on ONE compiled shape, so the row
+    # measures dispatch overhead, not recompiles
+    eng_kw = dict(batch_limit=1, workers=1, queue_limit=512)
+
+    # ---- bare engine baseline vs pool at N=1 (dispatch overhead) -------
+    bare = ParallelInference(model, registry=MetricsRegistry(),
+                             name="bench-bare", **eng_kw)
+    bare.output(payloads[0])  # compile
+    bare_rps = hammer(lambda x: bare.output(x), n_requests, threads)
+    bare.shutdown(drain=False)
+
+    pool1 = EnginePool(model=model, replicas=1, registry=MetricsRegistry(),
+                       name="bench-p1", **eng_kw)
+    pool1.output(payloads[0])
+    pool1_rps = hammer(lambda x: pool1.output(x), n_requests, threads)
+    pool1.shutdown(drain=False)
+
+    # ---- N replicas ----------------------------------------------------
+    regN = MetricsRegistry()
+    poolN = EnginePool(model=model, replicas=replicas, registry=regN,
+                       name="bench-pN", **eng_kw)
+    for _ in range(replicas * 2):  # compile every replica's forward
+        poolN.output(payloads[0])
+    poolN_rps = hammer(lambda x: poolN.output(x), n_requests,
+                       max(threads, replicas))
+    dispatchedN = poolN.stats()["dispatched"]
+    poolN.shutdown(drain=False)
+
+    # ---- cache hit-rate speedup on a repeated-payload workload ---------
+    hot = payloads[:distinct_payloads]
+    reg_c = MetricsRegistry()
+    cpool = EnginePool(model=model, replicas=1, registry=reg_c,
+                       cache_entries=256, cache_ttl=600.0,
+                       name="bench-cache", **eng_kw)
+    cpool.output(hot[0])
+    cold_rps = hammer(lambda x: cpool.output(x, use_cache=False),
+                      n_requests, threads)
+    warm_rps = hammer(
+        lambda x: cpool.output(hot[hash(x.tobytes()) % len(hot)]),
+        n_requests, threads)
+    cache_stats = cpool.stats()["cache"]
+    cpool.shutdown(drain=False)
+
+    # ---- forced overload: shed order by priority -----------------------
+    reg_o = MetricsRegistry()
+    opool = EnginePool(model=model, replicas=2, registry=reg_o,
+                       max_pending=8,
+                       priorities={"high": 1.0, "low": 0.5},
+                       name="bench-over", **eng_kw)
+    opool.output(payloads[0])
+    shed_errs = _it.count()
+
+    def flood(priority):
+        for i in range(overload_requests // (2 * threads)):
+            try:
+                opool.output_async(payloads[i % len(payloads)],
+                                   priority=priority, use_cache=False)
+            except Exception:  # noqa: BLE001 — shed, counted below
+                next(shed_errs)
+    ts = [_th.Thread(target=flood, args=("low" if i % 2 else "high",))
+          for i in range(2 * threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    opool.drain(timeout=30)
+    shed_by_priority = opool.stats().get("shed_by_priority", {})
+    # the acceptance surface: all of it must be scrapeable
+    prom = render_prometheus(reg_o) + render_prometheus(reg_c) \
+        + render_prometheus(regN)
+    metrics_visible = all(s in prom for s in (
+        "dl4j_tpu_pool_dispatch_total", "dl4j_tpu_pool_load_imbalance",
+        "dl4j_tpu_pool_cache_events_total", "dl4j_tpu_pool_shed_total",
+        "dl4j_tpu_inference_effective_batch_limit",
+        "dl4j_tpu_inference_flush_timeout_seconds"))
+    opool.shutdown(drain=False)
+
+    return {
+        "bare_engine_rps": round(bare_rps, 1),
+        "pool_1_replica_rps": round(pool1_rps, 1),
+        "pool_overhead_at_1": round(1.0 - pool1_rps / bare_rps, 4),
+        "pool_n_replicas": replicas,
+        "pool_n_rps": round(poolN_rps, 1),
+        "pool_scaling_vs_1": round(poolN_rps / pool1_rps, 2),
+        "pool_n_dispatch_spread": {k: int(v) for k, v in
+                                   sorted(dispatchedN.items())},
+        "host_cpu_count": os.cpu_count(),
+        "cache_off_rps": round(cold_rps, 1),
+        "cache_on_repeated_rps": round(warm_rps, 1),
+        "cache_speedup": round(warm_rps / cold_rps, 2),
+        "cache_hit_rate": (round(cache_stats["hit_rate"], 4)
+                           if cache_stats["hit_rate"] is not None else None),
+        "overload_shed_by_priority": shed_by_priority,
+        "metrics_visible": metrics_visible,
+        "note": ("near-linear replica scaling requires >= N cores; on a "
+                 "1-core host the row validates overhead + shed order + "
+                 "cache, not parallel speedup"),
+    }
+
+
 _MEASUREMENTS = {
     "lenet": measure_lenet,
     "resnet50": measure_resnet50,
@@ -1710,6 +1867,7 @@ _MEASUREMENTS = {
     "step_profile": measure_step_profile,
     "zero1_updater_headroom": measure_zero1_updater_headroom,
     "generate_decode": measure_generate_decode,
+    "engine_pool_scaling": measure_engine_pool_scaling,
 }
 
 
@@ -1818,6 +1976,10 @@ def _child_measure(name: str, platform: str) -> None:
                                 "heads": 4, "max_len": 64, "batch": 4,
                                 "prompt_len": 8, "decode_steps": 12,
                                 "warmup_steps": 2, "attn_len": 32},
+            # 1-core host: keep the RPS passes short; scaling is reported
+            # but only meaningful with >= N cores (see the row's note)
+            "engine_pool_scaling": {"n_requests": 120, "threads": 4,
+                                    "replicas": 2, "overload_requests": 80},
         }.get(name, {})
     result = _MEASUREMENTS[name](**kwargs)
     print(json.dumps(result))
@@ -1869,6 +2031,8 @@ def main() -> None:
         "zero1_updater_headroom": _run_measurement(
             "zero1_updater_headroom", platform),
         "generate_decode": _run_measurement("generate_decode", platform),
+        "engine_pool_scaling": _run_measurement("engine_pool_scaling",
+                                                platform),
     }
     if not fallback:  # chip-only rows
         extras["resnet50_b128"] = _run_measurement("resnet50_b128", platform)

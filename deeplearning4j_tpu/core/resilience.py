@@ -20,6 +20,7 @@ recovery paths are exercised by ordinary tests.
 from __future__ import annotations
 
 import enum
+import inspect
 import random
 import threading
 import time
@@ -328,14 +329,47 @@ class CircuitBreaker:
 # --------------------------------------------------------------------------
 # AdmissionController
 # --------------------------------------------------------------------------
+class _PriorityBudget:
+    """Per-class admission budget: a fraction of the pending window plus a
+    weighted slice of the token-bucket refill."""
+
+    __slots__ = ("fraction", "rate", "burst", "tokens", "admitted", "shed")
+
+    def __init__(self, fraction: float, rate: Optional[float],
+                 burst: float) -> None:
+        self.fraction = fraction
+        self.rate = rate
+        self.burst = burst
+        self.tokens = burst
+        self.admitted = 0
+        self.shed = 0
+
+
 class AdmissionController:
     """Bounded fail-fast admission: pending-slot cap plus an optional
     token bucket. Overload answers immediately (shed -> HTTP 503 +
     Retry-After) instead of blocking the caller on a full queue.
+
+    **Priority classes** (``priorities=``): a mapping of class name to a
+    fraction in (0, 1] of ``max_pending`` that class may fill, e.g.
+    ``{"high": 1.0, "normal": 0.85, "low": 0.6}``. As the pending window
+    fills, classes shed in ascending-fraction order — low-priority
+    traffic is refused while high-priority requests still fit, so
+    overload degrades the cheapest traffic first instead of collapsing
+    tail latency for everyone. When ``rate`` is also set, each class gets
+    its own token bucket with the refill split proportionally to its
+    fraction (weighted token buckets): one class exhausting its slice
+    never starves another's. Requests naming an unknown class are
+    treated as the lowest-fraction class (strictest budget — headers are
+    client-controlled, so unknown names must not escalate). ``admit()``
+    without a priority uses the highest-fraction class, which keeps the
+    single-class behavior exactly as before; ``priorities=None`` (the
+    default) is byte-identical to the pre-priority controller.
     """
 
     def __init__(self, *, max_pending: int = 256,
                  rate: Optional[float] = None, burst: Optional[float] = None,
+                 priorities: Optional[Dict[str, float]] = None,
                  clock: Callable[[], float] = time.monotonic) -> None:
         if max_pending < 1:
             raise ValueError("max_pending must be >= 1")
@@ -350,58 +384,128 @@ class AdmissionController:
         self._shed = 0
         self._admitted = 0
         self._lock = threading.Lock()
-        # decision observers: fn(decision, pending) with decision in
-        # {"admitted", "shed"}, called AFTER the lock is released (an
-        # observer may read .pending/.stats()). No behavior change unset.
-        self._observers: List[Callable[[str, int], None]] = []
+        self._priorities: Optional[Dict[str, _PriorityBudget]] = None
+        self._default_priority: Optional[str] = None
+        self._lowest_priority: Optional[str] = None
+        if priorities:
+            total = sum(float(f) for f in priorities.values())
+            self._priorities = {}
+            for pname, frac in priorities.items():
+                frac = float(frac)
+                if not 0.0 < frac <= 1.0:
+                    raise ValueError(
+                        f"priority fraction for {pname!r} must be in (0, 1], "
+                        f"got {frac}")
+                share = frac / total if total > 0 else 0.0
+                self._priorities[pname] = _PriorityBudget(
+                    frac,
+                    None if self.rate is None else self.rate * share,
+                    self.burst * share if self.rate is not None else 0.0)
+            ordered = sorted(priorities, key=lambda n: float(priorities[n]))
+            self._lowest_priority = ordered[0]
+            self._default_priority = ordered[-1]
+        # decision observers: fn(decision, pending) — or, when the
+        # callable accepts a third parameter, fn(decision, pending,
+        # priority) — with decision in {"admitted", "shed"}, called AFTER
+        # the lock is released (an observer may read .pending/.stats()).
+        # No behavior change unset.
+        self._observers: List[tuple] = []
 
-    def add_observer(self, fn: Callable[[str, int], None]) -> None:
-        """Register ``fn(decision, pending)`` for every admit/shed call."""
-        self._observers.append(fn)
+    @staticmethod
+    def _observer_arity(fn) -> bool:
+        """True when ``fn`` accepts a third (priority) argument."""
+        try:
+            return len(inspect.signature(fn).parameters) >= 3
+        except (TypeError, ValueError):  # builtins, exotic callables
+            return False
+
+    def add_observer(self, fn: Callable[..., None]) -> None:
+        """Register ``fn(decision, pending)`` — or
+        ``fn(decision, pending, priority)`` — for every admit/shed call."""
+        self._observers.append((fn, self._observer_arity(fn)))
 
     def remove_observer(self, fn) -> None:
-        try:
-            self._observers.remove(fn)
-        except ValueError:
-            pass
+        self._observers = [(f, a) for f, a in self._observers if f is not fn]
 
     @property
     def pending(self) -> int:
         with self._lock:
             return self._pending
 
+    @property
+    def priority_classes(self) -> tuple:
+        """Configured class names, highest-fraction first (empty when
+        priorities are not enabled)."""
+        if self._priorities is None:
+            return ()
+        return tuple(sorted(self._priorities,
+                            key=lambda n: -self._priorities[n].fraction))
+
+    def _resolve(self, priority: Optional[str]) -> Optional[str]:
+        if self._priorities is None:
+            return None
+        if priority is None:
+            return self._default_priority
+        if priority in self._priorities:
+            return priority
+        return self._lowest_priority
+
     def _refill(self) -> None:
         if self.rate is None:
             return
         now = self._clock()
-        self._tokens = min(self.burst,
-                           self._tokens + (now - self._last_refill) * self.rate)
+        dt = now - self._last_refill
+        self._tokens = min(self.burst, self._tokens + dt * self.rate)
+        if self._priorities is not None:
+            for b in self._priorities.values():
+                if b.rate is not None:
+                    b.tokens = min(b.burst, b.tokens + dt * b.rate)
         self._last_refill = now
 
-    def try_admit(self) -> bool:
+    def try_admit(self, priority: Optional[str] = None) -> bool:
+        pname = self._resolve(priority)
         with self._lock:
             self._refill()
-            if self._pending >= self.max_pending:
+            budget = (self._priorities[pname]
+                      if pname is not None else None)
+            window = (self.max_pending if budget is None
+                      else max(1, int(round(self.max_pending
+                                            * budget.fraction))))
+            tokens_ok = True
+            if self.rate is not None:
+                tokens_ok = ((self._tokens >= 1.0) if budget is None
+                             else (budget.tokens >= 1.0))
+            if self._pending >= window or not tokens_ok:
                 self._shed += 1
-                admitted = False
-            elif self.rate is not None and self._tokens < 1.0:
-                self._shed += 1
+                if budget is not None:
+                    budget.shed += 1
                 admitted = False
             else:
                 if self.rate is not None:
-                    self._tokens -= 1.0
+                    if budget is None:
+                        self._tokens -= 1.0
+                    else:
+                        budget.tokens -= 1.0
                 self._pending += 1
                 self._admitted += 1
+                if budget is not None:
+                    budget.admitted += 1
                 admitted = True
             pending = self._pending
-        for fn in list(self._observers):
-            fn("admitted" if admitted else "shed", pending)
+        decision = "admitted" if admitted else "shed"
+        for fn, wants_priority in list(self._observers):
+            if wants_priority:
+                fn(decision, pending, pname or "default")
+            else:
+                fn(decision, pending)
         return admitted
 
-    def admit(self) -> None:
-        if not self.try_admit():
+    def admit(self, priority: Optional[str] = None) -> None:
+        if not self.try_admit(priority):
+            detail = "" if priority is None else f" (priority {priority!r})"
             raise AdmissionRejectedError(
-                f"overloaded: {self.pending}/{self.max_pending} pending",
+                f"overloaded: {self.pending}/{self.max_pending} "
+                f"pending{detail}",
                 retry_after=self.retry_after())
 
     def release(self) -> None:
@@ -415,10 +519,16 @@ class AdmissionController:
             return max(1.0 / self.rate, 0.001)
         return 1.0
 
-    def stats(self) -> Dict[str, int]:
+    def stats(self) -> Dict:
         with self._lock:
-            return {"pending": self._pending, "admitted": self._admitted,
-                    "shed": self._shed}
+            out: Dict = {"pending": self._pending,
+                         "admitted": self._admitted, "shed": self._shed}
+            if self._priorities is not None:
+                out["by_priority"] = {
+                    pname: {"admitted": b.admitted, "shed": b.shed,
+                            "fraction": b.fraction}
+                    for pname, b in sorted(self._priorities.items())}
+            return out
 
 
 # --------------------------------------------------------------------------
@@ -484,6 +594,13 @@ class FaultInjector:
     # ---- firing (production side) ------------------------------------
     def fire(self, site: str) -> None:
         """Apply any armed faults for ``site``: latency first, then raise."""
+        if not self._plans:
+            # lock-free fast path: serving hot paths fire sites on every
+            # request, and an unarmed injector must cost a dict check,
+            # not a contended lock. Benign race: plans are armed before
+            # traffic in every test, and a concurrent arm is picked up
+            # by the next fire.
+            return
         with self._lock:
             plans = self._plans.get(site)
             if not plans:

@@ -260,11 +260,21 @@ class _NullSpan:
     context = None
     trace_id = span_id = parent_id = None
     sampled = False
-    error = False
     name = ""
     start_time = end_time = None
     duration = None
     attributes: Dict[str, Any] = {}
+
+    # writable no-op: callers flag 5xx responses with ``span.error = True``
+    # on whatever span they hold — an unsampled request must absorb that
+    # write, not kill the handler thread with an AttributeError
+    @property
+    def error(self) -> bool:
+        return False
+
+    @error.setter
+    def error(self, value) -> None:
+        pass
 
     def set_attribute(self, key: str, value: Any) -> "_NullSpan":
         return self

@@ -39,8 +39,15 @@ from .pipeline import (dense_block_stage, pipeline_apply,
 from .trainer import DistributedTrainer, moe_expert_parallel_rules
 from .inference import InferenceMode, ParallelInference, Servable
 from .decode import DecodeEngine, GenerationHandle
+from .pool import AdaptiveBatcher, EnginePool, PoolServable, ResponseCache
 
 __all__ = [
+    "AdaptiveBatcher",
+    "DecodeEngine",
+    "EnginePool",
+    "GenerationHandle",
+    "PoolServable",
+    "ResponseCache",
     "ShardedEmbeddingTable",
     "shard_rows",
     "DistributedTrainer",

@@ -83,6 +83,8 @@ class GenerationHandle:
         self._events: "queue.Queue[dict]" = queue.Queue()
         self._done = threading.Event()
         self._cancelled = threading.Event()
+        self._cb_lock = threading.Lock()
+        self._on_done: List[Callable[["GenerationHandle"], None]] = []
 
     # ----- engine side -----
     def _emit(self, index: int, token: int) -> None:
@@ -95,7 +97,30 @@ class GenerationHandle:
         if error:
             ev["error"] = error
         self._events.put(ev)
-        self._done.set()
+        with self._cb_lock:
+            self._done.set()
+            cbs = list(self._on_done)
+            self._on_done.clear()
+        for cb in cbs:
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 — a callback never kills the loop
+                pass
+
+    def add_done_callback(
+            self, fn: Callable[["GenerationHandle"], None]) -> None:
+        """Run ``fn(handle)`` when the terminal event lands (immediately
+        if it already has) — race-free: registration and the done flag
+        share one lock, so the callback fires exactly once. A replica
+        pool uses this to release its admission slot."""
+        with self._cb_lock:
+            if not self._done.is_set():
+                self._on_done.append(fn)
+                return
+        try:
+            fn(self)
+        except Exception:  # noqa: BLE001
+            pass
 
     # ----- consumer side -----
     def cancel(self) -> None:
@@ -309,11 +334,14 @@ class DecodeEngine:
         timeout: Optional[float] = None,
         deadline: Optional[Deadline] = None,
         request_id: Optional[str] = None,
+        priority: Optional[str] = None,
     ) -> GenerationHandle:
         """Fail-fast enqueue (the ``output_async`` analog): raises
         :class:`AdmissionRejectedError` when the pending window is full and
         :class:`CircuitOpenError` while the decode step is known-poisoned.
-        Returns immediately; tokens stream through the handle."""
+        Returns immediately; tokens stream through the handle.
+        ``priority`` names an admission priority class (``X-Priority``) —
+        under overload, lower classes shed first."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -342,7 +370,7 @@ class DecodeEngine:
                 self._c["circuit_rejected"].inc()
                 raise CircuitOpenError(retry_after=self._breaker.retry_after())
             try:
-                self._admission.admit()
+                self._admission.admit(priority)
             except Exception:
                 self._c["shed"].inc()
                 raise
@@ -530,10 +558,20 @@ class DecodeEngine:
     def circuit_state(self) -> CircuitState:
         return self._breaker.state
 
+    def load_score(self) -> float:
+        """Dispatch load score for a replica pool: admitted-but-unfinished
+        sequences plus the fraction of cache slots busy (a replica with
+        free slots is cheaper than one continuously batching at
+        capacity)."""
+        return (float(self._admission.pending)
+                + float(self._active.sum()) / max(1, self.slots))
+
     def stats(self) -> dict:
         counts = {k: int(c.value) for k, c in self._c.items()}
         counts.update({
             "in_flight": self._admission.pending,
+            # the engine-list aggregation key health()/pools sum over
+            "queue_depth": self._admission.pending,
             "active_slots": int(self._active.sum()),
             "slots": self.slots,
             "tokens": int(self._c_tokens.value),

@@ -121,6 +121,7 @@ class ParallelInference:
         workers: int = 2,
         queue_limit: int = 256,
         default_timeout: Optional[float] = None,
+        flush_timeout: float = 0.0,
         circuit_breaker: Optional[CircuitBreaker] = None,
         admission: Optional[AdmissionController] = None,
         clock: Callable[[], float] = time.monotonic,
@@ -132,6 +133,16 @@ class ParallelInference:
     ) -> None:
         self.mode = inference_mode
         self.batch_limit = int(batch_limit)
+        # effective batching parameters — what the workers actually obey.
+        # ``batch_limit`` stays the hard ceiling (it defines the warmed
+        # bucket shapes); adaptive batching (parallel/pool.AdaptiveBatcher)
+        # moves these two at runtime via :meth:`set_batching`.
+        self._eff_batch_limit = self.batch_limit
+        # flush timeout: with work still budgeted and the queue empty, a
+        # worker waits up to this long (WALL clock — it parks on the real
+        # queue) for more requests before firing an under-full batch.
+        # 0.0 = fire immediately (the pre-pool behavior).
+        self._flush_timeout = float(flush_timeout)
         self.default_timeout = default_timeout
         self._clock = clock
         self._fault_injector = fault_injector
@@ -147,6 +158,7 @@ class ParallelInference:
         self._lock = threading.Lock()
         self._stats_lock = threading.Lock()
         self._idle = threading.Condition(self._stats_lock)
+        self._inflight_batches = 0  # workers currently inside a forward
         self._init_metrics(registry if registry is not None else get_registry())
 
         self._servable = self.make_servable(model, version=model_version)
@@ -233,6 +245,41 @@ class ParallelInference:
         sizes.append(self.batch_limit)
         return sizes
 
+    # ----- adaptive batching (pool.AdaptiveBatcher writes, workers read)
+    @property
+    def effective_batch_limit(self) -> int:
+        return self._eff_batch_limit
+
+    @property
+    def flush_timeout(self) -> float:
+        return self._flush_timeout
+
+    def set_batching(self, max_batch: Optional[int] = None,
+                     flush_timeout: Optional[float] = None) -> tuple:
+        """Adjust the *effective* batching parameters at runtime. The
+        effective max batch is clamped to ``[1, batch_limit]`` so every
+        emitted bucket stays within the warmed compile shapes; the flush
+        timeout is clamped non-negative. Returns the applied
+        ``(max_batch, flush_timeout)`` pair. Plain attribute writes —
+        workers pick the new values up on their next batch."""
+        if max_batch is not None:
+            self._eff_batch_limit = max(1, min(int(max_batch),
+                                               self.batch_limit))
+            self._g_eff_batch.set(self._eff_batch_limit)
+        if flush_timeout is not None:
+            self._flush_timeout = max(0.0, float(flush_timeout))
+            self._g_flush.set(self._flush_timeout)
+        return self._eff_batch_limit, self._flush_timeout
+
+    def load_score(self) -> float:
+        """Dispatch load score for a replica pool: requests admitted but
+        not yet settled (queued + batching + in-forward), plus a small
+        term for workers currently inside a forward so two replicas with
+        empty queues still rank by in-flight work."""
+        with self._stats_lock:
+            inflight = self._inflight_batches
+        return float(self._admission.pending) + 0.5 * inflight
+
     # ----- metrics ----------------------------------------------------
     def _init_metrics(self, reg: MetricsRegistry) -> None:
         """Carve this instance's children out of the (shared) registry.
@@ -263,6 +310,20 @@ class ParallelInference:
         self._g_max_batch = reg.gauge(
             "dl4j_tpu_inference_batch_size_max",
             "Largest dynamic batch observed", ("instance",)).labels(inst)
+        # adaptive-batching knobs as gauges so a dashboard can watch the
+        # AIMD controller move them (parallel/pool.AdaptiveBatcher)
+        self._g_eff_batch = reg.gauge(
+            "dl4j_tpu_inference_effective_batch_limit",
+            "Current effective max dynamic batch (adaptive batching; hard "
+            "ceiling is the construction-time batch_limit)",
+            ("instance",)).labels(inst)
+        self._g_eff_batch.set(self._eff_batch_limit)
+        self._g_flush = reg.gauge(
+            "dl4j_tpu_inference_flush_timeout_seconds",
+            "Current batch flush timeout: how long a worker waits for more "
+            "requests before firing an under-full batch",
+            ("instance",)).labels(inst)
+        self._g_flush.set(self._flush_timeout)
         # family (not child): each Servable carves out its own
         # model_version child at make_servable time
         self._model_req_family = reg.counter(
@@ -286,14 +347,24 @@ class ParallelInference:
             "Admission controller decisions", ("instance", "decision"))
         self._adm_children = {d: adm.labels(inst, d)
                               for d in ("admitted", "shed")}
+        # per-priority shed attribution — under overload the admission
+        # controller refuses low-priority traffic first; this counter is
+        # the proof on /metrics (family held: classes appear as shed)
+        self._shed_pri_family = reg.counter(
+            "dl4j_tpu_resilience_shed_by_priority_total",
+            "Requests shed by the admission controller, by priority class "
+            "('default' when priority classes are not configured)",
+            ("instance", "priority"))
         self._g_circuit.set(_CIRCUIT_CODE[self._breaker.state])
 
         def on_transition(old, new, _t=transitions, _inst=inst):
             self._g_circuit.set(_CIRCUIT_CODE[new])
             _t.labels(_inst, old.value, new.value).inc()
 
-        def on_admission(decision, _pending):
+        def on_admission(decision, _pending, priority="default"):
             self._adm_children[decision].inc()
+            if decision == "shed":
+                self._shed_pri_family.labels(inst, priority).inc()
 
         self._circuit_observer = on_transition
         self._admission_observer = on_admission
@@ -306,11 +377,14 @@ class ParallelInference:
         return self.output_async(x, timeout=timeout).result()
 
     def output_async(self, x, *, timeout: Optional[float] = None,
-                     deadline: Optional[Deadline] = None) -> Future:
+                     deadline: Optional[Deadline] = None,
+                     priority: Optional[str] = None) -> Future:
         """Fail-fast enqueue. Raises :class:`AdmissionRejectedError` when
         the pending window is full (shed — retryable), and
         :class:`CircuitOpenError` while the breaker is hard-open (the
-        forward is known-poisoned; don't queue work behind it)."""
+        forward is known-poisoned; don't queue work behind it).
+        ``priority`` names an admission-controller priority class (HTTP
+        ``X-Priority``); under overload, lower classes shed first."""
         if deadline is None:
             deadline = Deadline.after(
                 timeout if timeout is not None else self.default_timeout,
@@ -333,7 +407,7 @@ class ParallelInference:
                 self._c["circuit_rejected"].inc()
                 raise CircuitOpenError(retry_after=self._breaker.retry_after())
             try:
-                self._admission.admit()
+                self._admission.admit(priority)
             except Exception:
                 self._c["shed"].inc()
                 raise
@@ -390,16 +464,32 @@ class ParallelInference:
         counts = {k: int(c.value) for k, c in self._c.items()}
         batches = int(self._c_batches.value)
         rows = int(self._c_rows.value)
+        padded = int(self._c_padded.value)
         counts.update({
             "queue_depth": self._admission.pending,
             "circuit_state": self._breaker.state.value,
             "batches": batches,
             "mean_batch_size": (rows / batches) if batches else 0.0,
             "max_batch_size": int(self._g_max_batch.value),
-            "padded_rows": int(self._c_padded.value),
+            "padded_rows": padded,
+            # derived ratios are None before any traffic (the PR-7
+            # zero-fetch convention) instead of a misleading 0.0
+            "padded_row_share": (padded / (rows + padded)
+                                 if (rows + padded) else None),
+            "batch_fill": ((rows / batches) / self._eff_batch_limit
+                           if batches else None),
+            # the *effective* batching parameters (what workers obey now
+            # — adaptive batching moves them; batch_limit is the ceiling)
+            "effective_batch_limit": self._eff_batch_limit,
+            "flush_timeout_s": self._flush_timeout,
+            "load_score": self.load_score(),
             "draining": self._draining,
             "model_version": self._servable.version,
         })
+        adm = self._admission.stats()
+        if "by_priority" in adm:
+            counts["shed_by_priority"] = {
+                p: v["shed"] for p, v in adm["by_priority"].items()}
         return counts
 
     @property
@@ -451,12 +541,28 @@ class ParallelInference:
     def _drain_batch(self, first: _Request) -> List[_Request]:
         items = [first]
         if self.mode is InferenceMode.BATCHED:
-            budget = self.batch_limit - first.rows
+            budget = self._eff_batch_limit - first.rows
+            flush_at: Optional[float] = None
             while budget > 0:
                 try:
                     nxt = self._queue.get_nowait()
                 except queue.Empty:
-                    break
+                    # flush timeout: with budget left, park briefly for
+                    # more requests so moderate load still fills batches.
+                    # Wall clock on purpose — the wait parks on the real
+                    # queue; the injectable request clock stays fake.
+                    ft = self._flush_timeout
+                    if ft <= 0.0:
+                        break
+                    if flush_at is None:
+                        flush_at = time.monotonic() + ft
+                    rem = flush_at - time.monotonic()
+                    if rem <= 0:
+                        break
+                    try:
+                        nxt = self._queue.get(timeout=rem)
+                    except queue.Empty:
+                        break
                 if nxt is None:
                     self._queue.put(None)
                     break
@@ -495,6 +601,8 @@ class ParallelInference:
             t_fwd = t_done = 0.0
             fwd_ok = False
             n = padded_n = 0
+            with self._stats_lock:
+                self._inflight_batches += 1
             try:
                 arrays = []
                 sizes = []
@@ -541,6 +649,8 @@ class ParallelInference:
                     if not req.fut.done():
                         req.fut.set_exception(e)
             finally:
+                with self._stats_lock:
+                    self._inflight_batches -= 1
                 # spans before _finish: futures are already settled (the
                 # caller is not waiting on this), and recording first
                 # means drain()/shutdown() imply all spans are flushed

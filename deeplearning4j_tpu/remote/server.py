@@ -52,6 +52,16 @@ ModelManager` endpoints add
                              that version is not currently serving) and
                              ``X-Request-Id`` is the canary routing key.
 
+Replica-pool serving (README "Replica pools & caching"): a
+:class:`~deeplearning4j_tpu.parallel.pool.EnginePool` passed as
+``pool=`` serves the main POST path through power-of-two-choices
+dispatch over N replicas. Request headers: ``X-Priority`` names an
+admission priority class (low classes shed first under overload — also
+honored on the single-engine, managed-model and generate routes);
+``X-Cache-Bypass`` (or ``Cache-Control: no-cache``) skips the pool's
+content-hash response cache. Responses carry ``X-Cache:
+hit|miss|bypass`` when the cache is configured.
+
 Generation serving (README "Generation serving"): a
 :class:`~deeplearning4j_tpu.parallel.decode.DecodeEngine` passed as
 ``generator=`` adds
@@ -139,9 +149,18 @@ class JsonModelServer:
                  managers: Optional[dict] = None,
                  tracer: Optional[Tracer] = None,
                  generator=None,
-                 generate_path: str = "/v1/generate") -> None:
+                 generate_path: str = "/v1/generate",
+                 pool=None) -> None:
+        if model is not None and pool is not None:
+            raise ValueError("pass model= (server-owned engine) or pool= "
+                             "(caller-owned EnginePool), not both")
         self.model = model
         self.path = path
+        # EnginePool behind the main POST path (caller-owned lifecycle,
+        # like managers=/generator= — the server routes to it, threads
+        # the X-Priority / X-Cache-Bypass headers through, and drains it
+        # on stop; shutdown stays with the caller)
+        self._pool = pool
         # DecodeEngine for POST /v1/generate (caller-owned lifecycle,
         # like managers= — the server routes to it and drains it on stop)
         self._generator = generator
@@ -258,12 +277,31 @@ class JsonModelServer:
                         outer._observe_request(
                             self._sent_code, time.perf_counter() - t0)
 
+            def _priority(self):
+                """``X-Priority`` header → admission priority class (None
+                when absent; unknown names resolve to the strictest class
+                inside the controller — headers are client-controlled)."""
+                p = self.headers.get("X-Priority")
+                return p.strip() if p else None
+
             def _submit_fn(self):
                 """Resolve the POST path to a ``(data, deadline) ->
                 (future, version|None)`` submitter, or answer 404."""
+                prio = self._priority()
+                if self.path == outer.path and outer._pool is not None:
+                    # cache controls: X-Cache-Bypass (any value) or
+                    # Cache-Control: no-cache skip lookup AND fill
+                    cc = (self.headers.get("Cache-Control") or "").lower()
+                    bypass = (self.headers.get("X-Cache-Bypass") is not None
+                              or "no-cache" in cc)
+                    return lambda data, deadline: (
+                        outer._pool.output_async(
+                            data, deadline=deadline, priority=prio,
+                            use_cache=not bypass), None)
                 if self.path == outer.path and outer._pi is not None:
                     return lambda data, deadline: (
-                        outer._pi.output_async(data, deadline=deadline), None)
+                        outer._pi.output_async(data, deadline=deadline,
+                                               priority=prio), None)
                 if self.path.startswith(_MODELS_PREFIX + "/"):
                     mname = self.path[len(_MODELS_PREFIX) + 1:]
                     mgr = outer._managers.get(mname)
@@ -276,7 +314,8 @@ class JsonModelServer:
                     # is always attributable to an id the client saw
                     key = self._request_id
                     return lambda data, deadline: mgr.submit(
-                        data, key=key, version=pin, deadline=deadline)
+                        data, key=key, version=pin, deadline=deadline,
+                        priority=prio)
                 self._send(404, {"error": f"unknown path {self.path}"})
                 return None
 
@@ -306,7 +345,8 @@ class JsonModelServer:
                         raise RuntimeError("draining")
                     handle = outer._generator.submit(
                         prompt, deadline=deadline,
-                        request_id=self._request_id, **kw)
+                        request_id=self._request_id,
+                        priority=self._priority(), **kw)
                 except ValueError as e:
                     self._send(400, {"error": str(e)})
                     return
@@ -377,10 +417,14 @@ class JsonModelServer:
                         raise RuntimeError("draining")
                     fut, version = submit(data, deadline)
                     out = fut.result(timeout=deadline.remaining())
-                    headers = ({"X-Model-Version": str(version)}
-                               if version is not None else None)
+                    headers = {}
+                    if version is not None:
+                        headers["X-Model-Version"] = str(version)
+                    cache_state = getattr(fut, "_dl4j_cache", None)
+                    if cache_state is not None:
+                        headers["X-Cache"] = cache_state
                     self._send(200, {"output": np.asarray(out).tolist()},
-                               headers)
+                               headers or None)
                 except VersionNotFoundError as e:
                     self._send(404, {"error": str(e)})
                 except AdmissionRejectedError as e:
@@ -448,19 +492,40 @@ class JsonModelServer:
 
     def health(self) -> tuple:
         """({"status": ...}, http_code). Truthful: draining while stopping,
-        degraded while any live breaker is not closed, ok otherwise."""
+        degraded while any live breaker is not closed, ok otherwise.
+        EVERY engine the server routes to counts: the main engine,
+        managed models, the decode generator (a tripped generate circuit
+        must not report ok/200) and a replica pool (whose aggregate state
+        is CLOSED while any replica is healthy — one sick replica out of
+        N degrades that replica's traffic, not the whole node's health;
+        per-replica circuits are itemized in the payload)."""
         engines = ([] if self._pi is None else [self._pi]) + \
             [m.engine for m in self._managers.values()]
         circuits = [e.circuit_state for e in engines]
+        queue_depth = sum(e.stats()["queue_depth"] for e in engines)
+        payload = {}
+        if self._pool is not None:
+            circuits.append(self._pool.circuit_state)
+            queue_depth += self._pool._admission.pending
+            payload["pool"] = {
+                "replicas": {e.name: e.circuit_state.value
+                             for e in (self._pool.replicas
+                                       + self._pool.decode_replicas)},
+                "circuit": self._pool.circuit_state.value,
+            }
+        if self._generator is not None:
+            gen_circuit = self._generator.circuit_state
+            circuits.append(gen_circuit)
+            queue_depth += self._generator.stats()["queue_depth"]
+            payload["generate"] = {"circuit": gen_circuit.value}
         if self._draining:
             status = "draining"
         elif any(c is not CircuitState.CLOSED for c in circuits):
             status = "degraded"
         else:
             status = "ok"
-        payload = {"status": status,
-                   "queue_depth": sum(e.stats()["queue_depth"]
-                                      for e in engines)}
+        payload["status"] = status
+        payload["queue_depth"] = queue_depth
         if self._pi is not None:
             payload["circuit"] = self._pi.circuit_state.value
         if self._managers:
@@ -472,6 +537,8 @@ class JsonModelServer:
 
     def stats(self) -> dict:
         s = {} if self._pi is None else self._pi.stats()
+        if self._pool is not None:
+            s["pool"] = self._pool.stats()
         if self._managers:
             s["models"] = {n: m.stats()
                            for n, m in sorted(self._managers.items())}
@@ -496,6 +563,8 @@ class JsonModelServer:
         if drain:
             if self._pi is not None:
                 self._pi.drain(timeout=drain_timeout)
+            if self._pool is not None:
+                self._pool.drain(timeout=drain_timeout)
             for m in self._managers.values():
                 m.engine.drain(timeout=drain_timeout)
             if self._generator is not None:

@@ -26,6 +26,14 @@ system for large-scale machine learning", PAPERS.md). The sequence a
    prior servable automatically
    (``dl4j_tpu_serving_swap_total{outcome="rolled_back"}``).
 
+The same sequence drives a replica pool unchanged: pass
+``engine=EnginePool(...)`` and deploy warms the candidate on every
+replica (one warmup pass executes each replica's jitted forward), then
+swaps all replicas atomically-per-replica with rollback on partial
+failure (:meth:`~deeplearning4j_tpu.parallel.pool.EnginePool.swap`).
+The probation breaker is shared across replicas — probation judges the
+*version*, not a replica.
+
 Canary rollout runs the candidate on a *second* engine behind a
 :class:`~.router.ModelRouter` (deterministic hash split or shadow
 mirroring) before it ever owns 100% of traffic; a canary breaker-open
@@ -466,21 +474,25 @@ class ModelManager:
     # ----- request path -----------------------------------------------
     def submit(self, x, *, key: Optional[str] = None,
                version: Optional[Union[int, str]] = None,
-               timeout: Optional[float] = None, deadline=None):
+               timeout: Optional[float] = None, deadline=None,
+               priority: Optional[str] = None):
         """Route one request; returns ``(future, version_str)``. A pinned
         ``version`` must be resident and serving (the live version, or
         the canary) — pinning is how a client deterministically hits the
-        canary or asserts which version answered."""
+        canary or asserts which version answered. ``priority`` names an
+        admission priority class (HTTP ``X-Priority``)."""
         if version is not None:
             want = str(version).lstrip("v")
             if want == self._live.version:
                 fut = self.engine.output_async(
-                    x, timeout=timeout, deadline=deadline)
+                    x, timeout=timeout, deadline=deadline,
+                    priority=priority)
                 return fut, self._live.version
             canary, engine = self._canary, self._canary_engine
             if canary is not None and want == canary.version:
                 fut = engine.output_async(
-                    x, timeout=timeout, deadline=deadline)
+                    x, timeout=timeout, deadline=deadline,
+                    priority=priority)
                 return fut, canary.version
             raise VersionNotFoundError(
                 f"{self.model_name} v{want} is not currently serving "
@@ -489,9 +501,11 @@ class ModelManager:
         router = self._router
         if router is not None:
             fut, _target, served = router.submit(
-                x, key=key, timeout=timeout, deadline=deadline)
+                x, key=key, timeout=timeout, deadline=deadline,
+                priority=priority)
             return fut, served
-        fut = self.engine.output_async(x, timeout=timeout, deadline=deadline)
+        fut = self.engine.output_async(x, timeout=timeout, deadline=deadline,
+                                       priority=priority)
         return fut, self._live.version
 
     def output(self, x, *, key: Optional[str] = None,

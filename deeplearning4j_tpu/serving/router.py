@@ -94,16 +94,22 @@ class ModelRouter:
 
     def submit(self, x, *, key: Optional[str] = None,
                timeout: Optional[float] = None,
-               deadline=None) -> Tuple[Future, str, str]:
+               deadline=None,
+               priority: Optional[str] = None) -> Tuple[Future, str, str]:
         """Route one request. Returns ``(future, target, version)`` where
         ``target`` is ``"primary"``/``"canary"`` and ``version`` the
-        model version of the backend that owns the response."""
+        model version of the backend that owns the response.
+        ``priority`` is forwarded to the backend's admission controller."""
         x = np.asarray(x)
         if self.shadow is not None:
             self._mirror(x, timeout)
         target = self.assign(x, key=key)
         backend = self.canary if target == CANARY else self.primary
-        fut = backend.output_async(x, timeout=timeout, deadline=deadline)
+        # only forward priority when set: the documented backend duck
+        # type is output_async(x, timeout=, deadline=)
+        kw = {} if priority is None else {"priority": priority}
+        fut = backend.output_async(x, timeout=timeout, deadline=deadline,
+                                   **kw)
         self._c[target].inc()
         return fut, target, backend.model_version
 

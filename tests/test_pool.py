@@ -1,0 +1,552 @@
+"""EnginePool unit suite (ISSUE 10): power-of-two-choices dispatch,
+circuit skip, least-loaded fallback, priority-aware admission, the
+content-hash response cache, AIMD adaptive batching, and pool-wide hot
+swap with per-replica rollback.
+
+Dispatch-distribution tests run against lightweight fake replicas (the
+pool's replica protocol: ``name``, ``output_async``, ``load_score``,
+``circuit_state``, ``_breaker``) so the arrival pattern and load decay
+are fully deterministic under the pool's seeded RNG; swap/manager tests
+use real engines over a tiny model.
+"""
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.core.resilience import (
+    AdmissionController,
+    AdmissionRejectedError,
+    CircuitBreaker,
+    CircuitOpenError,
+    CircuitState,
+    Deadline,
+)
+from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+from deeplearning4j_tpu.parallel import EnginePool, ParallelInference
+from deeplearning4j_tpu.parallel.pool import (
+    SWAP_SITE,
+    AdaptiveBatcher,
+    PoolServable,
+    ResponseCache,
+)
+
+
+def _tiny_model(seed=5):
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+class FakeReplica:
+    """Replica protocol stub: backlog-driven load score, optional breaker
+    on a fake clock, scripted shed behavior."""
+
+    def __init__(self, name, clock=None):
+        self.name = name
+        self.backlog = 0.0
+        self.calls = 0
+        self.shed_next = False
+        self._breaker = CircuitBreaker(clock=clock or time.monotonic)
+
+    @property
+    def circuit_state(self):
+        return self._breaker.state
+
+    def load_score(self):
+        return float(self.backlog)
+
+    def output_async(self, x, *, timeout=None, deadline=None, priority=None):
+        if self.shed_next:
+            raise AdmissionRejectedError("replica full")
+        self.calls += 1
+        self.backlog += 1
+        fut = Future()
+        fut.set_result(np.asarray(x))
+        return fut
+
+
+def _fake_pool(n=3, seed=7, clock=None, **kw):
+    reg = MetricsRegistry()
+    replicas = [FakeReplica(f"f{i}", clock=clock) for i in range(n)]
+    kw.setdefault("max_pending", 100_000)
+    pool = EnginePool(engines=replicas, registry=reg, seed=seed,
+                      name="tp", **kw)
+    return pool, replicas, reg
+
+
+# --------------------------------------------------------------------------
+# dispatch
+# --------------------------------------------------------------------------
+class TestPowerOfTwoChoices:
+    def test_balance_within_2x_on_skewed_arrivals(self):
+        """ISSUE 10 satellite: deterministic seed, bursty (skewed) arrival
+        pattern, per-replica drain between bursts — max/min per-replica
+        dispatch counts stay within 2x and every replica serves."""
+        pool, replicas, _ = _fake_pool(n=3, seed=7)
+        rng = np.random.RandomState(42)
+        bursts = rng.randint(1, 13, size=60)  # skewed: bursts of 1..12
+        for burst in bursts:
+            for _ in range(int(burst)):
+                pool.output_async(np.ones((1, 4), np.float32)).result()
+            for r in replicas:  # constant drain between bursts
+                r.backlog = max(0.0, r.backlog - 3.0)
+        counts = [r.calls for r in replicas]
+        assert sum(counts) == int(bursts.sum())
+        assert min(counts) > 0, counts
+        assert max(counts) <= 2 * min(counts), counts
+        s = pool.stats()
+        assert s["dispatched"] == {r.name: r.calls for r in replicas}
+        pool.shutdown(drain=False)
+
+    def test_open_circuit_replica_gets_zero_dispatches_until_half_open(self):
+        """ISSUE 10 satellite: a tripped replica receives nothing while
+        hard-open; once the open timeout elapses (half-open) it re-enters
+        the candidate set."""
+        t = [0.0]
+        pool, replicas, _ = _fake_pool(n=2, seed=3, clock=lambda: t[0])
+        bad = replicas[1]
+        for _ in range(5):  # trip: 5/5 failures over the window
+            bad._breaker.record_failure()
+        assert bad.circuit_state is CircuitState.OPEN
+        for _ in range(50):
+            pool.output_async(np.ones((1, 4), np.float32)).result()
+            for r in replicas:
+                r.backlog = 0.0
+        assert bad.calls == 0
+        assert replicas[0].calls == 50
+        t[0] += 31.0  # default open_timeout=30 elapses -> half-open
+        for _ in range(20):
+            pool.output_async(np.ones((1, 4), np.float32)).result()
+            for r in replicas:
+                r.backlog = 0.0
+        assert bad.calls > 0  # probes flow again
+        pool.shutdown(drain=False)
+
+    def test_least_loaded_fallback_when_chosen_replica_sheds(self):
+        pool, replicas, _ = _fake_pool(n=2, seed=0)
+        a, b = replicas
+        a.shed_next = True      # the attractive replica refuses
+        a.backlog, b.backlog = 0.0, 5.0  # p2c must pick a first
+        fut = pool.output_async(np.ones((1, 4), np.float32))
+        assert fut.result() is not None
+        assert b.calls == 1 and a.calls == 0
+        assert pool.stats()["dispatch_errors"].get("f0") == 1
+        pool.shutdown(drain=False)
+
+    def test_all_circuits_open_raises_circuit_open(self):
+        pool, replicas, _ = _fake_pool(n=2, seed=0)
+        for r in replicas:
+            for _ in range(5):
+                r._breaker.record_failure()
+        with pytest.raises(CircuitOpenError) as ei:
+            pool.output_async(np.ones((1, 4), np.float32))
+        assert ei.value.retry_after > 0
+        assert pool._admission.pending == 0  # the slot was released
+        pool.shutdown(drain=False)
+
+    def test_injected_dispatch_fault_charges_the_target_replica(self):
+        """The per-replica engine_pool.dispatch.<name> site: the fault is
+        recorded as that replica's failure (its breaker accumulates) and
+        the request falls over to another replica."""
+        from deeplearning4j_tpu.core.resilience import FaultInjector
+        from deeplearning4j_tpu.parallel.pool import DISPATCH_SITE
+
+        inj = FaultInjector()
+        pool, replicas, _ = _fake_pool(n=2, seed=0,
+                                       fault_injector=inj)
+        a, b = replicas
+        a.backlog, b.backlog = 0.0, 5.0  # force choice of a
+        inj.inject_error(f"{DISPATCH_SITE}.f0",
+                         lambda: RuntimeError("link down"), times=1)
+        fut = pool.output_async(np.ones((1, 4), np.float32))
+        assert fut.result() is not None
+        assert b.calls == 1 and a.calls == 0
+        assert pool.stats()["dispatch_errors"]["f0"] == 1
+        pool.shutdown(drain=False)
+
+
+# --------------------------------------------------------------------------
+# priority admission
+# --------------------------------------------------------------------------
+class TestPriorityAdmission:
+    def test_shed_order_low_first(self):
+        ac = AdmissionController(max_pending=10,
+                                 priorities={"high": 1.0, "low": 0.5})
+        for _ in range(5):
+            ac.admit("low")  # low's window: 5 of 10
+        with pytest.raises(AdmissionRejectedError):
+            ac.admit("low")
+        for _ in range(5):
+            ac.admit("high")  # high still fits up to the full window
+        with pytest.raises(AdmissionRejectedError):
+            ac.admit("high")
+        by = ac.stats()["by_priority"]
+        assert by["low"]["admitted"] == 5 and by["low"]["shed"] == 1
+        assert by["high"]["admitted"] == 5 and by["high"]["shed"] == 1
+
+    def test_weighted_token_buckets(self):
+        t = [0.0]
+        ac = AdmissionController(max_pending=100, rate=10.0, burst=10.0,
+                                 priorities={"high": 1.0, "low": 0.25},
+                                 clock=lambda: t[0])
+        # shares: high 0.8, low 0.2 -> bursts of 8 and 2 tokens
+        assert sum(ac.try_admit("low") for _ in range(5)) == 2
+        assert sum(ac.try_admit("high") for _ in range(10)) == 8
+        t[0] += 1.0  # +10 tokens split 8/2
+        assert ac.try_admit("low")
+        assert ac.try_admit("high")
+
+    def test_unknown_priority_is_strictest(self):
+        ac = AdmissionController(max_pending=10,
+                                 priorities={"high": 1.0, "low": 0.5})
+        for _ in range(5):
+            ac.admit("high")
+        with pytest.raises(AdmissionRejectedError):
+            ac.admit("???")  # resolves to the lowest class: window 5
+        assert ac.stats()["by_priority"]["low"]["shed"] == 1
+
+    def test_default_and_no_priorities_unchanged(self):
+        ac = AdmissionController(max_pending=2)
+        ac.admit()
+        ac.admit("anything")  # no classes configured: plain window
+        with pytest.raises(AdmissionRejectedError):
+            ac.admit()
+        assert "by_priority" not in ac.stats()
+
+    def test_observer_arity_both_supported(self):
+        ac = AdmissionController(max_pending=1,
+                                 priorities={"high": 1.0, "low": 0.5})
+        two, three = [], []
+        ac.add_observer(lambda decision, pending: two.append(decision))
+        ac.add_observer(
+            lambda decision, pending, priority: three.append(priority))
+        ac.admit("high")
+        assert not ac.try_admit("low")
+        assert two == ["admitted", "shed"]
+        assert three == ["high", "low"]
+
+    def test_pool_sheds_low_priority_first(self):
+        # hold slots open: futures that never resolve
+        class Pending(FakeReplica):
+            def output_async(self, x, **kw):
+                self.calls += 1
+                return Future()  # never resolves -> pool slot stays held
+
+        reg = MetricsRegistry()
+        pool = EnginePool(engines=[Pending("p0"), Pending("p1")],
+                          registry=reg, seed=1, max_pending=8,
+                          priorities={"high": 1.0, "low": 0.5}, name="tp")
+        for _ in range(4):
+            pool.output_async(np.ones((1, 4), np.float32), priority="low")
+        with pytest.raises(AdmissionRejectedError):
+            pool.output_async(np.ones((1, 4), np.float32), priority="low")
+        pool.output_async(np.ones((1, 4), np.float32), priority="high")
+        s = pool.stats()
+        assert s["shed_by_priority"]["low"] == 1
+        assert s["shed_by_priority"].get("high", 0) == 0
+        shed = reg.get("dl4j_tpu_pool_shed_total")
+        assert shed.labels("tp", "low").value == 1
+        pool.shutdown(drain=False)
+
+
+# --------------------------------------------------------------------------
+# response cache
+# --------------------------------------------------------------------------
+class TestResponseCache:
+    def test_ttl_and_lru_bounds(self):
+        t = [0.0]
+        c = ResponseCache(max_entries=2, ttl_seconds=10.0, clock=lambda: t[0])
+        x = np.ones((1, 4), np.float32)
+        k1 = ResponseCache.key("1", x)
+        c.put(k1, np.zeros(3))
+        assert c.get(k1) is not None
+        t[0] += 10.0  # expired exactly at ttl
+        assert c.get(k1) is None
+        c.put(k1, np.zeros(3))
+        k2 = ResponseCache.key("1", x * 2)
+        k3 = ResponseCache.key("1", x * 3)
+        c.put(k2, np.ones(3))
+        c.get(k1)  # renew k1's recency
+        c.put(k3, np.ones(3))  # evicts k2 (LRU), not k1
+        assert c.get(k1) is not None and c.get(k2) is None
+        assert len(c) == 2
+
+    def test_key_binds_version_dtype_shape(self):
+        x = np.ones((2, 2), np.float32)
+        assert ResponseCache.key("1", x) != ResponseCache.key("2", x)
+        assert ResponseCache.key("1", x) != ResponseCache.key(
+            "1", x.astype(np.float64))
+        assert ResponseCache.key("1", x) != ResponseCache.key(
+            "1", x.reshape(1, 4))
+
+    def test_pool_cache_hit_bypasses_dispatch(self):
+        pool, replicas, _ = _fake_pool(n=2, seed=0, cache_entries=8,
+                                       cache_ttl=60.0)
+        x = np.ones((1, 4), np.float32)
+        f1 = pool.output_async(x)
+        f1.result()
+        assert f1._dl4j_cache == "miss"
+        total = sum(r.calls for r in replicas)
+        f2 = pool.output_async(x)
+        assert f2._dl4j_cache == "hit"
+        assert sum(r.calls for r in replicas) == total  # no dispatch
+        f3 = pool.output_async(x, use_cache=False)
+        f3.result()
+        assert f3._dl4j_cache == "bypass"
+        assert sum(r.calls for r in replicas) == total + 1
+        cs = pool.stats()["cache"]
+        assert cs == {"hits": 1, "misses": 1, "bypass": 1, "entries": 1,
+                      "hit_rate": 0.5}
+        pool.shutdown(drain=False)
+
+    def test_zero_lookup_hit_rate_is_none(self):
+        pool, _, _ = _fake_pool(n=2, seed=0, cache_entries=8)
+        assert pool.stats()["cache"]["hit_rate"] is None
+        pool.shutdown(drain=False)
+
+
+# --------------------------------------------------------------------------
+# adaptive batching
+# --------------------------------------------------------------------------
+class TestAdaptiveBatching:
+    def _engine(self):
+        reg = MetricsRegistry()
+        return ParallelInference(_tiny_model(), batch_limit=32, workers=1,
+                                 registry=reg, name="ab")
+
+    def test_aimd_grow_and_shrink(self):
+        pi = self._engine()
+        try:
+            b = AdaptiveBatcher(pi, target_p95_s=0.05, grow_step=2,
+                                max_flush_timeout=0.01, flush_step=0.002)
+            assert b.tick() is None  # no traffic -> no action
+            # fast forwards + deep queue -> additive batch growth
+            for _ in range(20):
+                pi._h_forward.observe(0.001)
+            for _ in range(40):
+                pi._admission.admit()
+            obs = b.tick()
+            assert obs["action"] == "grow_batch"
+            assert pi.effective_batch_limit == 32 + 2 - 2  # clamped at 32
+            # fast forwards + shallow queue -> flush timeout grows
+            for _ in range(40):
+                pi._admission.release()
+            pi.set_batching(8, 0.0)
+            for _ in range(20):
+                pi._h_forward.observe(0.001)
+            obs = b.tick()
+            assert obs["action"] == "grow_flush"
+            assert pi.flush_timeout == pytest.approx(0.002)
+            # p95 breach -> multiplicative decrease of both
+            for _ in range(20):
+                pi._h_forward.observe(0.2)
+            obs = b.tick()
+            assert obs["action"] == "shrink"
+            assert pi.effective_batch_limit == 4
+            assert pi.flush_timeout == pytest.approx(0.001)
+        finally:
+            pi.shutdown(drain=False)
+
+    def test_set_batching_clamps(self):
+        pi = self._engine()
+        try:
+            assert pi.set_batching(10_000, -3.0) == (32, 0.0)
+            assert pi.set_batching(0, None) == (1, 0.0)
+            s = pi.stats()
+            assert s["effective_batch_limit"] == 1
+            assert s["flush_timeout_s"] == 0.0
+            # zero-request derived ratios are None, not 0-division
+            assert s["padded_row_share"] is None
+            assert s["batch_fill"] is None
+        finally:
+            pi.shutdown(drain=False)
+
+    def test_flush_timeout_coalesces_requests(self):
+        reg = MetricsRegistry()
+        pi = ParallelInference(_tiny_model(), batch_limit=8, workers=1,
+                               flush_timeout=0.5, registry=reg, name="ft")
+        try:
+            pi.output(np.ones((1, 4), np.float32))  # warm the jit
+            base = pi.stats()["batches"]
+            futs = [pi.output_async(np.ones((1, 4), np.float32))
+                    for _ in range(4)]
+            for f in futs:
+                f.result(timeout=10)
+            # without the flush wait the warm worker would fire ~4
+            # one-row batches; the wait coalesces them into 1-2
+            assert pi.stats()["batches"] - base <= 2
+        finally:
+            pi.shutdown(drain=False)
+
+
+# --------------------------------------------------------------------------
+# pool-wide hot swap
+# --------------------------------------------------------------------------
+class TestPoolSwap:
+    def test_swap_all_replicas_and_rollback_on_partial_failure(self):
+        class NthFire:
+            """Raises on the n-th firing of one site (lets the swap
+            succeed on replica 0 and fail on replica 1)."""
+
+            def __init__(self, site, n):
+                self.site, self.n, self.count = site, n, 0
+
+            def fire(self, site):
+                if site == self.site:
+                    self.count += 1
+                    if self.count == self.n:
+                        raise RuntimeError("swap wire cut")
+
+        reg = MetricsRegistry()
+        inj = NthFire(SWAP_SITE, 2)
+        pool = EnginePool(model=_tiny_model(1), replicas=2, workers=1,
+                          registry=reg, name="sw", fault_injector=inj)
+        try:
+            x = np.ones((2, 4), np.float32)
+            pool.output(x)
+            with pytest.raises(RuntimeError, match="swap wire cut"):
+                pool.swap_model(_tiny_model(2), version="2")
+            # replica 0 was swapped then rolled back: every replica still
+            # serves the original version
+            assert [e.model_version for e in pool.replicas] == ["0", "0"]
+            pool.output(x)
+            # injector exhausted: the next swap lands everywhere
+            retired = pool.swap_model(_tiny_model(2), version="2")
+            assert [e.model_version for e in pool.replicas] == ["2", "2"]
+            assert retired.version == "0"
+            pool.output(x)
+        finally:
+            pool.shutdown(drain=False)
+
+    def test_model_manager_drives_a_pool(self, tmp_path):
+        from deeplearning4j_tpu.serving import ModelManager, ModelStore
+
+        store = ModelStore(str(tmp_path / "registry"))
+        store.publish("m", _tiny_model(1))
+        store.publish("m", _tiny_model(2))
+        reg = MetricsRegistry()
+        pool = EnginePool(model=store.load("m", 1)[0], replicas=2,
+                          workers=1, registry=reg, name="mg",
+                          model_version="1")
+        mgr = ModelManager(store, "m", engine=pool, registry=reg,
+                           warmup_example=np.ones((1, 4), np.float32),
+                           probation_seconds=0.0)
+        try:
+            x = np.ones((2, 4), np.float32)
+            np.asarray(mgr.output(x))
+            entry = mgr.deploy(2)
+            assert str(entry.version) == "2"
+            # deploy swapped EVERY replica
+            assert [e.model_version for e in pool.replicas] == ["2", "2"]
+            np.asarray(mgr.output(x))
+            mgr.rollback()
+            assert [e.model_version for e in pool.replicas] == ["1", "1"]
+            np.asarray(mgr.output(x))
+        finally:
+            mgr.shutdown(drain=False)
+
+    def test_swap_replica_count_mismatch_rejected(self):
+        reg = MetricsRegistry()
+        pool = EnginePool(model=_tiny_model(1), replicas=2, workers=1,
+                          registry=reg, name="mm")
+        try:
+            sv = PoolServable([pool.replicas[0]._servable], pool.model, "9")
+            with pytest.raises(ValueError, match="replicas"):
+                pool.swap(sv)
+        finally:
+            pool.shutdown(drain=False)
+
+
+# --------------------------------------------------------------------------
+# decode replicas
+# --------------------------------------------------------------------------
+class TestDecodeDispatch:
+    def test_submit_generate_p2c_and_slot_release(self):
+        from deeplearning4j_tpu.parallel.decode import GenerationHandle
+
+        class FakeDecode:
+            def __init__(self, name):
+                self.name = name
+                self.calls = 0
+                self.backlog = 0.0
+                self.handles = []
+                self._breaker = CircuitBreaker()
+
+            @property
+            def circuit_state(self):
+                return self._breaker.state
+
+            def load_score(self):
+                return self.backlog
+
+            def submit(self, prompt, *, priority=None, **kw):
+                self.calls += 1
+                h = GenerationHandle(f"{self.name}-req", Deadline.never())
+                self.handles.append(h)
+                return h
+
+        reg = MetricsRegistry()
+        reps = [FakeDecode("d0"), FakeDecode("d1")]
+        pool = EnginePool(engines=reps, registry=reg, seed=5,
+                          max_pending=16, name="dp")
+        assert pool.decode_replicas == reps and pool.replicas == []
+        handles = []
+        for i in range(6):
+            reps[0].backlog, reps[1].backlog = i % 2, (i + 1) % 2
+            handles.append(pool.submit_generate([1, 2, 3]))
+        assert reps[0].calls + reps[1].calls == 6
+        assert reps[0].calls > 0 and reps[1].calls > 0
+        assert pool._admission.pending == 6
+        for h in handles:
+            h._finish("completed")
+        assert pool._admission.pending == 0
+        # double-finish never over-releases
+        handles[0]._finish("completed")
+        assert pool._admission.pending == 0
+        with pytest.raises(RuntimeError, match="no inference replicas"):
+            pool.output_async(np.ones((1, 4), np.float32))
+        pool.shutdown(drain=False)
+
+
+# --------------------------------------------------------------------------
+# concurrency smoke
+# --------------------------------------------------------------------------
+class TestPoolConcurrency:
+    def test_concurrent_submitters_real_engines(self):
+        reg = MetricsRegistry()
+        pool = EnginePool(model=_tiny_model(), replicas=3, workers=1,
+                          registry=reg, name="cc", cache_entries=4,
+                          seed=11)
+        try:
+            errs = []
+
+            def worker(i):
+                x = np.full((1, 4), float(i % 5), np.float32)
+                try:
+                    for _ in range(10):
+                        np.asarray(pool.output(x))
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert not errs
+            s = pool.stats()
+            served = sum(s["dispatched"].values()) + s["cache"]["hits"]
+            assert served == 80
+            assert s["queue_depth"] == 0  # every pool slot released
+        finally:
+            pool.shutdown(drain=False)

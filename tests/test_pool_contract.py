@@ -1,0 +1,19 @@
+"""Tier-1 wiring for tools/check_pool_contract.py: the replica-pool
+serving contract (README.md "Replica pools & caching") — p2c dispatch
+across all replicas, per-replica fault isolation, priority-aware
+shedding, cache-hit bypass, /metrics visibility — is enforced on every
+test run, not just when someone remembers to run the tool."""
+
+import os
+import sys
+
+_TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+
+
+def test_pool_contract_smoke():
+    sys.path.insert(0, _TOOLS)
+    try:
+        import check_pool_contract
+    finally:
+        sys.path.remove(_TOOLS)
+    assert check_pool_contract.main(log=lambda m: None) == 0

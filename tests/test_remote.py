@@ -322,3 +322,41 @@ def test_graceful_drain_on_stop():
     with pytest.raises(URLError):     # fully stopped: connection refused
         urllib_request.urlopen(
             f"http://127.0.0.1:{srv.port}/health", timeout=2)
+
+
+def test_health_includes_generate_circuit():
+    """ISSUE 10 satellite bugfix: health() must cover the DecodeEngine —
+    a tripped generate circuit previously still reported ok/200 and its
+    queue depth was missing from queue_depth."""
+    from deeplearning4j_tpu.core.resilience import CircuitBreaker
+
+    class StubGenerator:
+        """DecodeEngine health surface: circuit_state + stats()."""
+
+        def __init__(self):
+            self._breaker = CircuitBreaker()
+
+        @property
+        def circuit_state(self):
+            return self._breaker.state
+
+        def stats(self):
+            return {"queue_depth": 2, "in_flight": 2}
+
+        def drain(self, timeout=None):
+            return True
+
+    gen = StubGenerator()
+    srv = JsonModelServer(generator=gen).start()
+    try:
+        payload, code = srv.health()
+        assert code == 200 and payload["status"] == "ok"
+        assert payload["generate"]["circuit"] == "closed"
+        assert payload["queue_depth"] == 2  # generator depth counts now
+        for _ in range(5):  # trip the generate circuit
+            gen._breaker.record_failure()
+        payload, code = srv.health()
+        assert code == 503 and payload["status"] == "degraded", payload
+        assert payload["generate"]["circuit"] == "open"
+    finally:
+        srv.stop(drain=False)
