@@ -15,11 +15,17 @@ from .sampling import (
     greedy,
     make_sampler,
     sample_tokens,
+    speculative_accept,
     temperature,
     top_k,
     top_p,
 )
-from .session import GenerationSession, bucket_length
+from .session import (
+    GenerationSession,
+    SpeculativeGenerationSession,
+    bucket_length,
+    rewind_carry,
+)
 
 
 def __getattr__(name):
@@ -37,10 +43,13 @@ __all__ = [
     "DecodeEngine",
     "GenerationHandle",
     "GenerationSession",
+    "SpeculativeGenerationSession",
     "bucket_length",
     "greedy",
     "make_sampler",
+    "rewind_carry",
     "sample_tokens",
+    "speculative_accept",
     "temperature",
     "top_k",
     "top_p",

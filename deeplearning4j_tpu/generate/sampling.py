@@ -81,15 +81,22 @@ def top_p(logits: jax.Array, key: jax.Array, p: float,
         key, _top_p_logits(_scaled(logits, temp), p), axis=-1).astype(jnp.int32)
 
 
+def _warp(logits, temp, k, p):
+    """Shared logits warping: temperature scale, then top-k, then top-p
+    over the surviving support (``k == 0`` and ``p >= 1`` disable)."""
+    z = _scaled(logits.astype(jnp.float32), temp)
+    z = jnp.where(k > 0, _top_k_logits(z, jnp.maximum(k, 1)), z)
+    z = jnp.where(p < 1.0, _top_p_logits(z, jnp.clip(p, 1e-6, 1.0)), z)
+    return z
+
+
 def _sample_one(logits, seed, step, greedy_flag, temp, k, p):
     """One row of the batched engine sampler. ``k == 0`` disables top-k,
     ``p >= 1`` disables top-p; both compose (top-k first, then top-p over
     the surviving support). Keyed by fold_in(PRNGKey(seed), step) so the
     stream depends only on (seed, position), never on batch composition."""
     key = jax.random.fold_in(jax.random.PRNGKey(seed.astype(jnp.uint32)), step)
-    z = _scaled(logits.astype(jnp.float32), temp)
-    z = jnp.where(k > 0, _top_k_logits(z, jnp.maximum(k, 1)), z)
-    z = jnp.where(p < 1.0, _top_p_logits(z, jnp.clip(p, 1e-6, 1.0)), z)
+    z = _warp(logits, temp, k, p)
     sampled = jax.random.categorical(key, z)
     return jnp.where(greedy_flag, jnp.argmax(logits), sampled).astype(jnp.int32)
 
@@ -111,6 +118,128 @@ def sample_tokens(
                                  steps.astype(jnp.int32), greedy_mask,
                                  temp.astype(jnp.float32),
                                  k.astype(jnp.int32), p.astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding: exact accept-or-resample
+# ---------------------------------------------------------------------------
+
+# fold_in tags keeping the accept-test and residual-resample streams
+# independent of each other AND of the draft's proposal draw at the same
+# (seed, step) — the independence the exactness proof requires
+_ACCEPT_TAG = 0x5A
+_RESID_TAG = 0x5B
+
+
+def _warped_probs(logits, greedy_flag, temp, k, p):
+    """The per-position sampling distribution a request's spec implies:
+    softmax of the warped logits, or a one-hot argmax for greedy rows
+    (greedy == the temperature->0 limit, so the ratio test degenerates to
+    exact token equality and speculative greedy streams stay
+    token-identical to plain greedy)."""
+    probs = jax.nn.softmax(_warp(logits, temp, k, p), axis=-1)
+    hot = jax.nn.one_hot(jnp.argmax(logits, axis=-1), logits.shape[-1],
+                         dtype=probs.dtype)
+    return jnp.where(greedy_flag, hot, probs)
+
+
+def _residual_probs(p_t, p_d):
+    """Normalized max(0, p_t - p_d): the exact residual distribution a
+    rejection resamples from. Falls back to p_t when the residual has no
+    mass (p_d == p_t — a rejection there has probability zero, the
+    fallback only guards the division)."""
+    r = jnp.maximum(p_t - p_d, 0.0)
+    mass = jnp.sum(r, axis=-1, keepdims=True)
+    return jnp.where(mass > 1e-12, r / jnp.maximum(mass, 1e-12), p_t)
+
+
+def _speculative_row(draft_tokens, draft_logits, target_logits, seed, step,
+                     spec_k, greedy_flag, temp, tk, tp):
+    """One row of :func:`speculative_accept` — see there for shapes."""
+    kmax = draft_tokens.shape[0]
+    steps = step + jnp.arange(kmax + 1, dtype=jnp.int32)
+    base = jax.random.PRNGKey(seed.astype(jnp.uint32))
+    keys = jax.vmap(lambda s: jax.random.fold_in(base, s))(steps)
+
+    p_t = _warped_probs(target_logits, greedy_flag, temp, tk, tp)  # [K+1,V]
+    p_d = _warped_probs(draft_logits, greedy_flag, temp, tk, tp)   # [K, V]
+
+    # accept test at each proposed position: u < p_t(x)/p_d(x)
+    pt_x = jnp.take_along_axis(p_t[:kmax], draft_tokens[:, None],
+                               axis=-1)[:, 0]
+    pd_x = jnp.take_along_axis(p_d, draft_tokens[:, None], axis=-1)[:, 0]
+    u = jax.vmap(lambda kk: jax.random.uniform(
+        jax.random.fold_in(kk, _ACCEPT_TAG)))(keys[:kmax])
+    ratio = pt_x / jnp.maximum(pd_x, 1e-30)
+    in_window = jnp.arange(kmax, dtype=jnp.int32) < spec_k
+    accept = (u < ratio) & in_window
+    # number of LEADING accepts (a rejection stops the window)
+    n_acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32)))
+
+    # at every position, what a rejection there would emit (residual
+    # dist), and what full acceptance emits (plain sample from the
+    # target — position kmax's untagged key is exactly the key plain
+    # decode would use at that step, so a spec_k == 0 row reproduces the
+    # non-speculative stream token-for-token even when sampling)
+    resid = _residual_probs(p_t[:kmax], p_d)
+    resample = jax.vmap(lambda kk, pr: jax.random.categorical(
+        jax.random.fold_in(kk, _RESID_TAG),
+        jnp.log(jnp.maximum(pr, 1e-30))))(keys[:kmax], resid)
+    plain = jax.vmap(lambda kk, lg: jax.random.categorical(
+        kk, jnp.log(jnp.maximum(lg, 1e-30))))(keys, p_t)
+    greedy_fix = jnp.argmax(target_logits, axis=-1).astype(jnp.int32)
+    idx = jnp.arange(kmax + 1, dtype=jnp.int32)
+    # inside the window a stop is a REJECTION -> residual resample; at
+    # position spec_k the window is merely exhausted -> plain sample from
+    # the full target dist (the "bonus" token; for spec_k == 0 this IS
+    # plain decode, same untagged (seed, step) key, token-identical)
+    sampled_fix = jnp.where(idx < spec_k,
+                            jnp.pad(resample, (0, 1)), plain)
+    correction = jnp.where(greedy_flag, greedy_fix,
+                           sampled_fix).astype(jnp.int32)
+    out = jnp.where(idx < n_acc, jnp.pad(draft_tokens, (0, 1)),
+                    correction[jnp.minimum(n_acc, kmax)])
+    return out.astype(jnp.int32), n_acc.astype(jnp.int32), \
+        (n_acc + 1).astype(jnp.int32)
+
+
+def speculative_accept(
+    draft_tokens: jax.Array,   # [B, K] proposed tokens
+    draft_logits: jax.Array,   # [B, K, V] draft dist at each proposal
+    target_logits: jax.Array,  # [B, K+1, V] target dist at each position
+    seeds: jax.Array,          # [B] uint32 per-request seed
+    steps: jax.Array,          # [B] int32 decode step of the FIRST position
+    spec_ks: jax.Array,        # [B] int32 per-row window (0 = plain decode)
+    greedy_mask: jax.Array,    # [B] bool
+    temp: jax.Array,           # [B] float temperature
+    k: jax.Array,              # [B] int32 top-k (0 = off)
+    p: jax.Array,              # [B] float top-p (>= 1 = off)
+):
+    """Exact acceptance sampling for draft-model speculative decoding.
+
+    Per row: walk the ``spec_ks`` proposed tokens left to right, accepting
+    token ``x_i`` with probability ``min(1, p_target(x_i)/p_draft(x_i))``;
+    the first rejection emits a resample from the normalized residual
+    ``max(0, p_target - p_draft)`` and closes the window; full acceptance
+    emits a bonus token sampled from the target's ``K+1``-th distribution.
+    The emitted-token marginal at every position is exactly the (warped)
+    target distribution — speculation changes only the cost per token,
+    never the output law (``tests/test_speculative.py`` checks the closed
+    form). Greedy rows degenerate to argmax equality, so greedy streams
+    are token-identical to plain decode.
+
+    Randomness at output position ``steps + i`` is keyed by
+    ``fold_in(PRNGKey(seed), steps + i)`` (+ per-use tags), so a row's
+    stream depends only on ``(seed, position)`` — never on batch
+    composition. Returns ``(tokens [B, K+1], n_accepted [B],
+    n_emitted [B])`` with ``n_emitted == n_accepted + 1``; entries past
+    ``n_emitted`` are padding."""
+    return jax.vmap(_speculative_row)(
+        draft_tokens.astype(jnp.int32), draft_logits, target_logits,
+        seeds.astype(jnp.uint32), steps.astype(jnp.int32),
+        spec_ks.astype(jnp.int32), greedy_mask,
+        temp.astype(jnp.float32), k.astype(jnp.int32),
+        p.astype(jnp.float32))
 
 
 def make_sampler(*, greedy_mode: Optional[bool] = None,

@@ -37,7 +37,7 @@ import jax.numpy as jnp
 
 from ..nn.layers.output import BaseOutputLayer
 from ..nn.activations import Activation
-from .sampling import sample_tokens
+from .sampling import sample_tokens, speculative_accept
 
 _NEG = -1e30
 
@@ -248,4 +248,259 @@ class GenerationSession:
             if all(done):
                 break
             tokens = toks
+        return out
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding
+# ---------------------------------------------------------------------------
+
+_REWINDABLE_KEYS = frozenset({"cache_k", "cache_v", "pos"})
+
+
+def _check_rewindable(session: GenerationSession, role: str) -> None:
+    """Speculative decode writes ``k+1`` positions ahead and must be able
+    to roll the uncommitted suffix back after a rejection. That is only
+    possible when every decode-state leaf is position-indexed (K/V caches
+    masked by a ``pos`` counter): a recurrent ``h``/``c`` carry has no
+    position to rewind, so those models are rejected up front."""
+    for name, st in session.decode_state(1).items():
+        keys = set(st.keys())
+        if "pos" not in keys or not keys <= _REWINDABLE_KEYS:
+            raise ValueError(
+                f"speculative decoding requires position-indexed decode "
+                f"caches; {role} layer {name!r} carries state "
+                f"{sorted(keys)}, which cannot be rewound past a rejected "
+                "draft (recurrent h/c carries have no position counter)")
+
+
+def rewind_carry(carry, delta):
+    """Roll a decode carry back ``delta`` positions per row. Stale K/V
+    entries past the committed frontier stay in the cache but are masked
+    by ``pos`` (decode attention reads ``[0, pos)`` only) and are
+    overwritten by the next forward — rewind is a per-row position
+    subtraction, not a data copy."""
+    out = {}
+    for name, st in carry.items():
+        out[name] = {
+            kk: (jnp.maximum(v - delta.astype(v.dtype), 0) if kk == "pos"
+                 else v)
+            for kk, v in st.items()}
+    return out
+
+
+class SpeculativeGenerationSession:
+    """Draft-model speculative decoding over a paired target+draft cache.
+
+    Each speculative step runs the cheap draft model ``k+1`` times at
+    ``[B, 1]`` (proposing ``k`` tokens and keeping its own cache aligned
+    through the window), scores the proposals with ONE target forward at
+    ``[B, k+1]`` — the tq>1 causal pass through the same cached-attention
+    path prefill uses, writing into the target's KV cache — and commits
+    tokens through :func:`~deeplearning4j_tpu.generate.sampling.
+    speculative_accept` (exact accept-or-resample: the output law is the
+    target's, byte-identical under the same ``(seed, step)`` keying;
+    greedy streams are token-identical to plain decode). Both caches then
+    REWIND to the committed frontier, so a rejected burst never leaks
+    speculative state into the next step.
+
+    The per-``k`` propose/verify programs are compiled once each — the
+    static-shape discipline of :class:`GenerationSession` carries over
+    (one propose + one verify program per speculation depth, ever)."""
+
+    def __init__(self, model, draft_model, *, max_len: int = 256,
+                 k: int = 4) -> None:
+        if k < 1:
+            raise ValueError("speculative k must be >= 1")
+        self.target = GenerationSession(model, max_len=max_len)
+        self.draft = GenerationSession(draft_model, max_len=max_len)
+        if self.draft.vocab_size != self.target.vocab_size:
+            raise ValueError(
+                f"draft vocab {self.draft.vocab_size} != target vocab "
+                f"{self.target.vocab_size} — the acceptance ratio needs "
+                "one shared token space")
+        _check_rewindable(self.target, "target")
+        _check_rewindable(self.draft, "draft")
+        self.k = int(k)
+        self.max_len = int(max_len)
+        self._fns: Dict = {}
+        self.last_stats: Optional[dict] = None
+
+    # ----- jitted steps -----------------------------------------------
+    def _step_fn(self, k: int):
+        """jit (one per depth): the WHOLE speculative step fused into one
+        dispatch — k+1 chained [B, 1] draft forwards (k proposals keyed
+        ``(seed, step+i)`` plus one trailing feed so the draft cache
+        covers the full window), the tq=k+1 causal target verify pass,
+        exact accept-or-resample, inactive-row freeze, and the rewind of
+        BOTH caches to the committed frontier. One host round-trip per
+        speculative step, mirroring the plain path's one-dispatch decode."""
+        key = ("step", k)
+        if key not in self._fns:
+            dsess, tsess = self.draft, self.target
+
+            def fn(tparams, tstate, dparams, dstate, tcarry, dcarry, last,
+                   steps, active, seeds, gmask, temps, ks, ps, spec_ks):
+                # ---- propose: k draft tokens, draft cache kept aligned
+                cur, feed = dcarry, last
+                toks, logits_list = [], []
+                for i in range(k + 1):
+                    out, _, cur = dsess.model.forward_pure(
+                        dparams, dstate, dsess._prep(feed[:, None]),
+                        train=False, rng=None, mask=None, rnn_state=cur)
+                    logits_i = dsess._logits(out)[:, :, 0]
+                    if i < k:
+                        tok = sample_tokens(logits_i, seeds, steps + i,
+                                            gmask, temps, ks, ps)
+                        toks.append(tok)
+                        logits_list.append(logits_i)
+                        feed = tok
+                d_toks = jnp.stack(toks, axis=1)
+                d_logits = jnp.stack(logits_list, axis=1)
+                # ---- verify: ONE tq=k+1 target forward through the
+                # cached-attention path (the multi-token "prefill" shape)
+                tokens_in = jnp.concatenate([last[:, None], d_toks], axis=1)
+                out, _, tnew = tsess.model.forward_pure(
+                    tparams, tstate, tsess._prep(tokens_in), train=False,
+                    rng=None, mask=None, rnn_state=tcarry)
+                t_logits = tsess._logits(out).transpose(0, 2, 1)  # [b,t,V]
+                # ---- accept (exact), freeze idle rows, rewind both
+                otoks, n_acc, n_emit = speculative_accept(
+                    d_toks, d_logits, t_logits, seeds, steps, spec_ks,
+                    gmask, temps, ks, ps)
+
+                def sel(n, o):
+                    a = active.reshape((-1,) + (1,) * (n.ndim - 1))
+                    return jnp.where(a, n, o)
+
+                tnew = jax.tree_util.tree_map(sel, tnew, tcarry)
+                dnew = jax.tree_util.tree_map(sel, cur, dcarry)
+                delta = jnp.where(active, (k + 1) - n_emit, 0)
+                return (rewind_carry(tnew, delta),
+                        rewind_carry(dnew, delta), otoks, n_acc, n_emit)
+
+            self._fns[key] = jax.jit(fn)
+        return self._fns[key]
+
+    # ----- one batched speculative step --------------------------------
+    def step(self, target_carry, draft_carry, last, steps, active, seeds,
+             gmask, temps, ks, ps, spec_ks, *, k: Optional[int] = None):
+        """Propose / verify / accept / rewind for one batch step.
+
+        ``last`` [B] is each row's most recent committed token (not yet
+        fed), ``steps`` [B] the decode-step index its NEXT token samples
+        at, ``spec_ks`` [B] the per-row acceptance window (<= ``k``; 0
+        degenerates to a plain decode step for that row). Rows where
+        ``active`` is False are frozen. Returns ``(target_carry,
+        draft_carry, tokens [B, k+1], n_accepted [B], n_emitted [B])`` —
+        the caller commits ``tokens[i, :n_emitted[i]]`` per row; both
+        carries are already rewound to the committed frontier."""
+        kk = self.k if k is None else int(k)
+        return self._step_fn(kk)(
+            self.target.model.params, self.target.model.state,
+            self.draft.model.params, self.draft.model.state,
+            target_carry, draft_carry,
+            jnp.asarray(last, jnp.int32), jnp.asarray(steps, jnp.int32),
+            jnp.asarray(active, bool), jnp.asarray(seeds, jnp.uint32),
+            jnp.asarray(gmask, bool), jnp.asarray(temps, jnp.float32),
+            jnp.asarray(ks, jnp.int32), jnp.asarray(ps, jnp.float32),
+            jnp.asarray(spec_ks, jnp.int32))
+
+    # ----- host API ----------------------------------------------------
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_tokens: int,
+        *,
+        greedy: bool = True,
+        temperature: float = 1.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int = 0,
+        eos_id: Optional[int] = None,
+        k: Optional[int] = None,
+    ) -> List[List[int]]:
+        """Batch speculative generation with the same semantics (and, for
+        greedy, the same token streams) as :meth:`GenerationSession.
+        generate`. Near the cache limit, where a full ``k+1`` window no
+        longer fits, the batch falls back to plain [B, 1] decode steps so
+        no write ever lands past ``max_len``. Records acceptance counters
+        in :attr:`last_stats`."""
+        b = len(prompts)
+        kk = self.k if k is None else int(k)
+        tcarry, logits, lens = self.target.prefill(prompts)
+        dcarry, _, _ = self.draft.prefill(prompts)
+        seeds = jnp.full((b,), seed, jnp.uint32) + jnp.arange(
+            b, dtype=jnp.uint32)
+        gmask = jnp.full((b,), bool(greedy))
+        temps = jnp.full((b,), temperature, jnp.float32)
+        ks = jnp.full((b,), top_k, jnp.int32)
+        ps = jnp.full((b,), top_p, jnp.float32)
+        out: List[List[int]] = [[] for _ in range(b)]
+        done = [False] * b
+        comm = lens.copy().astype(np.int64)  # committed length per row
+        first = sample_tokens(logits, seeds, jnp.zeros((b,), jnp.int32),
+                              gmask, temps, ks, ps)
+        last = np.asarray(first).astype(np.int32)
+        for i in range(b):
+            t = int(last[i])
+            out[i].append(t)
+            comm[i] += 1
+            if ((eos_id is not None and t == eos_id)
+                    or comm[i] >= self.max_len or max_tokens <= 1):
+                done[i] = True
+        steps_h = np.ones((b,), np.int32)
+        spec_steps = proposed = accepted = 0
+        while not all(done):
+            active_rows = [i for i in range(b) if not done[i]]
+            k_step = min(kk, min(self.max_len - int(comm[i])
+                                 for i in active_rows))
+            active = jnp.asarray([not d for d in done])
+            if k_step >= 1:
+                spec_ks_h = np.where([not d for d in done], k_step, 0)
+                tcarry, dcarry, toks, n_acc, n_emit = self.step(
+                    tcarry, dcarry, last, steps_h, active, seeds, gmask,
+                    temps, ks, ps, spec_ks_h, k=k_step)
+                toks_h = np.asarray(toks)
+                acc_h, ne_h = np.asarray(n_acc), np.asarray(n_emit)
+                spec_steps += 1
+                for i in active_rows:
+                    proposed += int(spec_ks_h[i])
+                    accepted += int(acc_h[i])
+                    for j in range(int(ne_h[i])):
+                        t = int(toks_h[i, j])
+                        out[i].append(t)
+                        comm[i] += 1
+                        steps_h[i] += 1
+                        last[i] = t
+                        if ((eos_id is not None and t == eos_id)
+                                or len(out[i]) >= max_tokens
+                                or comm[i] >= self.max_len):
+                            done[i] = True
+                            break
+            else:
+                # boundary fallback: plain decode (no speculative write
+                # may straddle max_len)
+                tcarry, step_logits = self.target.decode(tcarry, last)
+                toks = sample_tokens(step_logits, seeds, steps_h, gmask,
+                                     temps, ks, ps)
+                toks_h = np.asarray(toks)
+                for i in active_rows:
+                    t = int(toks_h[i])
+                    out[i].append(t)
+                    comm[i] += 1
+                    steps_h[i] += 1
+                    last[i] = t
+                    if ((eos_id is not None and t == eos_id)
+                            or len(out[i]) >= max_tokens
+                            or comm[i] >= self.max_len):
+                        done[i] = True
+        self.last_stats = {
+            "spec_steps": spec_steps,
+            "proposed": proposed,
+            "accepted": accepted,
+            "acceptance_rate": (accepted / proposed) if proposed else None,
+            "accepted_per_step": ((accepted + spec_steps) / spec_steps)
+            if spec_steps else None,
+        }
         return out

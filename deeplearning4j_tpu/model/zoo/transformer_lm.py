@@ -16,6 +16,8 @@ with SPARSE_MCXENT next-token labels).
 
 from __future__ import annotations
 
+from typing import Optional
+
 from ...nn import Activation, LossFunction, NeuralNetConfiguration, WeightInit
 from ...nn.layers import (
     EmbeddingSequenceLayer,
@@ -71,3 +73,21 @@ class TransformerLM:
 
     def init(self) -> MultiLayerNetwork:
         return MultiLayerNetwork(self.conf()).init()
+
+    @classmethod
+    def draft_of(cls, target: "TransformerLM", *, hidden: int = 64,
+                 n_layers: int = 1, n_heads: int = 2,
+                 seed: Optional[int] = None) -> "TransformerLM":
+        """A small draft config paired to ``target`` for speculative
+        decoding: same vocab, ``max_len`` and dtype (the acceptance ratio
+        needs one shared token space and the paired caches advance in
+        lockstep), with a much cheaper stack — the default (1 layer,
+        hidden 64) is the zoo's serving draft. Train/distill it on the
+        target's data; exact acceptance sampling keeps the output
+        distribution regardless of draft quality, the draft only moves
+        the acceptance rate."""
+        return cls(vocab_size=target.vocab_size, hidden=hidden,
+                   n_layers=n_layers, n_heads=n_heads,
+                   max_len=target.max_len,
+                   seed=target.seed + 1 if seed is None else seed,
+                   dtype=target.dtype)

@@ -38,11 +38,12 @@ from .pipeline import (dense_block_stage, pipeline_apply,
                        pipeline_stages_init, shard_stage_params)
 from .trainer import DistributedTrainer, moe_expert_parallel_rules
 from .inference import InferenceMode, ParallelInference, Servable
-from .decode import DecodeEngine, GenerationHandle
+from .decode import DecodeAIMD, DecodeEngine, GenerationHandle
 from .pool import AdaptiveBatcher, EnginePool, PoolServable, ResponseCache
 
 __all__ = [
     "AdaptiveBatcher",
+    "DecodeAIMD",
     "DecodeEngine",
     "EnginePool",
     "GenerationHandle",

@@ -25,6 +25,17 @@ batching observable):
   disconnect) frees its slot on the next loop turn. Retirement releases
   the admission slot — cache capacity is never leaked to dead clients.
 
+* **speculate** — with ``draft_model=`` (ISSUE 11), each turn runs the
+  draft ``k+1`` times at ``[B, 1]``, verifies the ``k`` proposals with
+  ONE ``tq=k+1`` target forward, and commits through exact acceptance
+  sampling — output law identical to plain decode (greedy streams
+  token-for-token), ~accepted+1 tokens per target-model serial round.
+  Both caches rewind to the committed frontier inside the fused step;
+  rows near ``max_len`` (or with per-request ``speculative_k=0``) take
+  the plain path in the same turn. :class:`DecodeAIMD` adapts the
+  current ``k`` and the active-slot admission target against a
+  per-token p95 budget (``adaptive=True``).
+
 Failures run through a :class:`CircuitBreaker`: a poisoned decode step
 fails the affected requests and opens the breaker, so new submits shed
 instead of queueing behind a broken jit.
@@ -57,7 +68,7 @@ from ..core.resilience import (
     Deadline,
 )
 from ..generate.sampling import sample_tokens
-from ..generate.session import GenerationSession
+from ..generate.session import GenerationSession, SpeculativeGenerationSession
 from ..obs.metrics import MetricsRegistry, get_registry
 from ..obs.tracing import Tracer, current_context, get_tracer, trace_now
 
@@ -150,11 +161,11 @@ class GenerationHandle:
 
 class _Request:
     __slots__ = ("prompt", "max_tokens", "eos_id", "handle", "seed",
-                 "greedy", "temp", "top_k", "top_p", "trace_ctx",
+                 "greedy", "temp", "top_k", "top_p", "spec_k", "trace_ctx",
                  "t_submit", "t_decode_start")
 
     def __init__(self, prompt, max_tokens, eos_id, handle, seed, greedy,
-                 temp, top_k, top_p, trace_ctx) -> None:
+                 temp, top_k, top_p, spec_k, trace_ctx) -> None:
         self.prompt = prompt
         self.max_tokens = max_tokens
         self.eos_id = eos_id
@@ -164,6 +175,7 @@ class _Request:
         self.temp = temp
         self.top_k = top_k
         self.top_p = top_p
+        self.spec_k = spec_k  # None = follow the engine's adaptive k
         self.trace_ctx = trace_ctx
         self.t_submit = trace_now() if trace_ctx is not None else 0.0
         self.t_decode_start = 0.0
@@ -186,8 +198,29 @@ class DecodeEngine:
         name: Optional[str] = None,
         tracer: Optional[Tracer] = None,
         step_hook: Optional[Callable[[], None]] = None,
+        draft_model=None,
+        speculative_k: int = 4,
+        adaptive: bool = False,
+        target_p95_s: float = 0.05,
+        adjust_interval: float = 0.5,
     ) -> None:
-        self.session = GenerationSession(model, max_len=max_len)
+        """``draft_model=`` turns on speculative decoding: the draft
+        proposes up to ``speculative_k`` tokens per step, one tq=k+1
+        target forward verifies them, and exact acceptance sampling keeps
+        the output law (greedy streams token-identical to plain decode).
+        ``adaptive=True`` runs the decode-side AIMD controller
+        (:class:`DecodeAIMD`): the current ``k`` and the active-slot
+        target adapt against ``target_p95_s`` per-token latency, ticked
+        every ``adjust_interval`` seconds on the engine loop
+        (``adjust_interval=0`` -> manual :meth:`adjust`)."""
+        if draft_model is not None:
+            self._spec = SpeculativeGenerationSession(
+                model, draft_model, max_len=max_len,
+                k=max(1, int(speculative_k)))
+            self.session = self._spec.target
+        else:
+            self._spec = None
+            self.session = GenerationSession(model, max_len=max_len)
         self.max_len = int(max_len)
         self.slots = int(slots)
         self.default_timeout = default_timeout
@@ -199,11 +232,21 @@ class DecodeEngine:
         self._admission = admission or AdmissionController(
             max_pending=queue_limit, clock=clock)
         self._breaker = circuit_breaker or CircuitBreaker(clock=clock)
+        # decode-side AIMD knobs: current speculation depth (clamped to
+        # the construction-time ceiling) and the active-slot target
+        self.max_speculative_k = (max(1, int(speculative_k))
+                                  if self._spec is not None else 0)
+        self._spec_k = self.max_speculative_k
+        self._slot_target = self.slots
         self._init_metrics(registry if registry is not None else get_registry())
 
         # device-side batch state: one preallocated carry, per-row specs
         self._carry = self.session.decode_state(self.slots)
         self._row_template = self.session.decode_state(1)
+        self._draft_carry = (None if self._spec is None
+                             else self._spec.draft.decode_state(self.slots))
+        self._draft_row = (None if self._spec is None
+                           else self._spec.draft.decode_state(1))
         self._active = np.zeros((self.slots,), bool)
         self._last = np.zeros((self.slots,), np.int32)
         self._steps = np.zeros((self.slots,), np.int32)
@@ -212,7 +255,15 @@ class DecodeEngine:
         self._temps = np.ones((self.slots,), np.float32)
         self._ks = np.zeros((self.slots,), np.int32)
         self._ps = np.ones((self.slots,), np.float32)
+        # committed cache frontier (next write position) per slot, and the
+        # per-request speculation cap (-1 = follow the engine's current k)
+        self._pos = np.zeros((self.slots,), np.int64)
+        self._spec_caps = np.full((self.slots,), -1, np.int32)
         self._requests: List[Optional[_Request]] = [None] * self.slots
+        self.aimd = DecodeAIMD(self, target_p95_s=target_p95_s)
+        self._adaptive = bool(adaptive)
+        self._adjust_interval = float(adjust_interval)
+        self._next_adjust = clock() + self._adjust_interval
 
         self._pending: "deque[_Request]" = deque()
         self._lock = threading.Lock()
@@ -251,6 +302,33 @@ class DecodeEngine:
             "dl4j_tpu_generate_prefill_latency_seconds",
             "Prompt prefill latency (bucketed length, batch of one)",
             ("instance",)).labels(inst)
+        self._h_token = reg.histogram(
+            "dl4j_tpu_generate_token_latency_seconds",
+            "Per-emitted-token latency per sequence (step time divided by "
+            "the tokens that sequence committed — the AIMD control signal)",
+            ("instance",)).labels(inst)
+        self._c_spec_steps = reg.counter(
+            "dl4j_tpu_generate_spec_steps_total",
+            "Speculative propose/verify steps executed",
+            ("instance",)).labels(inst)
+        self._c_spec_proposed = reg.counter(
+            "dl4j_tpu_generate_spec_proposed_total",
+            "Draft tokens proposed for verification",
+            ("instance",)).labels(inst)
+        self._c_spec_accepted = reg.counter(
+            "dl4j_tpu_generate_spec_accepted_total",
+            "Draft tokens accepted by the target",
+            ("instance",)).labels(inst)
+        self._g_spec_k = reg.gauge(
+            "dl4j_tpu_generate_speculative_k",
+            "Current speculation depth (0 = speculative decoding off)",
+            ("instance",)).labels(inst)
+        self._g_spec_k.set(self._spec_k)
+        self._g_slot_target = reg.gauge(
+            "dl4j_tpu_generate_slot_target",
+            "AIMD active-slot target (admission fills at most this many "
+            "cache slots)", ("instance",)).labels(inst)
+        self._g_slot_target.set(self._slot_target)
 
     @property
     def tracer(self) -> Tracer:
@@ -277,6 +355,26 @@ class DecodeEngine:
                 tok = sample_tokens(last, seed, jnp.zeros((1,), jnp.int32),
                                     gflag, temp, k, p)
                 return new_rnn, tok[0]
+
+            self._fns[key] = jax.jit(fn)
+        return self._fns[key]
+
+    def _draft_prefill_fn(self, tb: int):
+        """jit: 1-row draft prefill (cache build only — the draft's
+        prompt logits are never sampled; proposals start from the first
+        committed token)."""
+        key = ("draft_prefill", tb)
+        if key not in self._fns:
+            sess = self._spec.draft
+            model = sess.model
+
+            def fn(params, state, row_carry, ids, lengths):
+                mask = (jnp.arange(tb, dtype=jnp.int32)[None, :]
+                        < lengths[:, None]).astype(model.dtype)
+                _, _, new_rnn = model.forward_pure(
+                    params, state, sess._prep(ids), train=False, rng=None,
+                    mask=mask, rnn_state=row_carry)
+                return new_rnn
 
             self._fns[key] = jax.jit(fn)
         return self._fns[key]
@@ -335,13 +433,17 @@ class DecodeEngine:
         deadline: Optional[Deadline] = None,
         request_id: Optional[str] = None,
         priority: Optional[str] = None,
+        speculative_k: Optional[int] = None,
     ) -> GenerationHandle:
         """Fail-fast enqueue (the ``output_async`` analog): raises
         :class:`AdmissionRejectedError` when the pending window is full and
         :class:`CircuitOpenError` while the decode step is known-poisoned.
         Returns immediately; tokens stream through the handle.
         ``priority`` names an admission priority class (``X-Priority``) —
-        under overload, lower classes shed first."""
+        under overload, lower classes shed first. ``speculative_k`` caps
+        this request's speculation window (0 = plain decode for this
+        request; None = follow the engine's adaptive k); exact acceptance
+        sampling means the choice changes latency, never the output law."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -349,6 +451,8 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt length {len(prompt)} >= max_len {self.max_len} — "
                 "no room to generate")
+        if speculative_k is not None and int(speculative_k) < 0:
+            raise ValueError("speculative_k must be >= 0")
         if deadline is None:
             deadline = Deadline.after(
                 timeout if timeout is not None else self.default_timeout,
@@ -360,7 +464,9 @@ class DecodeEngine:
         ctx = current_context() if tracer.enabled else None
         req = _Request(prompt, mt, eos_id, handle, int(seed) & 0xFFFFFFFF,
                        bool(greedy), float(temperature), int(top_k),
-                       float(top_p), ctx)
+                       float(top_p),
+                       None if speculative_k is None else int(speculative_k),
+                       ctx)
         with self._lock:
             if self._shutdown or self._draining:
                 raise RuntimeError("DecodeEngine is shut down" if
@@ -407,6 +513,11 @@ class DecodeEngine:
 
     def _admit(self) -> None:
         while True:
+            # AIMD admission pacing: fill at most slot_target cache slots
+            # even when more are free (the controller shrinks the target
+            # when per-token p95 breaches the budget)
+            if int(self._active.sum()) >= self._slot_target:
+                return
             slot = self._free_slot()
             with self._lock:
                 if not self._pending:
@@ -445,6 +556,17 @@ class DecodeEngine:
             jnp.asarray([req.top_p], jnp.float32))
         self._carry = self._write_row_fn()(
             self._carry, row, jnp.asarray(slot, jnp.int32))
+        cap = -1 if req.spec_k is None else min(req.spec_k,
+                                                self.max_speculative_k)
+        if self._spec is not None and cap != 0:
+            # paired draft cache: same prompt, same slot — proposals must
+            # condition on the same committed prefix the target verifies
+            drow = self._draft_prefill_fn(tb)(
+                self._spec.draft.model.params, self._spec.draft.model.state,
+                self._draft_row, jnp.asarray(ids),
+                jnp.asarray([len(req.prompt)], jnp.int32))
+            self._draft_carry = self._write_row_fn()(
+                self._draft_carry, drow, jnp.asarray(slot, jnp.int32))
         first = int(tok)
         self._h_prefill.observe(time.perf_counter() - t0)
         self._breaker.record_success()
@@ -465,6 +587,8 @@ class DecodeEngine:
         self._temps[slot] = req.temp
         self._ks[slot] = req.top_k
         self._ps[slot] = req.top_p
+        self._pos[slot] = len(req.prompt)  # committed cache frontier
+        self._spec_caps[slot] = cap
         self._g_active.set(int(self._active.sum()))
         self._c_tokens.inc()
         req.handle._emit(0, first)
@@ -491,43 +615,123 @@ class DecodeEngine:
             self._g_active.set(int(self._active.sum()))
             self._finish(req, reason)
 
-    def _step(self) -> None:
+    def _fail_active(self, e: Exception) -> None:
+        """Poisoned device step: fail every active request, open-circuit
+        accounting, clear the batch."""
+        self._breaker.record_failure()
+        for slot in range(self.slots):
+            req = self._requests[slot]
+            if req is not None:
+                self._requests[slot] = None
+                self._active[slot] = False
+                self._finish(req, "failed", error=str(e))
+        self._g_active.set(0)
+
+    def _step(self, rows: Optional[np.ndarray] = None) -> None:
+        """One plain [B, 1] decode step over ``rows`` (default: every
+        active slot — the non-speculative path, and the boundary fallback
+        for rows whose remaining cache room cannot hold a k+1 window)."""
         sess = self.session
+        rows = self._active if rows is None else rows
         t0 = time.perf_counter()
         try:
             self._carry, toks = self._decode_step_fn()(
                 sess.model.params, sess.model.state, self._carry,
-                jnp.asarray(self._last), jnp.asarray(self._active),
+                jnp.asarray(self._last), jnp.asarray(rows),
                 jnp.asarray(self._seeds), jnp.asarray(self._steps),
                 jnp.asarray(self._greedy), jnp.asarray(self._temps),
                 jnp.asarray(self._ks), jnp.asarray(self._ps))
             toks_h = np.asarray(toks)
         except Exception as e:  # noqa: BLE001 — poisoned step: fail active requests
-            self._breaker.record_failure()
-            for slot in range(self.slots):
-                req = self._requests[slot]
-                if req is not None:
-                    self._requests[slot] = None
-                    self._active[slot] = False
-                    self._finish(req, "failed", error=str(e))
-            self._g_active.set(0)
+            self._fail_active(e)
             return
         dt = time.perf_counter() - t0
         self._h_decode.observe(dt)
         self._breaker.record_success()
-        n_active = 0
-        for slot in np.nonzero(self._active)[0]:
+        for slot in np.nonzero(rows)[0]:
             req = self._requests[slot]
             tok = int(toks_h[slot])
             emitted = len(req.handle.tokens)
             req.handle._emit(emitted, tok)
             self._last[slot] = tok
             self._steps[slot] += 1
+            self._pos[slot] += 1
             self._c_tokens.inc()
-            n_active += 1
+            self._h_token.observe(dt)
             self._retire_if_done(slot, tok, emitted + 1)
         if self._step_hook is not None:
             self._step_hook()
+
+    def _spec_step(self) -> None:
+        """One speculative engine turn: propose/verify/accept for every
+        row with cache room for the full k+1 window, then a plain [B, 1]
+        step for the remainder (rows near ``max_len``, and requests with
+        ``speculative_k=0``). Both caches are rewound to the committed
+        frontier inside :meth:`SpeculativeGenerationSession.step`, so a
+        cancelled or expired request never leaves speculative writes
+        behind when its slot is reused."""
+        k = max(1, self._spec_k)
+        caps = np.where(self._spec_caps < 0, k,
+                        np.minimum(self._spec_caps, k)).astype(np.int32)
+        spec_rows = (self._active & (caps > 0)
+                     & (self._pos + k + 1 <= self.max_len))
+        plain_rows = self._active & ~spec_rows
+        if spec_rows.any():
+            t0 = time.perf_counter()
+            try:
+                (self._carry, self._draft_carry, toks, n_acc,
+                 n_emit) = self._spec.step(
+                    self._carry, self._draft_carry, self._last, self._steps,
+                    spec_rows, jnp.asarray(self._seeds),
+                    jnp.asarray(self._greedy), jnp.asarray(self._temps),
+                    jnp.asarray(self._ks), jnp.asarray(self._ps),
+                    np.where(spec_rows, caps, 0), k=k)
+                toks_h = np.asarray(toks)
+                acc_h = np.asarray(n_acc)
+                ne_h = np.asarray(n_emit)
+            except Exception as e:  # noqa: BLE001
+                self._fail_active(e)
+                return
+            dt = time.perf_counter() - t0
+            self._h_decode.observe(dt)
+            self._breaker.record_success()
+            self._c_spec_steps.inc()
+            for slot in np.nonzero(spec_rows)[0]:
+                req = self._requests[slot]
+                if req is None:
+                    continue
+                self._c_spec_proposed.inc(int(caps[slot]))
+                self._c_spec_accepted.inc(int(acc_h[slot]))
+                committed = 0
+                for j in range(int(ne_h[slot])):
+                    tok = int(toks_h[slot, j])
+                    emitted = len(req.handle.tokens)
+                    req.handle._emit(emitted, tok)
+                    self._last[slot] = tok
+                    self._steps[slot] += 1
+                    self._pos[slot] += 1
+                    self._c_tokens.inc()
+                    committed += 1
+                    self._retire_if_done(slot, tok, emitted + 1)
+                    if self._requests[slot] is None:
+                        break  # retired mid-window: drop the tail
+                self._h_token.observe(dt / max(1, committed))
+            if self._step_hook is not None:
+                self._step_hook()
+        if plain_rows.any():
+            self._step(plain_rows)
+
+    def _sweep_pending(self) -> None:
+        """Fail pending requests that died in the queue (cancel/expiry)
+        WITHOUT waiting for a cache slot: a burst of doomed requests must
+        release its admission window even while every slot is busy."""
+        with self._lock:
+            dead = [r for r in self._pending
+                    if r.handle.cancelled or r.handle.deadline.expired()]
+            for r in dead:
+                self._pending.remove(r)
+        for r in dead:
+            self._finish(r, "cancelled" if r.handle.cancelled else "deadline")
 
     def _loop(self) -> None:
         while True:
@@ -542,13 +746,64 @@ class DecodeEngine:
                     continue
             self._admit()
             if self._active.any():
-                self._step()
+                if self._spec is not None:
+                    self._spec_step()
+                else:
+                    self._step()
             # also sweep cancelled requests on slots that produced nothing
             for slot in range(self.slots):
                 req = self._requests[slot]
                 if req is not None and (req.handle.cancelled or
                                         req.handle.deadline.expired()):
                     self._retire_if_done(slot, -1, len(req.handle.tokens))
+            self._sweep_pending()
+            if (self._adaptive and self._adjust_interval > 0
+                    and self._clock() >= self._next_adjust):
+                self.adjust()
+                self._next_adjust = self._clock() + self._adjust_interval
+
+    # ----- decode-side AIMD control -----------------------------------
+    @property
+    def speculative_k(self) -> int:
+        """Current speculation depth (0 when no draft model)."""
+        return self._spec_k if self._spec is not None else 0
+
+    @property
+    def slot_target(self) -> int:
+        return self._slot_target
+
+    def set_decode_control(self, speculative_k: Optional[int] = None,
+                           slot_target: Optional[int] = None):
+        """Write the AIMD-controlled knobs (clamped: ``k`` to
+        ``[1, max_speculative_k]`` when a draft is attached, the slot
+        target to ``[1, slots]``). Returns the effective pair."""
+        if speculative_k is not None and self._spec is not None:
+            self._spec_k = max(1, min(int(speculative_k),
+                                      self.max_speculative_k))
+            self._g_spec_k.set(self._spec_k)
+        if slot_target is not None:
+            self._slot_target = max(1, min(int(slot_target), self.slots))
+            self._g_slot_target.set(self._slot_target)
+        return self.speculative_k, self._slot_target
+
+    def adjust(self) -> Optional[dict]:
+        """Tick the AIMD controller once (the engine loop does this every
+        ``adjust_interval`` seconds when ``adaptive=True``); returns the
+        observation/action, or None when no tokens were emitted since the
+        last tick."""
+        return self.aimd.tick()
+
+    def token_p95(self) -> Optional[float]:
+        """Lifetime per-token p95 from the latency histogram (bucket
+        upper bound; None before any traffic — PR-7 zero-guard)."""
+        count = self._h_token.count
+        if count <= 0:
+            return None
+        threshold = 0.95 * count
+        for le, c in self._h_token.buckets():
+            if c >= threshold:
+                return le
+        return float("inf")
 
     # ----- lifecycle / introspection ----------------------------------
     def bucket_sizes(self) -> List[int]:
@@ -568,16 +823,36 @@ class DecodeEngine:
 
     def stats(self) -> dict:
         counts = {k: int(c.value) for k, c in self._c.items()}
+        proposed = int(self._c_spec_proposed.value)
+        accepted = int(self._c_spec_accepted.value)
+        spec_steps = int(self._c_spec_steps.value)
         counts.update({
             "in_flight": self._admission.pending,
             # the engine-list aggregation key health()/pools sum over
             "queue_depth": self._admission.pending,
             "active_slots": int(self._active.sum()),
             "slots": self.slots,
+            "slot_target": self._slot_target,
             "tokens": int(self._c_tokens.value),
             "max_len": self.max_len,
             "circuit_state": self._breaker.state.value,
             "draining": self._draining,
+            # zero-guarded (PR-7 convention): derived ratios are None, not
+            # 0.0, before any speculative traffic
+            "per_token_p95_s": self.token_p95(),
+            "speculative": {
+                "enabled": self._spec is not None,
+                "current_k": self.speculative_k,
+                "max_k": self.max_speculative_k,
+                "steps": spec_steps,
+                "proposed": proposed,
+                "accepted": accepted,
+                "acceptance_rate": (accepted / proposed) if proposed
+                else None,
+                "accepted_tokens_per_step":
+                    ((accepted + spec_steps) / spec_steps) if spec_steps
+                    else None,
+            },
         })
         return counts
 
@@ -610,3 +885,85 @@ class DecodeEngine:
                 req.handle.cancel()
         self._wake.set()
         self._thread.join(timeout=10)
+
+
+class DecodeAIMD:
+    """AIMD controller for the decode engine's latency/throughput knobs —
+    the decode-side mirror of :class:`~deeplearning4j_tpu.parallel.pool.
+    AdaptiveBatcher`.
+
+    Each :meth:`tick` estimates the per-token p95 from the delta of the
+    engine's token-latency histogram since the previous tick, then:
+
+    * **p95 over target** → multiplicative decrease: the active-slot
+      target AND the speculation depth both shrink by ``shrink_factor``
+      (fewer sequences sharing the step, shallower windows — per-token
+      latency is the hard constraint, back off fast).
+    * **p95 under target, pending queue non-empty and slots headroom** →
+      additive increase of the slot target (demand exists; batch wider).
+    * **p95 under target otherwise** → additive increase of the
+      speculation depth toward ``max_speculative_k`` (spend the latency
+      headroom on deeper windows: more accepted tokens per fixed-cost
+      target forward).
+
+    No tokens since the last tick leaves everything untouched. Writes go
+    through :meth:`DecodeEngine.set_decode_control` (clamped there)."""
+
+    def __init__(self, engine: DecodeEngine, *, target_p95_s: float = 0.05,
+                 grow_step: int = 1, shrink_factor: float = 0.5,
+                 min_k: int = 1, min_slots: int = 1) -> None:
+        if not 0.0 < shrink_factor < 1.0:
+            raise ValueError("shrink_factor must be in (0, 1)")
+        self.engine = engine
+        self.target_p95_s = float(target_p95_s)
+        self.grow_step = int(grow_step)
+        self.shrink_factor = float(shrink_factor)
+        self.min_k = int(min_k)
+        self.min_slots = int(min_slots)
+        self._last_buckets = [c for _, c in engine._h_token.buckets()]
+        self._last_count = engine._h_token.count
+
+    def _p95_delta(self) -> Optional[float]:
+        hist = self.engine._h_token
+        pairs = hist.buckets()  # cumulative (le, count)
+        count = hist.count
+        cums = [c for _, c in pairs]
+        deltas = [c - p for c, p in zip(cums, self._last_buckets)]
+        dcount = count - self._last_count
+        self._last_buckets = cums
+        self._last_count = count
+        if dcount <= 0:
+            return None
+        threshold = 0.95 * dcount
+        for (le, _), d in zip(pairs, deltas):
+            if d >= threshold:
+                return le if le != float("inf") else float("inf")
+        return float("inf")
+
+    def tick(self) -> Optional[dict]:
+        """One control step; returns the observation/action taken, or
+        None when no tokens were emitted since the last tick."""
+        p95 = self._p95_delta()
+        if p95 is None:
+            return None
+        eng = self.engine
+        k, st = eng.speculative_k, eng.slot_target
+        queue_depth = max(0, eng._admission.pending
+                          - int(eng._active.sum()))
+        if p95 > self.target_p95_s:
+            new_k = max(self.min_k, int(k * self.shrink_factor)) if k else 0
+            new_st = max(self.min_slots, int(st * self.shrink_factor))
+            action = "shrink"
+        elif queue_depth > 0 and st < eng.slots:
+            new_k, new_st = k, st + self.grow_step
+            action = "grow_slots"
+        elif k and k < eng.max_speculative_k:
+            new_k, new_st = k + self.grow_step, st
+            action = "grow_k"
+        else:
+            new_k, new_st = k, st
+            action = "hold"
+        new_k, new_st = eng.set_decode_control(
+            new_k if new_k else None, new_st)
+        return {"p95_s": p95, "queue_depth": queue_depth, "action": action,
+                "speculative_k": new_k, "slot_target": new_st}

@@ -764,6 +764,29 @@ class EnginePool:
         if "by_priority" in adm:
             out["shed_by_priority"] = {
                 p: v["shed"] for p, v in adm["by_priority"].items()}
+        if self.decode_replicas:
+            # pool-level generation view: per-replica circuits + the
+            # acceptance counters aggregated across decode replicas
+            # (zero-guarded ratios, PR-7 convention)
+            prop = acc = steps = 0
+            for e in self.decode_replicas:
+                sp = (out["replicas"].get(e.name) or {}).get(
+                    "speculative") or {}
+                prop += int(sp.get("proposed") or 0)
+                acc += int(sp.get("accepted") or 0)
+                steps += int(sp.get("steps") or 0)
+            out["generate"] = {
+                "replicas": [e.name for e in self.decode_replicas],
+                "dispatched": {e.name: dispatched.get(e.name, 0)
+                               for e in self.decode_replicas},
+                "circuits": {e.name: e.circuit_state.value
+                             for e in self.decode_replicas},
+                "proposed": prop,
+                "accepted": acc,
+                "acceptance_rate": (acc / prop) if prop else None,
+                "accepted_tokens_per_step": ((acc + steps) / steps)
+                if steps else None,
+            }
         if self._cache is not None:
             out["cache"] = {
                 "hits": hits,

@@ -64,12 +64,17 @@ hit|miss|bypass`` when the cache is configured.
 
 Generation serving (README "Generation serving"): a
 :class:`~deeplearning4j_tpu.parallel.decode.DecodeEngine` passed as
-``generator=`` adds
+``generator=`` — or an :class:`~deeplearning4j_tpu.parallel.pool.
+EnginePool` with decode replicas passed as ``pool=`` (requests then go
+through ``EnginePool.submit_generate``: power-of-two-choices over the
+decode replicas, circuit-skip + least-loaded fallback; an explicit
+``generator=`` wins when both are present) — adds
 
   POST /v1/generate → {"prompt": [ids...], "max_tokens"?, "greedy"?,
                        "temperature"?, "top_k"?, "top_p"?, "seed"?,
-                       "eos_id"?, "deadline_ms"?, "stream"? (default
-                       true)}
+                       "eos_id"?, "speculative_k"? (cap this request's
+                       draft window; 0 = plain decode), "deadline_ms"?,
+                       "stream"? (default true)}
                       streamed as newline-delimited JSON token events
                       ({"token", "index"}... {"done", "reason",
                       "count"}) over one response; same 400/503 shed +
@@ -327,6 +332,7 @@ class JsonModelServer:
                     prompt = [int(t) for t in payload["prompt"]]
                     deadline = self._deadline(payload)
                     stream = bool(payload.get("stream", True))
+                    spec_k = payload.get("speculative_k")
                     kw = dict(
                         max_tokens=payload.get("max_tokens"),
                         greedy=bool(payload.get("greedy", True)),
@@ -335,6 +341,8 @@ class JsonModelServer:
                         top_p=float(payload.get("top_p", 1.0)),
                         seed=int(payload.get("seed", 0)),
                         eos_id=payload.get("eos_id"),
+                        speculative_k=(None if spec_k is None
+                                       else int(spec_k)),
                     )
                 except Exception as e:
                     self._send(400, {"error": f"malformed request: {e}"})
@@ -343,10 +351,16 @@ class JsonModelServer:
                 try:
                     if outer._draining:
                         raise RuntimeError("draining")
-                    handle = outer._generator.submit(
-                        prompt, deadline=deadline,
-                        request_id=self._request_id,
-                        priority=self._priority(), **kw)
+                    if outer._generator is not None:
+                        handle = outer._generator.submit(
+                            prompt, deadline=deadline,
+                            request_id=self._request_id,
+                            priority=self._priority(), **kw)
+                    else:  # pooled generation: p2c over decode replicas
+                        handle = outer._pool.submit_generate(
+                            prompt, deadline=deadline,
+                            request_id=self._request_id,
+                            priority=self._priority(), **kw)
                 except ValueError as e:
                     self._send(400, {"error": str(e)})
                     return
@@ -394,8 +408,10 @@ class JsonModelServer:
                     raise
 
             def _handle_post(self):
-                if (self.path == outer.generate_path
-                        and outer._generator is not None):
+                if self.path == outer.generate_path and (
+                        outer._generator is not None
+                        or (outer._pool is not None
+                            and outer._pool.decode_replicas)):
                     self._handle_generate()
                     return
                 submit = self._submit_fn()
@@ -700,6 +716,7 @@ class JsonRemoteInference:
                  greedy: bool = True, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0, seed: int = 0,
                  eos_id: Optional[int] = None,
+                 speculative_k: Optional[int] = None,
                  timeout: Optional[float] = None,
                  path: str = "/v1/generate"):
         """Streamed generation against ``POST /v1/generate``: yields the
@@ -716,6 +733,8 @@ class JsonRemoteInference:
             payload["max_tokens"] = max_tokens
         if eos_id is not None:
             payload["eos_id"] = eos_id
+        if speculative_k is not None:
+            payload["speculative_k"] = speculative_k
         body = json.dumps(payload).encode()
         deadline = Deadline.after(
             timeout if timeout is not None else self.timeout,

@@ -2,7 +2,10 @@
 generation-serving contract (README.md "Generation serving" — ordered
 token events over real HTTP, mid-stream deadline with partial output,
 admission shed -> 503 + Retry-After, disconnect frees the cache slot,
-metric/trace surfaces) is enforced on every test run, mirroring
+metric/trace surfaces, and the ISSUE-11 pooled route: /v1/generate via
+EnginePool.submit_generate over speculative decode replicas with
+X-Request-Id echo, per-request speculative_k, and acceptance-rate
+stats) is enforced on every test run, mirroring
 test_serving_contract.py / test_trace_contract.py."""
 
 import os

@@ -19,7 +19,14 @@ against ``POST /v1/generate``, asserting:
   * the generate metric series (tokens total, in-flight gauge, decode
     latency histogram) land in ``GET /metrics``, and a traced request
     shows ``engine.prefill``/``engine.decode`` child spans in
-    ``GET /v1/traces``.
+    ``GET /v1/traces``,
+  * the POOLED route (ISSUE 11): ``/v1/generate`` served through
+    ``EnginePool.submit_generate`` over speculative decode replicas
+    (draft model + exact acceptance sampling) streams ordered chunks
+    token-identical to the single-engine greedy stream, echoes
+    ``X-Request-Id``, honors per-request ``speculative_k`` (0 = plain),
+    and surfaces the acceptance rate in ``GET /stats`` (pool generate
+    aggregate + per-replica speculative counters).
 
 Runs standalone (``python tools/check_generate_contract.py``) and as a
 tier-1 pytest via tests/test_generate_contract.py.
@@ -198,10 +205,76 @@ def main(log=print) -> int:
         except HTTPError as e:
             assert e.code == 400
         log("malformed request -> 400 ok")
-        return 0
     finally:
         server.stop()
         engine.shutdown(drain=False)
+
+    # ---- 7. pooled speculative generation (ISSUE 11)
+    from deeplearning4j_tpu.parallel import EnginePool
+
+    draft = TransformerLM.draft_of(
+        TransformerLM(vocab_size=23, hidden=32, n_layers=2, n_heads=4,
+                      max_len=MAX_LEN),
+        hidden=16, n_heads=2).init()
+    reg2 = MetricsRegistry()
+    replicas = [DecodeEngine(model, draft_model=draft, speculative_k=2,
+                             max_len=MAX_LEN, slots=2, registry=reg2,
+                             name=f"spec-r{i}") for i in range(2)]
+    pool = EnginePool(engines=replicas, registry=reg2, name="spec-pool")
+    pooled = JsonModelServer(pool=pool, registry=reg2,
+                             name="spec-pool-server").start()
+    try:
+        req = urllib_request.Request(
+            f"http://127.0.0.1:{pooled.port}/v1/generate",
+            data=json.dumps({"prompt": [1, 2, 3], "max_tokens": 6,
+                             "speculative_k": 2}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "spec-rid-1"})
+        events = []
+        with urllib_request.urlopen(req, timeout=120) as r:
+            assert r.status == 200
+            assert r.headers.get("X-Request-Id") == "spec-rid-1", \
+                "pooled generate must echo X-Request-Id"
+            for line in r:
+                line = line.strip()
+                if line:
+                    events.append(json.loads(line))
+        toks = [e for e in events if "token" in e]
+        assert [e["index"] for e in toks] == list(range(6)), events
+        assert events[-1].get("done") and events[-1]["count"] == 6
+        # speculative greedy == plain greedy, over the pooled route too
+        sess = GenerationSession(model, max_len=MAX_LEN)
+        expected = sess.generate([[1, 2, 3]], 6, greedy=True)[0]
+        assert [e["token"] for e in toks] == expected, \
+            f"pooled speculative stream {toks} != plain {expected}"
+        # per-request speculative_k=0 -> plain decode, same stream
+        events = _stream(pooled.port, {"prompt": [1, 2, 3], "max_tokens": 6,
+                                       "speculative_k": 0})
+        assert [e["token"] for e in events if "token" in e] == expected
+        log("pooled speculative stream + X-Request-Id echo ok")
+
+        with urllib_request.urlopen(
+                f"http://127.0.0.1:{pooled.port}/stats", timeout=30) as r:
+            stats = json.loads(r.read())
+        gen = stats["pool"].get("generate")
+        assert gen is not None, "pool stats must carry a generate section"
+        assert gen["proposed"] > 0
+        assert gen.get("acceptance_rate") is not None, \
+            "acceptance rate missing from pooled /stats"
+        served = [n for n, st in stats["pool"]["replicas"].items()
+                  if st.get("speculative", {}).get("steps", 0) > 0]
+        assert served, "no replica reports speculative steps"
+        with urllib_request.urlopen(
+                f"http://127.0.0.1:{pooled.port}/health", timeout=30) as r:
+            health = json.loads(r.read())
+        for rep in replicas:
+            assert rep.name in health["pool"]["replicas"], \
+                "decode replica circuits must be itemized in /health"
+        log("pooled acceptance-rate stats + per-replica circuits ok")
+        return 0
+    finally:
+        pooled.stop()
+        pool.shutdown(drain=False)
 
 
 if __name__ == "__main__":
