@@ -1994,6 +1994,111 @@ def measure_engine_pool_scaling(n_requests: int = 240, threads: int = 4,
     }
 
 
+def measure_fabric_overhead(n_requests: int = 120, threads: int = 4) -> dict:
+    """Cross-host fabric row (ISSUE 12 acceptance): RPS of a direct
+    JsonRemoteInference client against one HTTP host vs the same host
+    fronted by an EnginePool with a single RemoteReplica (the fabric
+    adds a dispatch + executor hop per request; the gate is < 10%
+    overhead at N=1), with the fabric metric series checked visible."""
+    import itertools as _it
+    import threading as _th
+
+    import numpy as np
+
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+    from deeplearning4j_tpu.obs.prom import render_prometheus
+    from deeplearning4j_tpu.parallel import EnginePool
+    from deeplearning4j_tpu.remote import (JsonModelServer,
+                                           JsonRemoteInference,
+                                           RemoteReplica)
+
+    conf = (NeuralNetConfiguration.builder().seed(5).list()
+            .layer(DenseLayer(n_in=8, n_out=16))
+            .layer(OutputLayer(n_in=16, n_out=4))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    payloads = [rng.randn(1, 8).astype(np.float32) for _ in range(16)]
+
+    def one_pass(submit, n, nthreads) -> float:
+        counter = _it.count()
+        errs = []
+
+        def worker():
+            while True:
+                i = next(counter)
+                if i >= n:
+                    return
+                try:
+                    submit(payloads[i % len(payloads)])
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+                    return
+        ts = [_th.Thread(target=worker) for _ in range(nthreads)]
+        start = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errs:
+            raise errs[0]
+        return n / (time.perf_counter() - start)
+
+    host = JsonModelServer(model, port=0, workers=1, batch_limit=1,
+                           queue_limit=512, registry=MetricsRegistry(),
+                           name="fab-bench-host").start()
+    endpoint = f"http://127.0.0.1:{host.port}/v1/serving"
+    try:
+        client = JsonRemoteInference(endpoint, registry=MetricsRegistry())
+        client.predict(payloads[0])  # compile the host's forward
+        fab_reg = MetricsRegistry()
+        pool = EnginePool(
+            engines=[RemoteReplica(endpoint, name="fab-bench-rr",
+                                   probe_interval=0.5, registry=fab_reg)],
+            registry=fab_reg, name="fab-bench")
+        try:
+            pool.output(payloads[0], timeout=30)
+            # paired interleaved passes (the tracing_overhead recipe for
+            # this noisy 1-core host): alternate direct/fabric so host
+            # drift cancels inside each pair, take the median per-pair
+            # ratio and the median RPS of each leg
+            directs, fabrics, ratios = [], [], []
+            for _ in range(max(REPEATS, 5)):
+                d = one_pass(lambda x: client.predict(x),
+                             n_requests, threads)
+                f = one_pass(lambda x: pool.output(x, timeout=30),
+                             n_requests, threads)
+                directs.append(d)
+                fabrics.append(f)
+                ratios.append(f / d)
+            direct_rps = statistics.median(directs)
+            fabric_rps = statistics.median(fabrics)
+            ratio = statistics.median(ratios)
+            prom = render_prometheus(fab_reg)
+            metrics_visible = all(s in prom for s in (
+                "dl4j_tpu_fabric_probe_total",
+                "dl4j_tpu_fabric_replica_healthy",
+                "dl4j_tpu_fabric_request_latency_seconds",
+                "dl4j_tpu_fabric_failover_total"))
+        finally:
+            pool.shutdown(drain=False)
+    finally:
+        host.stop(drain=False)
+
+    overhead = 1.0 - ratio
+    return {
+        "direct_client_rps": round(direct_rps, 1),
+        "fabric_pool_1_rps": round(fabric_rps, 1),
+        "fabric_overhead_at_1": round(overhead, 4),
+        "fabric_overhead_under_10pct": bool(overhead < 0.10),
+        "metrics_visible": metrics_visible,
+        "note": ("both legs pay the same HTTP round trip to the host; "
+                 "the delta is the fabric's dispatch + executor hop"),
+    }
+
+
 _MEASUREMENTS = {
     "lenet": measure_lenet,
     "resnet50": measure_resnet50,
@@ -2016,6 +2121,7 @@ _MEASUREMENTS = {
     "generate_decode": measure_generate_decode,
     "speculative_decode": measure_speculative_decode,
     "engine_pool_scaling": measure_engine_pool_scaling,
+    "fabric_overhead": measure_fabric_overhead,
 }
 
 
@@ -2135,6 +2241,9 @@ def _child_measure(name: str, platform: str) -> None:
             # but only meaningful with >= N cores (see the row's note)
             "engine_pool_scaling": {"n_requests": 120, "threads": 4,
                                     "replicas": 2, "overload_requests": 80},
+            # both legs ride real HTTP: keep the passes short, the 1-core
+            # host serializes client + server threads anyway
+            "fabric_overhead": {"n_requests": 80, "threads": 4},
         }.get(name, {})
     result = _MEASUREMENTS[name](**kwargs)
     print(json.dumps(result))
@@ -2190,6 +2299,7 @@ def main() -> None:
                                                platform),
         "engine_pool_scaling": _run_measurement("engine_pool_scaling",
                                                 platform),
+        "fabric_overhead": _run_measurement("fabric_overhead", platform),
     }
     if not fallback:  # chip-only rows
         extras["resnet50_b128"] = _run_measurement("resnet50_b128", platform)

@@ -61,6 +61,21 @@ class CrashLoopError(ResilienceError):
     """Restart budget exhausted inside the crash-loop window."""
 
 
+class ReplicaUnavailableError(ResilienceError):
+    """A (remote) replica could not take or finish the request: connection
+    refused/reset, read timeout, truncated response, or a 503 from the
+    host. The pool layer treats this as "the HOST failed, not the
+    request" and fails the request over to the next least-loaded replica
+    — never raised for a 400 (resending malformed input elsewhere cannot
+    help). ``retry_after`` carries the host's Retry-After hint when one
+    was sent."""
+
+    def __init__(self, msg: str = "replica unavailable",
+                 retry_after: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
 # --------------------------------------------------------------------------
 # Deadline
 # --------------------------------------------------------------------------

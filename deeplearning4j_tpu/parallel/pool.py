@@ -77,6 +77,7 @@ from ..core.resilience import (
     CircuitOpenError,
     CircuitState,
     Deadline,
+    ReplicaUnavailableError,
     get_fault_injector,
 )
 from ..obs.metrics import MetricsRegistry, get_registry
@@ -349,14 +350,21 @@ class EnginePool:
                                if hasattr(e, "output_async")]
         self.decode_replicas: List = [e for e in engines
                                       if not hasattr(e, "output_async")]
+        # a pool with remote replicas (RemoteReplica adapters) dispatches
+        # through the failover path; a purely local pool is byte-for-byte
+        # unaffected (same dispatch code, no fabric metrics)
+        self._has_remote = any(getattr(e, "is_remote", False)
+                               for e in self.replicas + self.decode_replicas)
 
         # pool-level admission: the shed-first-by-priority gate in front
         # of dispatch. Default window = the sum of the replica windows
-        # (the pool can never usefully hold more).
+        # (the pool can never usefully hold more). Remote replicas have
+        # no local AdmissionController — their max_pending hint counts.
         if admission is None:
             if max_pending is None:
                 max_pending = sum(
-                    getattr(e, "_admission").max_pending
+                    getattr(getattr(e, "_admission", None), "max_pending",
+                            None) or int(getattr(e, "max_pending", 64))
                     for e in self.replicas + self.decode_replicas)
             admission = AdmissionController(
                 max_pending=max_pending, priorities=priorities, clock=clock)
@@ -379,9 +387,11 @@ class EnginePool:
         self.batchers: List[AdaptiveBatcher] = []
         self._adjust_thread: Optional[threading.Thread] = None
         if adaptive:
+            # remote replicas have no local batching knobs (the remote
+            # host's own pool/engine adapts) — only local engines get one
             self.batchers = [
                 AdaptiveBatcher(e, target_p95_s=target_p95_s)
-                for e in self.replicas]
+                for e in self.replicas if hasattr(e, "_h_forward")]
             if adjust_interval > 0:
                 self._adjust_interval = float(adjust_interval)
                 self._adjust_stop = threading.Event()
@@ -409,6 +419,18 @@ class EnginePool:
             "faults, replica shed/circuit on the chosen replica)",
             ("pool", "replica"))
         self._disp_err_children: Dict[str, object] = {}
+        self._c_failover_family = None
+        self._failover_children: Dict[str, object] = {}
+        if self._has_remote:  # fabric series only exist for remote pools
+            self._c_failover_family = reg.counter(
+                "dl4j_tpu_fabric_failover_total",
+                "Requests failed over to another replica after a remote "
+                "replica became unavailable mid-request (connection "
+                "error/503; labeled by the replica failed AWAY from)",
+                ("pool", "replica"))
+            for e in self.replicas + self.decode_replicas:
+                self._failover_children[e.name] = \
+                    self._c_failover_family.labels(self.name, e.name)
         self._g_imbalance = reg.gauge(
             "dl4j_tpu_pool_load_imbalance",
             "max/mean of per-replica load scores (1.0 = perfectly "
@@ -500,14 +522,17 @@ class EnginePool:
                       key=lambda e: e.load_score())
         return [first] + rest
 
-    def _dispatch(self, submit_one: Callable, pool: Sequence):
+    def _dispatch(self, submit_one: Callable, pool: Sequence,
+                  candidates: Optional[List] = None):
         """Run ``submit_one(replica)`` against the candidate chain.
         An injected dispatch fault (site ``engine_pool.dispatch.<name>``)
         is recorded as that replica's failure — its breaker accumulates
         it and eventually opens, taking the replica out of rotation —
         and the request falls over to the next candidate."""
         last_exc: Optional[Exception] = None
-        for engine in self._candidates(pool):
+        if candidates is None:
+            candidates = self._candidates(pool)
+        for engine in candidates:
             try:
                 inj = self._inj()
                 inj.fire(DISPATCH_SITE)
@@ -528,6 +553,81 @@ class EnginePool:
             return result
         assert last_exc is not None
         raise last_exc
+
+    def _dispatch_failover(self, submit_one: Callable, pool: Sequence,
+                           deadline: Optional[Deadline] = None) -> Future:
+        """Like :meth:`_dispatch`, for pools with remote replicas: a
+        dispatched request whose FUTURE settles with
+        ``ReplicaUnavailableError`` (connection drop, truncated body, or
+        a 503 from the host — never a 400) fails over to the next
+        least-loaded candidate, re-submitting on the callback thread.
+        The replica's breaker already recorded the failure inside the
+        adapter; the pool counts the failover and keeps the caller's
+        future unresolved until a candidate answers or the chain runs
+        out."""
+        candidates = self._candidates(pool)
+        if len(candidates) == 1:
+            # no fallback exists: skip the wrapper future entirely (this
+            # keeps the N=1 fabric overhead inside the <10% budget)
+            return self._dispatch(submit_one, pool, candidates)
+        outer: Future = Future()
+        state = {"last": None}
+
+        def attempt(idx: int) -> None:
+            while idx < len(candidates):
+                engine = candidates[idx]
+                idx += 1
+                try:
+                    inj = self._inj()
+                    inj.fire(DISPATCH_SITE)
+                    inj.fire(self._site_names[engine.name])
+                except Exception as e:  # targeted fault: charge the replica
+                    engine._breaker.record_failure()
+                    self._disp_err(engine.name).inc()
+                    state["last"] = e
+                    continue
+                try:
+                    fut = submit_one(engine)
+                except Exception as e:  # replica-level shed / circuit-open
+                    self._disp_err(engine.name).inc()
+                    state["last"] = e
+                    continue
+                self._c_disp[engine.name].inc()
+                self._update_imbalance(pool)
+                next_idx = idx
+
+                def _done(f, engine=engine, next_idx=next_idx):
+                    if f.cancelled():
+                        outer.cancel()
+                        return
+                    exc = f.exception()
+                    if exc is None:
+                        outer.set_result(f.result())
+                        return
+                    if isinstance(exc, ReplicaUnavailableError) and not (
+                            deadline is not None and deadline.expired()):
+                        self._disp_err(engine.name).inc()
+                        self._failover(engine.name).inc()
+                        state["last"] = exc
+                        attempt(next_idx)  # next host, on this thread
+                        return
+                    outer.set_exception(exc)
+
+                fut.add_done_callback(_done)
+                return
+            outer.set_exception(
+                state["last"] if state["last"] is not None
+                else RuntimeError(f"{self.name}: no dispatch candidates"))
+
+        attempt(0)
+        return outer
+
+    def _failover(self, replica_name: str):
+        child = self._failover_children.get(replica_name)
+        if child is None:
+            child = self._c_failover_family.labels(self.name, replica_name)
+            self._failover_children[replica_name] = child
+        return child
 
     def output_async(self, x, *, timeout: Optional[float] = None,
                      deadline: Optional[Deadline] = None,
@@ -571,10 +671,13 @@ class EnginePool:
                 cache_state = "miss"
         self._admission.admit(priority)
         try:
-            fut = self._dispatch(
-                lambda e: e.output_async(x, deadline=deadline,
-                                         priority=priority),
-                self.replicas)
+            submit = lambda e: e.output_async(x, deadline=deadline,  # noqa: E731
+                                              priority=priority)
+            if self._has_remote:
+                fut = self._dispatch_failover(submit, self.replicas,
+                                              deadline=deadline)
+            else:
+                fut = self._dispatch(submit, self.replicas)
         except Exception:
             self._admission.release()
             raise
@@ -764,6 +867,16 @@ class EnginePool:
         if "by_priority" in adm:
             out["shed_by_priority"] = {
                 p: v["shed"] for p, v in adm["by_priority"].items()}
+        if self._has_remote:
+            remotes = [e for e in all_replicas
+                       if getattr(e, "is_remote", False)]
+            out["fabric"] = {
+                "remote_replicas": [e.name for e in remotes],
+                "healthy": {e.name: e.circuit_state is CircuitState.CLOSED
+                            for e in remotes},
+                "failovers": {n: int(c.value)
+                              for n, c in self._failover_children.items()},
+            }
         if self.decode_replicas:
             # pool-level generation view: per-replica circuits + the
             # acceptance counters aggregated across decode replicas

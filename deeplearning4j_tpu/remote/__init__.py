@@ -1,3 +1,6 @@
-from .server import JsonModelServer, JsonRemoteInference, ServiceUnavailableError
+from .replica import RemoteDeployError, RemoteReplica
+from .server import (JsonModelServer, JsonRemoteInference,
+                     PartialStreamError, ServiceUnavailableError)
 
-__all__ = ["JsonModelServer", "JsonRemoteInference", "ServiceUnavailableError"]
+__all__ = ["JsonModelServer", "JsonRemoteInference", "PartialStreamError",
+           "RemoteDeployError", "RemoteReplica", "ServiceUnavailableError"]

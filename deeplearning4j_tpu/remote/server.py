@@ -89,8 +89,10 @@ decode replicas, circuit-skip + least-loaded fallback; an explicit
 
 from __future__ import annotations
 
+import http.client as _http_client
 import itertools
 import json
+import os
 import threading
 import time
 import uuid
@@ -139,7 +141,20 @@ class ServiceUnavailableError(ResilienceError):
         self.retry_after = retry_after
 
 
+class PartialStreamError(ResilienceError):
+    """A generation stream died mid-way (connection drop, truncated
+    NDJSON, or EOF before the terminal ``done`` event). Carries the
+    tokens received before the drop so the caller keeps the partial
+    output. The client NEVER silently retries a stream that already
+    emitted tokens — a transparent retry would re-emit them."""
+
+    def __init__(self, msg: str, tokens=None):
+        super().__init__(msg)
+        self.tokens = list(tokens or [])
+
+
 _MODELS_PREFIX = "/v1/models"
+_ADMIN_ACTIONS = ("deploy", "rollback")
 
 
 class JsonModelServer:
@@ -174,6 +189,7 @@ class JsonModelServer:
         self._clock = clock
         self._draining = False
         self.name = name or f"server-{next(_server_seq)}"
+        self._t0_mono = time.monotonic()  # replica identity: uptime
         self.registry = registry if registry is not None else get_registry()
         self._tracer = tracer  # None -> process-global at request time
         # named ModelManager endpoints (serving/): name -> manager. The
@@ -214,6 +230,15 @@ class JsonModelServer:
                 rid = getattr(self, "_request_id", None)
                 if rid is not None:
                     self.send_header("X-Request-Id", rid)
+                # load score piggybacks on every POST response so a
+                # RemoteReplica in a front pool learns this host's load
+                # for free (staleness-bounded /stats poll is the fallback)
+                if self.command == "POST":
+                    try:
+                        self.send_header("X-Load-Score",
+                                         f"{outer.load_score():.3f}")
+                    except Exception:
+                        pass
                 for k, v in (headers or {}).items():
                     self.send_header(k, v)
                 self.end_headers()
@@ -324,6 +349,40 @@ class JsonModelServer:
                 self._send(404, {"error": f"unknown path {self.path}"})
                 return None
 
+            def _handle_admin(self):
+                """``POST /v1/models/<name>/deploy`` (body
+                ``{"version": N|"vN"|"latest"}``) and ``POST
+                /v1/models/<name>/rollback`` against a registered
+                ModelManager — the remote end of the pool's deploy
+                fan-out (a front pool with RemoteReplicas rolls each
+                host through this route)."""
+                rest = self.path[len(_MODELS_PREFIX) + 1:]
+                mname, _, action = rest.rpartition("/")
+                mgr = outer._managers.get(mname)
+                if mgr is None:
+                    self._send(404, {"error": f"unknown model {mname!r}"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = (json.loads(self.rfile.read(length))
+                               if length else {})
+                except Exception as e:
+                    self._send(400, {"error": f"malformed request: {e}"})
+                    return
+                try:
+                    if action == "deploy":
+                        previous = mgr.live_version
+                        entry = mgr.deploy(payload.get("version", "latest"))
+                        self._send(200, {"deployed": str(entry.version),
+                                         "previous": previous})
+                    else:
+                        mgr.rollback()
+                        self._send(200, {"live": mgr.live_version})
+                except VersionNotFoundError as e:
+                    self._send(404, {"error": str(e)})
+                except Exception as e:
+                    self._send(500, {"error": f"{action} failed: {e}"})
+
             def _handle_generate(self):
                 # ---- parse: any failure here is the CLIENT's fault -> 400
                 try:
@@ -395,6 +454,11 @@ class JsonModelServer:
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
                 self.send_header("X-Request-Id", self._request_id)
+                try:
+                    self.send_header("X-Load-Score",
+                                     f"{outer.load_score():.3f}")
+                except Exception:
+                    pass
                 self.end_headers()
                 try:
                     for ev in handle.events(
@@ -408,6 +472,10 @@ class JsonModelServer:
                     raise
 
             def _handle_post(self):
+                if (self.path.startswith(_MODELS_PREFIX + "/")
+                        and self.path.rsplit("/", 1)[-1] in _ADMIN_ACTIONS):
+                    self._handle_admin()
+                    return
                 if self.path == outer.generate_path and (
                         outer._generator is not None
                         or (outer._pool is not None
@@ -471,6 +539,32 @@ class JsonModelServer:
     @property
     def tracer(self) -> Tracer:
         return self._tracer if self._tracer is not None else get_tracer()
+
+    def identity(self) -> dict:
+        """Stable replica identity, surfaced on ``/health`` and
+        ``/stats``: lets a pool fan-out failure be attributed to a HOST
+        (which process, how long it has been up), not just an endpoint."""
+        return {"name": self.name,
+                "uptime_seconds": round(
+                    time.monotonic() - self._t0_mono, 3),
+                "pid": os.getpid()}
+
+    def load_score(self) -> float:
+        """Aggregate load score across every engine this server routes
+        to — piggybacked on POST responses as ``X-Load-Score`` so a
+        front pool's ``RemoteReplica`` tracks this host's load without
+        extra polling."""
+        score = 0.0
+        engines = ([] if self._pi is None else [self._pi]) + \
+            [m.engine for m in self._managers.values()]
+        for e in engines:
+            score += float(e.load_score())
+        if self._pool is not None:
+            score += float(self._pool.load_score())
+        if self._generator is not None and hasattr(self._generator,
+                                                   "load_score"):
+            score += float(self._generator.load_score())
+        return score
 
     def traces_payload(self, query: str = "") -> dict:
         """``GET /v1/traces`` body: recent completed traces, filterable by
@@ -542,6 +636,7 @@ class JsonModelServer:
             status = "ok"
         payload["status"] = status
         payload["queue_depth"] = queue_depth
+        payload["replica"] = self.identity()
         if self._pi is not None:
             payload["circuit"] = self._pi.circuit_state.value
         if self._managers:
@@ -561,6 +656,7 @@ class JsonModelServer:
         if self._generator is not None:
             s["generate"] = self._generator.stats()
         s["draining"] = self._draining
+        s["replica"] = self.identity()
         return s
 
     def start(self) -> "JsonModelServer":
@@ -773,16 +869,41 @@ class JsonRemoteInference:
 
         with tracer.span("client.request",
                          attrs={"endpoint": endpoint}):
+            # retries cover stream OPENING only (503/connect errors before
+            # the first byte). Once events flow, a connection drop raises
+            # PartialStreamError with the tokens received so far — NEVER a
+            # transparent re-open, which would re-emit tokens the caller
+            # already consumed.
             resp = self.retry_policy.execute(
                 open_stream,
                 retry_on=(ServiceUnavailableError, URLError, ConnectionError),
                 deadline=deadline, sleep=self._sleep)
+            tokens: list = []
             with resp:
-                for line in resp:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    ev = json.loads(line)
-                    yield ev
-                    if ev.get("done"):
-                        return
+                try:
+                    for line in resp:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            ev = json.loads(line)
+                        except ValueError as e:  # truncated NDJSON line
+                            raise PartialStreamError(
+                                f"stream truncated after {len(tokens)} "
+                                f"tokens: {e}", tokens) from e
+                        if "token" in ev:
+                            tokens.append(ev["token"])
+                        yield ev
+                        if ev.get("done"):
+                            return
+                except PartialStreamError:
+                    raise
+                except (ConnectionError, _http_client.HTTPException,
+                        URLError, OSError) as e:
+                    raise PartialStreamError(
+                        f"stream dropped after {len(tokens)} tokens: {e}",
+                        tokens) from e
+            # EOF with no terminal event: the server died between lines
+            raise PartialStreamError(
+                f"stream ended without a done event after {len(tokens)} "
+                f"tokens", tokens)

@@ -224,6 +224,11 @@ class ModelManager:
         feat = self._warmup_shapes()
         if feat is None:
             return
+        if getattr(servable, "model", None) is None:
+            # remote-backed servable (cross-host fabric): there is no
+            # local jitted forward to warm — each host warms during its
+            # own deploy, driven by the swap fan-out
+            return
         dtype = servable.model.dtype
         with self.tracer.span("manager.warmup",
                               attrs={"model": self.model_name,

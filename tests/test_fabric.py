@@ -1,0 +1,357 @@
+"""Cross-host serving fabric unit tests (ISSUE 12): RemoteReplica's
+replica protocol over HTTP, pool failover semantics (connection error /
+503 fail over, 400 never does), health-prober breaker feed, load-score
+piggyback + staleness fallback, and remote deploy fan-out with rollback
+on partial failure. The full kill-a-host chaos story lives in
+tools/check_fabric_contract.py (tier-1 via test_fabric_contract.py)."""
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.core.resilience import (CircuitBreaker,
+                                                CircuitState,
+                                                ReplicaUnavailableError)
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+from deeplearning4j_tpu.parallel import EnginePool
+from deeplearning4j_tpu.remote import (JsonModelServer, RemoteDeployError,
+                                       RemoteReplica)
+
+
+def _small_model(seed=5):
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _wait_for(cond, timeout=10.0, what="condition"):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _replica(port, name, *, registry=None, breaker=None, prober=False,
+             **kw):
+    return RemoteReplica(
+        f"http://127.0.0.1:{port}/v1/serving", name=name,
+        registry=registry or MetricsRegistry(),
+        circuit_breaker=breaker, start_prober=prober,
+        probe_interval=0.05, **kw)
+
+
+class _RawServer:
+    """Minimal raw-socket HTTP server for protocol-level failure shapes
+    (fixed status codes, truncated bodies) that a well-behaved
+    JsonModelServer never produces."""
+
+    def __init__(self, respond):
+        self._respond = respond
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            with conn:
+                try:
+                    conn.settimeout(5)
+                    data = b""
+                    while b"\r\n\r\n" not in data:
+                        data += conn.recv(65536)
+                    head = data.split(b"\r\n\r\n", 1)[0].decode()
+                    length = 0
+                    for line in head.split("\r\n"):
+                        if line.lower().startswith("content-length:"):
+                            length = int(line.split(":", 1)[1])
+                    body = data.split(b"\r\n\r\n", 1)[1]
+                    while len(body) < length:
+                        body += conn.recv(65536)
+                    conn.sendall(self._respond(head, body))
+                except Exception:
+                    pass
+
+    def close(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+@pytest.fixture(scope="module")
+def backend():
+    model = _small_model()
+    srv = JsonModelServer(model, port=0, workers=1,
+                          registry=MetricsRegistry(), name="fab-be").start()
+    yield srv, model
+    srv.stop(drain=False)
+
+
+def test_remote_replica_serves_through_pool(backend):
+    srv, model = backend
+    reg = MetricsRegistry()
+    rep = _replica(srv.port, "solo", registry=reg)
+    pool = EnginePool(engines=[rep], registry=reg, name="fab-p1")
+    try:
+        x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        out = pool.output(x, timeout=15)
+        np.testing.assert_allclose(out, np.asarray(model.output(x)),
+                                   atol=1e-5)
+        assert pool.stats()["dispatched"]["solo"] == 1
+        assert pool.stats()["fabric"]["healthy"] == {"solo": True}
+    finally:
+        pool.shutdown(drain=False)
+
+
+def test_load_score_piggybacks_and_falls_back_to_stats_poll(backend):
+    srv, _ = backend
+    rep = _replica(srv.port, "score", load_score_max_age=60.0)
+    try:
+        assert rep._remote_score is None
+        rep.output(np.ones((1, 4), np.float32), timeout=15)
+        # every POST response carries X-Load-Score
+        assert rep._remote_score is not None
+        # the /stats poll fallback refreshes score AND identity
+        rep._remote_score = None
+        s = rep.poll_stats()
+        assert rep._remote_score is not None
+        assert s["replica"]["name"] == "fab-be"
+        assert rep.stats()["remote"]["pid"] == s["replica"]["pid"]
+        assert rep.load_score() >= 0.0
+    finally:
+        rep.shutdown(drain=False)
+
+
+def test_connection_error_fails_over_to_survivor(backend):
+    """A dead host surfaces as ReplicaUnavailableError on the dispatched
+    future; the pool fails the request over to the next candidate and
+    the caller sees only the answer."""
+    srv, model = backend
+    reg = MetricsRegistry()
+    dead_port = _free_port()
+    dead = _replica(dead_port, "dead", registry=reg,
+                    breaker=CircuitBreaker(min_calls=2, window=4,
+                                           open_timeout=60.0))
+    live = _replica(srv.port, "live", registry=reg)
+    pool = EnginePool(engines=[dead, live], registry=reg, seed=0,
+                      name="fab-fo")
+    try:
+        x = np.ones((1, 4), np.float32)
+        for _ in range(8):  # p2c will pick the dead one sometimes
+            out = pool.output(x, timeout=15)
+        np.testing.assert_allclose(out, np.asarray(model.output(x)),
+                                   atol=1e-5)
+        st = pool.stats()
+        assert st["fabric"]["failovers"]["dead"] >= 1
+        # the dead host's breaker accumulated the failures and opened,
+        # taking it out of rotation entirely
+        assert dead.circuit_state is CircuitState.OPEN
+        assert st["fabric"]["healthy"]["dead"] is False
+    finally:
+        pool.shutdown(drain=False)
+
+
+def test_400_never_fails_over():
+    """A host answering 400 is telling the CALLER the input is bad —
+    retrying it on another host cannot help and must not happen."""
+    def bad_request(_head, _body):
+        body = json.dumps({"error": "malformed request: nope"}).encode()
+        return (b"HTTP/1.0 400 Bad Request\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: " + str(len(body)).encode()
+                + b"\r\n\r\n" + body)
+
+    raw = _RawServer(bad_request)
+    reg = MetricsRegistry()
+    r400 = _replica(raw.port, "r400", registry=reg)
+    other = _replica(_free_port(), "other", registry=reg)
+    pool = EnginePool(engines=[r400, other], registry=reg, seed=1,
+                      name="fab-400")
+    try:
+        # force dispatch onto the 400 replica: the other one is open
+        for _ in range(5):
+            other._breaker.record_failure()
+        assert other.circuit_state is CircuitState.OPEN
+        with pytest.raises(ValueError):
+            pool.output(np.ones((1, 4), np.float32), timeout=10)
+        assert pool.stats()["fabric"]["failovers"]["r400"] == 0
+        # a 400 is the caller's fault: the replica stays healthy
+        assert r400.circuit_state is CircuitState.CLOSED
+    finally:
+        pool.shutdown(drain=False)
+        raw.close()
+
+
+def test_truncated_body_is_host_failure():
+    def truncated(_head, _body):
+        return (b"HTTP/1.0 200 OK\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: 500\r\n\r\n"
+                b'{"output": [[0.1')  # dies mid-body
+
+    raw = _RawServer(truncated)
+    rep = _replica(raw.port, "trunc")
+    try:
+        with pytest.raises(ReplicaUnavailableError):
+            rep.output(np.ones((1, 4), np.float32), timeout=10)
+    finally:
+        rep.shutdown(drain=False)
+        raw.close()
+
+
+def test_prober_opens_breaker_without_traffic_and_rejoins(backend):
+    """The health prober feeds the dispatch breaker: a dead endpoint is
+    marked unhealthy with ZERO request traffic; once something answers
+    /health there again, the half-open probe closes the breaker — no
+    operator action, no request needed."""
+    srv, _ = backend
+    port = _free_port()
+    rep = RemoteReplica(
+        f"http://127.0.0.1:{port}/v1/serving", name="probed",
+        registry=MetricsRegistry(), probe_interval=0.05,
+        connect_timeout=0.5,
+        circuit_breaker=CircuitBreaker(min_calls=2, window=4,
+                                       open_timeout=0.3))
+    try:
+        _wait_for(lambda: rep.circuit_state is CircuitState.OPEN,
+                  what="prober to open the breaker")
+        assert rep.stats()["probes"]["error"] >= 2
+        # something starts answering on that port
+        revived = JsonModelServer(_small_model(), port=port, workers=1,
+                                  registry=MetricsRegistry(),
+                                  name="revived").start()
+        try:
+            _wait_for(lambda: rep.circuit_state is CircuitState.CLOSED,
+                      what="half-open probe to close the breaker")
+            assert rep.stats()["probes"]["ok"] >= 1
+            # identity came along with the healthy probe
+            assert rep.stats()["remote"]["name"] == "revived"
+        finally:
+            revived.stop(drain=False)
+    finally:
+        rep.shutdown(drain=False)
+
+
+def test_degraded_health_counts_as_probe_failure():
+    def degraded(head, _body):
+        if head.startswith("GET /health"):
+            body = json.dumps({"status": "degraded",
+                               "queue_depth": 0}).encode()
+            code = b"503 Service Unavailable"
+        else:
+            body = json.dumps({"status": "ok"}).encode()
+            code = b"200 OK"
+        return (b"HTTP/1.0 " + code + b"\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: " + str(len(body)).encode()
+                + b"\r\n\r\n" + body)
+
+    raw = _RawServer(degraded)
+    rep = _replica(raw.port, "deg",
+                   breaker=CircuitBreaker(min_calls=2, window=4,
+                                          open_timeout=60.0))
+    try:
+        assert rep.probe() == "degraded"
+        assert rep.probe() == "degraded"
+        assert rep.circuit_state is CircuitState.OPEN
+    finally:
+        rep.shutdown(drain=False)
+        raw.close()
+
+
+def test_remote_deploy_fanout_rolls_back_on_partial_failure(tmp_path):
+    """ModelManager over a pool of RemoteReplicas rolls each host
+    atomically: host0 deploys, host1 fails -> host0 is rolled back to
+    the prior version before the error reaches the caller, so the fleet
+    never serves two versions."""
+    from deeplearning4j_tpu.core.resilience import FaultInjector
+    from deeplearning4j_tpu.serving import ModelManager, ModelStore
+
+    store = ModelStore(str(tmp_path))
+    store.publish("m", _small_model(1))
+    store.publish("m", _small_model(2))
+
+    hosts = []
+    for i in range(2):
+        reg = MetricsRegistry()
+        mgr = ModelManager(store, "m", version=1, registry=reg,
+                           probation_seconds=0.0,
+                           warmup_example=np.zeros((1, 4), np.float32))
+        srv = JsonModelServer(port=0, managers={"m": mgr}, registry=reg,
+                              name=f"dh{i}").start()
+        hosts.append((srv, mgr))
+    front_reg = MetricsRegistry()
+    reps = [RemoteReplica(f"http://127.0.0.1:{srv.port}/v1/models/m",
+                          name=f"drr{i}", model_name="m",
+                          registry=front_reg, start_prober=False)
+            for i, (srv, _) in enumerate(hosts)]
+    pool = EnginePool(engines=reps, registry=front_reg, name="dfab")
+    front = ModelManager(store, "m", engine=pool, registry=front_reg,
+                         probation_seconds=0.0)
+    try:
+        assert front.live_version == "1"
+        front.deploy(2)
+        assert [m.live_version for _, m in hosts] == ["2", "2"]
+        assert front.live_version == "2"
+        # requests flow through the pool onto the managed route
+        out = pool.output(np.ones((1, 4), np.float32), timeout=15)
+        assert out.shape == (1, 3)
+
+        # partial failure: host1's store load dies mid-fan-out
+        inj = FaultInjector()
+        inj.inject_error("model_manager.load",
+                         lambda: RuntimeError("disk gone"), times=1)
+        hosts[1][1]._fault_injector = inj
+        with pytest.raises(RemoteDeployError):
+            front.deploy(1)
+        # host0 was deployed to v1, then rolled back to v2
+        assert [m.live_version for _, m in hosts] == ["2", "2"], \
+            "partial deploy must leave every host on the prior version"
+    finally:
+        pool.shutdown(drain=False)
+        for srv, _ in hosts:
+            srv.stop(drain=False)
+
+
+def test_local_pool_has_no_fabric_surface():
+    """No remote replicas configured -> no failover dispatch path, no
+    fabric stats section, no fabric series in the registry (local pools
+    are unaffected by the fabric feature)."""
+    reg = MetricsRegistry()
+    pool = EnginePool(model=_small_model(), replicas=2, workers=1,
+                      registry=reg, name="local-only")
+    try:
+        assert pool._has_remote is False
+        assert "fabric" not in pool.stats()
+        from deeplearning4j_tpu.obs.prom import render_prometheus
+        assert "dl4j_tpu_fabric" not in render_prometheus(reg)
+    finally:
+        pool.shutdown(drain=False)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

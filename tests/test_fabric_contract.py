@@ -1,0 +1,21 @@
+"""Tier-1 wiring for tools/check_fabric_contract.py: the cross-host
+serving fabric chaos contract (README.md "Cross-host serving fabric") —
+two real HTTP hosts behind one EnginePool of RemoteReplica adapters,
+kill one host under mixed-priority load and assert zero high-priority
+loss, breaker-open within one window, re-balance onto the survivor, and
+half-open rejoin after revival — is enforced on every test run, not
+just when someone remembers to run the tool."""
+
+import os
+import sys
+
+_TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+
+
+def test_fabric_contract_smoke():
+    sys.path.insert(0, _TOOLS)
+    try:
+        import check_fabric_contract
+    finally:
+        sys.path.remove(_TOOLS)
+    assert check_fabric_contract.main(log=lambda m: None) == 0

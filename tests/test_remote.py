@@ -324,6 +324,111 @@ def test_graceful_drain_on_stop():
             f"http://127.0.0.1:{srv.port}/health", timeout=2)
 
 
+def test_health_and_stats_carry_replica_identity(server):
+    """ISSUE 12 satellite: /health and /stats carry a stable identity
+    block (name/uptime_seconds/pid) so pool fan-out failures are
+    attributable to a host."""
+    import os as _os
+
+    srv, _ = server
+    for path in ("/health", "/stats"):
+        with urllib_request.urlopen(
+                f"http://127.0.0.1:{srv.port}{path}", timeout=10) as r:
+            payload = json.loads(r.read())
+        ident = payload["replica"]
+        assert ident["name"] == srv.name
+        assert ident["pid"] == _os.getpid()
+        assert ident["uptime_seconds"] >= 0.0
+    # uptime advances between reads
+    import time as _time
+
+    _time.sleep(0.05)
+    with urllib_request.urlopen(
+            f"http://127.0.0.1:{srv.port}/health", timeout=10) as r:
+        later = json.loads(r.read())["replica"]["uptime_seconds"]
+    assert later > ident["uptime_seconds"] - 1e-9
+
+
+def test_post_responses_carry_load_score(server):
+    srv, _ = server
+    req = urllib_request.Request(
+        f"http://127.0.0.1:{srv.port}/v1/serving",
+        data=json.dumps({"data": [[1.0, 2.0, 3.0, 4.0]]}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib_request.urlopen(req, timeout=10) as r:
+        assert r.status == 200
+        score = r.headers.get("X-Load-Score")
+    assert score is not None and float(score) >= 0.0
+
+
+def _raw_ndjson_server(chunks, *, then_close=True):
+    """One-shot raw HTTP server: answers any POST with an NDJSON body
+    built from ``chunks`` and then drops the connection — the shape of a
+    host dying mid-generation-stream."""
+    import socket
+
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind(("127.0.0.1", 0))
+    sock.listen(1)
+    port = sock.getsockname()[1]
+
+    def serve():
+        conn, _ = sock.accept()
+        try:
+            conn.settimeout(5)
+            data = b""
+            while b"\r\n\r\n" not in data:
+                data += conn.recv(65536)
+            conn.sendall(b"HTTP/1.0 200 OK\r\n"
+                         b"Content-Type: application/x-ndjson\r\n\r\n")
+            for c in chunks:
+                conn.sendall(c)
+        finally:
+            conn.close()   # abrupt: no done event ever arrives
+            sock.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    return port
+
+
+def test_generate_mid_stream_drop_raises_partial_output():
+    """ISSUE 12 satellite: a server dying mid-NDJSON-stream surfaces as
+    PartialStreamError carrying the tokens received so far — never a
+    silent retry that would re-emit them."""
+    from deeplearning4j_tpu.remote import PartialStreamError
+
+    port = _raw_ndjson_server([
+        b'{"token": 5, "index": 0}\n',
+        b'{"token": 7, "index": 1}\n',
+    ])
+    client = JsonRemoteInference(f"http://127.0.0.1:{port}/v1/serving",
+                                 timeout=10)
+    events = []
+    with pytest.raises(PartialStreamError) as ei:
+        for ev in client.generate([1, 2, 3], max_tokens=8):
+            events.append(ev)
+    # the two emitted tokens were yielded exactly once and ride the error
+    assert [e["token"] for e in events] == [5, 7]
+    assert ei.value.tokens == [5, 7]
+    assert client.retries == 0, "a broken stream must never retry"
+
+
+def test_generate_truncated_line_raises_partial_output():
+    from deeplearning4j_tpu.remote import PartialStreamError
+
+    port = _raw_ndjson_server([
+        b'{"token": 3, "index": 0}\n',
+        b'{"token": 9, "ind',     # truncated mid-line
+    ])
+    client = JsonRemoteInference(f"http://127.0.0.1:{port}/v1/serving",
+                                 timeout=10)
+    with pytest.raises(PartialStreamError) as ei:
+        list(client.generate([1], max_tokens=8))
+    assert ei.value.tokens == [3]
+
+
 def test_health_includes_generate_circuit():
     """ISSUE 10 satellite bugfix: health() must cover the DecodeEngine —
     a tripped generate circuit previously still reported ok/200 and its

@@ -212,6 +212,75 @@ class TestCircuitBreaker:
             cb.call(lambda: (_ for _ in ()).throw(RuntimeError("boom")))
         assert cb.state is CircuitState.CLOSED  # 1/2 failures < 1.0
 
+    # ---- ISSUE 12 satellite: half-open under N concurrent probes ------
+    def _opened(self, clk):
+        cb = _breaker(clk)
+        for _ in range(4):
+            cb.record_failure()
+        assert cb.state is CircuitState.OPEN
+        clk.advance(10.0)  # timeout elapsed: next allow() is the trial
+        return cb
+
+    def _concurrent_allow(self, cb, n=16):
+        """n threads race allow() through a barrier; returns the list of
+        verdicts."""
+        import threading
+
+        barrier = threading.Barrier(n)
+        results = []
+        lock = threading.Lock()
+
+        def worker():
+            barrier.wait()
+            ok = cb.allow()
+            with lock:
+                results.append(ok)
+
+        threads = [threading.Thread(target=worker) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(results) == n
+        return results
+
+    def test_half_open_concurrent_probes_exactly_one_trial(self):
+        cb = self._opened(FakeClock())
+        results = self._concurrent_allow(cb)
+        assert sum(results) == 1, \
+            f"exactly one trial slot, got {sum(results)} (thundering herd)"
+        # while the trial is in flight, later callers keep being rejected
+        assert not cb.allow()
+        assert cb.state is CircuitState.HALF_OPEN
+
+    def test_half_open_failed_trial_reopens_under_concurrency(self):
+        clk = FakeClock()
+        cb = self._opened(clk)
+        assert sum(self._concurrent_allow(cb)) == 1
+        cb.record_failure()  # the one trial fails
+        assert cb.state is CircuitState.OPEN
+        # a fresh full timeout gates the NEXT single trial
+        assert sum(self._concurrent_allow(cb)) == 0
+        clk.advance(10.0)
+        assert sum(self._concurrent_allow(cb)) == 1
+
+    def test_half_open_successful_trial_closes_for_everyone(self):
+        cb = self._opened(FakeClock())
+        assert sum(self._concurrent_allow(cb)) == 1
+        cb.record_success()  # the one trial succeeds
+        assert cb.state is CircuitState.CLOSED
+        assert all(self._concurrent_allow(cb))  # closed: no gating
+
+    def test_half_open_max_calls_n_admits_exactly_n(self):
+        clk = FakeClock()
+        cb = CircuitBreaker(failure_threshold=0.5, min_calls=4, window=8,
+                            open_timeout=10.0, half_open_max_calls=3,
+                            clock=clk)
+        for _ in range(4):
+            cb.record_failure()
+        clk.advance(10.0)
+        assert sum(self._concurrent_allow(cb)) == 3
+
 
 # ----------------------------------------------------- AdmissionController
 class TestAdmissionController:

@@ -45,6 +45,9 @@ from urllib.error import HTTPError
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from contract_common import start_http_server  # noqa: E402
 
 MAX_LEN = 24
 
@@ -89,8 +92,9 @@ def main(log=print) -> int:
     engine = DecodeEngine(model, max_len=MAX_LEN, slots=2, queue_limit=3,
                           registry=registry, tracer=tracer, name="gen",
                           step_hook=lambda: time.sleep(slow["delay"]))
-    server = JsonModelServer(generator=engine, registry=registry,
-                             tracer=tracer, name="gen-server").start()
+    server = start_http_server(
+        lambda: JsonModelServer(generator=engine, registry=registry,
+                                tracer=tracer, name="gen-server").start())
     port = server.port
     try:
         # ---- 1. ordered token events, greedy-deterministic over HTTP
@@ -221,8 +225,9 @@ def main(log=print) -> int:
                              max_len=MAX_LEN, slots=2, registry=reg2,
                              name=f"spec-r{i}") for i in range(2)]
     pool = EnginePool(engines=replicas, registry=reg2, name="spec-pool")
-    pooled = JsonModelServer(pool=pool, registry=reg2,
-                             name="spec-pool-server").start()
+    pooled = start_http_server(
+        lambda: JsonModelServer(pool=pool, registry=reg2,
+                                name="spec-pool-server").start())
     try:
         req = urllib_request.Request(
             f"http://127.0.0.1:{pooled.port}/v1/generate",

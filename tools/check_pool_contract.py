@@ -39,6 +39,9 @@ from urllib.error import HTTPError
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from contract_common import start_http_server  # noqa: E402
 
 
 def _post(port, payload, headers=None, timeout=15):
@@ -109,8 +112,9 @@ def main(log=print) -> int:
         breaker_factory=lambda: CircuitBreaker(min_calls=3, window=6,
                                                open_timeout=300.0),
         fault_injector=inj, registry=reg, name="poolctr")
-    srv = JsonModelServer(pool=pool, port=0, registry=reg,
-                          name="poolctr-srv").start()
+    srv = start_http_server(
+        lambda: JsonModelServer(pool=pool, port=0, registry=reg,
+                                name="poolctr-srv").start())
     port = srv.port
     rng = np.random.RandomState(0)
     try:

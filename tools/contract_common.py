@@ -1,0 +1,31 @@
+"""Shared helpers for the real-HTTP contract checks (tools/check_*.py).
+
+Every check binds its servers to OS-assigned ports (``port=0``) — the
+kernel hands out a free port, so a collision is all but impossible. The
+residual race (a pinned-port rebind in the chaos harness, or two checks
+landing in the same SO_REUSEADDR window) surfaces as ``EADDRINUSE`` and
+used to fail the whole run; :func:`start_http_server` turns it into one
+bounded retry instead of a flake.
+"""
+
+from __future__ import annotations
+
+import errno
+import time
+
+
+def start_http_server(make_server, *, attempts: int = 2,
+                      backoff_s: float = 0.2):
+    """Construct-and-start a server via ``make_server()`` (which must
+    bind the port — pass ``port=0`` for an OS-assigned one), retrying on
+    ``EADDRINUSE``. Any other ``OSError`` propagates immediately."""
+    last = None
+    for i in range(max(1, int(attempts))):
+        try:
+            return make_server()
+        except OSError as e:
+            if e.errno != errno.EADDRINUSE:
+                raise
+            last = e
+            time.sleep(backoff_s * (i + 1))
+    raise last
