@@ -301,6 +301,18 @@ class CircuitBreaker:
         if not self.allow():
             raise CircuitOpenError(retry_after=self.retry_after())
 
+    def release(self) -> None:
+        """Release a slot reserved by ``allow()``/``check()`` when the
+        call ended with neither a host success nor a host failure (the
+        caller's bad input, the caller's deadline). Leaves the state and
+        the outcome window untouched — without this, a 400/504 landing
+        in the single half-open trial slot would wedge the breaker in
+        HALF_OPEN forever (no probe could ever run again)."""
+        with self._lock:
+            if self._state is CircuitState.HALF_OPEN:
+                self._half_open_inflight = max(
+                    0, self._half_open_inflight - 1)
+
     def record_success(self) -> None:
         with self._lock:
             if self._state is CircuitState.HALF_OPEN:

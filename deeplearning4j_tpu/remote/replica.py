@@ -143,7 +143,10 @@ class RemoteReplica:
                              f"{endpoint!r}")
         self._base = f"{u.scheme}://{u.netloc}"
         self.endpoint = endpoint if u.path else f"{self._base}/v1/serving"
-        self.name = name or f"remote-{u.netloc or next(_replica_seq)}"
+        # the sequence number keeps auto-names unique: two adapters to
+        # the same netloc must not share metric label children or
+        # collide in the pool's per-name failover bookkeeping
+        self.name = name or f"remote-{u.netloc}-{next(_replica_seq)}"
         self.model_name = model_name
         self.connect_timeout = float(connect_timeout)
         self.read_timeout = float(read_timeout)
@@ -225,17 +228,23 @@ class RemoteReplica:
         with self._lock:
             if self._model_version is not None:
                 return self._model_version
-        v = "0"
-        if self.model_name:
-            try:
-                with urllib_request.urlopen(
-                        f"{self._base}/v1/models",
-                        timeout=self.connect_timeout) as r:
-                    models = json.loads(r.read())["models"]
-                v = str(models.get(self.model_name, {}).get(
-                    "live_version", "0"))
-            except Exception:
-                v = "0"
+        if not self.model_name:
+            with self._lock:
+                self._model_version = "0"
+            return "0"
+        try:
+            with urllib_request.urlopen(
+                    f"{self._base}/v1/models",
+                    timeout=self.connect_timeout) as r:
+                models = json.loads(r.read())["models"]
+            v = str(models.get(self.model_name, {}).get(
+                "live_version", "0"))
+        except Exception:
+            # transient fetch failure: answer "0" but do NOT cache it —
+            # a later swap() would otherwise record old_version="0" and
+            # the pool's partial-failure rollback would "roll back" to a
+            # version that never existed. The next call retries.
+            return "0"
         with self._lock:
             self._model_version = v
         return v
@@ -273,6 +282,7 @@ class RemoteReplica:
         except RuntimeError:
             with self._lock:
                 self._inflight -= 1
+            self._breaker.release()  # give back the half-open trial slot
             raise RuntimeError(f"{self.name} is shut down")
         return fut
 
@@ -289,7 +299,10 @@ class RemoteReplica:
             out = self._call_once(body, deadline, priority)
         except (ValueError, DeadlineExceededError) as e:
             # the caller's input / the caller's deadline — the host is
-            # fine, so the breaker records nothing and nothing fails over
+            # fine, so the breaker records nothing and nothing fails
+            # over; the half-open trial slot check() reserved must still
+            # be given back or the breaker wedges in HALF_OPEN
+            breaker.release()
             fut.set_exception(e)
         except Exception as e:
             breaker.record_failure()
@@ -333,10 +346,16 @@ class RemoteReplica:
             except Exception:
                 pass
             if e.code == 503:
-                ra = e.headers.get("Retry-After")
+                # Retry-After may be an HTTP-date (RFC 7231), not just
+                # delta-seconds — an unparseable hint must not turn a
+                # host-unavailable signal into a caller error
+                try:
+                    ra = float(e.headers.get("Retry-After"))
+                except (TypeError, ValueError):
+                    ra = None
                 raise ReplicaUnavailableError(
                     f"{self.name}: 503 {detail or 'unavailable'}",
-                    retry_after=float(ra) if ra else None) from e
+                    retry_after=ra) from e
             if e.code == 400:
                 raise ValueError(detail or "bad request") from e
             if e.code == 504:

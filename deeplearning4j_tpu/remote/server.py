@@ -554,16 +554,19 @@ class JsonModelServer:
         to — piggybacked on POST responses as ``X-Load-Score`` so a
         front pool's ``RemoteReplica`` tracks this host's load without
         extra polling."""
-        score = 0.0
-        engines = ([] if self._pi is None else [self._pi]) + \
-            [m.engine for m in self._managers.values()]
-        for e in engines:
-            score += float(e.load_score())
-        if self._pool is not None:
-            score += float(self._pool.load_score())
-        if self._generator is not None and hasattr(self._generator,
-                                                   "load_score"):
-            score += float(self._generator.load_score())
+        # the same engine/pool can be both the direct serving target and
+        # a registered manager's engine — dedupe by identity so it is
+        # counted once (double-counting inflates X-Load-Score and skews
+        # the front pool's dispatch away from this host)
+        targets = [self._pi, self._pool, self._generator]
+        targets.extend(m.engine for m in self._managers.values())
+        score, seen = 0.0, set()
+        for e in targets:
+            if e is None or id(e) in seen:
+                continue
+            seen.add(id(e))
+            if hasattr(e, "load_score"):
+                score += float(e.load_score())
         return score
 
     def traces_payload(self, query: str = "") -> dict:

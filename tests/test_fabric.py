@@ -219,6 +219,120 @@ def test_truncated_body_is_host_failure():
         raw.close()
 
 
+def test_caller_error_in_half_open_slot_does_not_wedge_breaker():
+    """Regression: a 400 landing in the single half-open trial slot is a
+    NEUTRAL outcome (the host is fine, the input was bad) — the slot
+    must be released so the next probe can still run its trial. Without
+    the release the breaker wedges in HALF_OPEN forever: allow() keeps
+    rejecting and every probe() reports 'probe_inflight', permanently
+    removing the replica from rotation."""
+    def respond(head, _body):
+        if head.startswith("GET /health"):
+            body = json.dumps({"status": "ok", "queue_depth": 0}).encode()
+            code = b"200 OK"
+        else:
+            body = json.dumps({"error": "malformed"}).encode()
+            code = b"400 Bad Request"
+        return (b"HTTP/1.0 " + code + b"\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: " + str(len(body)).encode()
+                + b"\r\n\r\n" + body)
+
+    raw = _RawServer(respond)
+    breaker = CircuitBreaker(min_calls=2, window=4, open_timeout=0.05)
+    rep = _replica(raw.port, "wedge", breaker=breaker)
+    try:
+        for _ in range(2):
+            breaker.record_failure()
+        assert rep.circuit_state is CircuitState.OPEN
+        time.sleep(0.06)
+        assert rep.circuit_state is CircuitState.HALF_OPEN
+        # request traffic wins the trial slot over the prober and ends
+        # with a caller error...
+        with pytest.raises(ValueError):
+            rep.output(np.ones((1, 4), np.float32), timeout=10)
+        # ...which must have given the slot back: the next health probe
+        # takes the trial and closes the breaker
+        assert rep.circuit_state is CircuitState.HALF_OPEN
+        assert rep.probe() == "ok"
+        assert rep.circuit_state is CircuitState.CLOSED
+    finally:
+        rep.shutdown(drain=False)
+        raw.close()
+
+
+def test_model_version_fetch_failure_is_not_cached():
+    """Regression: a transient /v1/models fetch failure answers '0' but
+    must NOT cache it — a later swap() would record old_version='0' and
+    the pool's partial-failure rollback would re-deploy a version that
+    never existed. The next call retries and caches the real version."""
+    calls = []
+
+    def respond(head, _body):
+        if head.startswith("GET /v1/models"):
+            calls.append(1)
+            if len(calls) == 1:
+                return b""  # connection dies: transient fetch failure
+            body = json.dumps(
+                {"models": {"m": {"live_version": "7"}}}).encode()
+        else:
+            body = json.dumps({"status": "ok"}).encode()
+        return (b"HTTP/1.0 200 OK\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: " + str(len(body)).encode()
+                + b"\r\n\r\n" + body)
+
+    raw = _RawServer(respond)
+    rep = _replica(raw.port, "mv", model_name="m")
+    try:
+        assert rep.model_version == "0"    # transient-failure answer...
+        assert rep._model_version is None  # ...is not cached
+        assert rep.model_version == "7"    # retry succeeds and caches
+        assert rep._model_version == "7"
+    finally:
+        rep.shutdown(drain=False)
+        raw.close()
+
+
+def test_retry_after_http_date_is_still_host_unavailable():
+    """Retry-After may be an HTTP-date (RFC 7231) — an unparseable hint
+    must not turn the 503 into a caller error (it is still a
+    host-unavailable signal and must still fail over)."""
+    def respond(_head, _body):
+        body = json.dumps({"error": "overloaded"}).encode()
+        return (b"HTTP/1.0 503 Service Unavailable\r\n"
+                b"Retry-After: Wed, 05 Aug 2026 09:00:00 GMT\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: " + str(len(body)).encode()
+                + b"\r\n\r\n" + body)
+
+    raw = _RawServer(respond)
+    rep = _replica(raw.port, "ra-date")
+    try:
+        with pytest.raises(ReplicaUnavailableError) as ei:
+            rep.output(np.ones((1, 4), np.float32), timeout=10)
+        assert ei.value.retry_after is None
+    finally:
+        rep.shutdown(drain=False)
+        raw.close()
+
+
+def test_auto_generated_names_are_unique():
+    """Two adapters to the same netloc must not share a name — same-name
+    replicas collide in metric label children and in the pool's
+    per-name failover bookkeeping."""
+    a = RemoteReplica("http://127.0.0.1:9/v1/serving", start_prober=False,
+                      registry=MetricsRegistry())
+    b = RemoteReplica("http://127.0.0.1:9/v1/serving", start_prober=False,
+                      registry=MetricsRegistry())
+    try:
+        assert a.name != b.name
+        assert a.name.startswith("remote-127.0.0.1:9")
+    finally:
+        a.shutdown(drain=False)
+        b.shutdown(drain=False)
+
+
 def test_prober_opens_breaker_without_traffic_and_rejoins(backend):
     """The health prober feeds the dispatch breaker: a dead endpoint is
     marked unhealthy with ZERO request traffic; once something answers

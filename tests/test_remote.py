@@ -361,6 +361,37 @@ def test_post_responses_carry_load_score(server):
     assert score is not None and float(score) >= 0.0
 
 
+def test_load_score_dedupes_shared_engine_across_routes():
+    """An engine pool that is both the server's direct POST target
+    (pool=) and a registered manager's engine must be counted ONCE in
+    the aggregated load score — double-counting inflates X-Load-Score
+    and skews a front pool's dispatch away from this host."""
+    from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+    from deeplearning4j_tpu.parallel import EnginePool
+
+    class _FakeManager:
+        def __init__(self, engine):
+            self.engine = engine
+
+    conf = (NeuralNetConfiguration.builder().seed(5).list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3))
+            .build())
+    pool = EnginePool(model=MultiLayerNetwork(conf).init(), replicas=1,
+                      workers=1, registry=MetricsRegistry(),
+                      name="ls-pool")
+    srv = None
+    try:
+        srv = JsonModelServer(port=0, pool=pool,
+                              managers={"m": _FakeManager(pool)},
+                              registry=MetricsRegistry(), name="ls-srv")
+        assert srv.load_score() == pytest.approx(float(pool.load_score()))
+    finally:
+        if srv is not None:
+            srv._httpd.server_close()
+        pool.shutdown(drain=False)
+
+
 def _raw_ndjson_server(chunks, *, then_close=True):
     """One-shot raw HTTP server: answers any POST with an NDJSON body
     built from ``chunks`` and then drops the connection — the shape of a

@@ -281,6 +281,26 @@ class TestCircuitBreaker:
         clk.advance(10.0)
         assert sum(self._concurrent_allow(cb)) == 3
 
+    def test_release_frees_half_open_slot_without_state_change(self):
+        """A call that ends with neither success nor failure (caller's
+        bad input, caller's deadline) must give the trial slot back —
+        otherwise the breaker wedges in HALF_OPEN and no probe can ever
+        close it again."""
+        cb = self._opened(FakeClock())
+        assert cb.allow()        # trial slot taken
+        assert not cb.allow()
+        cb.release()             # neutral outcome: slot freed
+        assert cb.state is CircuitState.HALF_OPEN  # state untouched
+        assert cb.allow()        # the NEXT probe can run
+        cb.record_success()
+        assert cb.state is CircuitState.CLOSED
+
+    def test_release_is_noop_when_closed(self):
+        cb = _breaker(FakeClock())
+        cb.release()  # never reserved anything: harmless
+        assert cb.state is CircuitState.CLOSED
+        assert cb.allow()
+
 
 # ----------------------------------------------------- AdmissionController
 class TestAdmissionController:
