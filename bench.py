@@ -1837,6 +1837,205 @@ def measure_speculative_decode(vocab: int = 32, target_hidden: int = 256,
     }
 
 
+def measure_quantized_infer(batch: int = 64, n_in: int = 32,
+                            hidden: int = 256, classes: int = 16,
+                            train_steps: int = 60, infer_iters: int = 24,
+                            holdout: int = 512,
+                            match_gate: float = 0.98,
+                            prob_mse_gate: float = 1e-4) -> dict:
+    """Quantized-serving row (ISSUE 13 acceptance): quantized-vs-full-
+    precision inference latency ratio for the int8 weight-only rewrite
+    pass (per-channel absmax scales, dequant in the output epilogue),
+    an ACCURACY-DELTA GATE on a calibration holdout (top-1 agreement +
+    output MSE vs the full-precision model — the same gate a canary
+    promotion should watch), plus the calibrated activation-quantization
+    variant and fp8 where the jaxlib supports the dtype. On a CPU host
+    the latency ratio is informational (no int8 matmul unit); the
+    accuracy gate is the load-bearing check everywhere."""
+    import numpy as np
+
+    from deeplearning4j_tpu.nn import (Activation, InputType, LossFunction,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.rewrite import (QuantizeWeightsPass,
+                                               calibrate, rewrite_model)
+    from deeplearning4j_tpu.nn.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    rng = np.random.RandomState(0)
+    teacher = rng.randn(n_in, classes).astype(np.float32)
+
+    def make_batch(n):
+        x = rng.randn(n, n_in).astype(np.float32)
+        y = np.eye(classes, dtype=np.float32)[np.argmax(x @ teacher, axis=1)]
+        return x, y
+
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-3))
+            .list()
+            .layer(DenseLayer(n_in=n_in, n_out=hidden,
+                              activation=Activation.RELU))
+            .layer(DenseLayer(n_out=hidden, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=classes, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(n_in))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    for _ in range(train_steps):
+        model.fit(*make_batch(batch))
+    xh, yh = make_batch(holdout)
+    base_probs = np.asarray(model.output(xh))
+    base_top1 = np.argmax(base_probs, axis=1)
+    task_acc = float(np.mean(base_top1 == np.argmax(yh, axis=1)))
+
+    def infer_ms(m) -> float:
+        _host_fence(m.output(xh))  # compile
+        vals = []
+        for _ in range(REPEATS):
+            start = time.perf_counter()
+            for _ in range(infer_iters):
+                out = m.output(xh)
+            _host_fence(out)
+            vals.append((time.perf_counter() - start) / infer_iters * 1e3)
+        return statistics.median(vals)
+
+    def variant(passes):
+        m2, applied = rewrite_model(model, passes)
+        probs = np.asarray(m2.output(xh))
+        top1 = np.argmax(probs, axis=1)
+        return {
+            "applied": applied,
+            "infer_ms": round(infer_ms(m2), 3),
+            "top1_match_rate": round(float(np.mean(top1 == base_top1)), 4),
+            "prob_mse": float(np.mean((probs - base_probs) ** 2)),
+        }
+
+    fp_ms = infer_ms(model)
+    int8 = variant([QuantizeWeightsPass("int8")])
+    ranges = calibrate(model, [make_batch(batch)[0] for _ in range(4)])
+    int8_act = variant([QuantizeWeightsPass("int8", act_ranges=ranges)])
+    try:
+        fp8 = variant([QuantizeWeightsPass("fp8")])
+    except ValueError as e:  # jaxlib without float8_e4m3fn
+        fp8 = {"skipped": str(e)}
+
+    accuracy_ok = (int8["top1_match_rate"] >= match_gate
+                   and int8["prob_mse"] <= prob_mse_gate)
+    return {
+        "fp_infer_ms": round(fp_ms, 3),
+        "int8_weight_only": int8,
+        "int8_activations": int8_act,
+        "fp8_weight_only": fp8,
+        "quantized_speedup": round(fp_ms / max(int8["infer_ms"], 1e-9), 3),
+        "calibration_batches": 4,
+        "calibrated_layers": len(ranges),
+        "task_accuracy_fp": round(task_acc, 4),
+        "accuracy_gate": {"top1_match_min": match_gate,
+                          "prob_mse_max": prob_mse_gate,
+                          "ok": bool(accuracy_ok)},
+        "batch": holdout,
+        "model": {"n_in": n_in, "hidden": hidden, "classes": classes},
+        "note": ("the latency ratio is only meaningful on hardware with "
+                 "an int8 matmul path (TPU MXU); on CPU the row gates "
+                 "accuracy of the exact rewrite that deploys via "
+                 "ModelManager(optimize='inference:int8')"),
+    }
+
+
+def measure_int8_kv_cache(vocab: int = 32, hidden: int = 256,
+                          layers: int = 2, heads: int = 4,
+                          max_len: int = 128, batch: int = 4,
+                          prompt_len: int = 8, gen_tokens: int = 48,
+                          train_steps: int = 80,
+                          match_gate: float = 0.95,
+                          ratio_gate: float = 1.8) -> dict:
+    """int8 KV cache row (ISSUE 13 acceptance): resident-sequences ratio
+    at a fixed cache HBM budget (int8 cache + per-slot/per-head f32
+    scales vs an fp16 cache — the gate is >= 1.8x) and a greedy-stream
+    token-match-rate gate against the full-precision cache on the SAME
+    trained model (quantization must not change what the model says).
+    Tokens/sec both ways is informational (the dequant rides the decode
+    attention; the win is resident bytes, not step time)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.generate import GenerationSession
+    from deeplearning4j_tpu.model.zoo import TransformerLM
+    from deeplearning4j_tpu.train.solver import Solver
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    rng = np.random.RandomState(0)
+    model = TransformerLM(vocab_size=vocab, hidden=hidden, n_layers=layers,
+                          n_heads=heads, max_len=max_len,
+                          updater=Adam(1e-3)).init()
+    sol = Solver(model)
+    for _ in range(train_steps):
+        s = rng.randint(0, vocab, (16, 1))
+        x = (s + np.arange(12)) % vocab
+        sol.fit_batch(jnp.asarray(x, jnp.int32),
+                      jnp.asarray((x + 1) % vocab, jnp.int32))
+
+    prompts = [((rng.randint(0, vocab) + np.arange(prompt_len))
+                % vocab).tolist() for _ in range(batch)]
+    fp_sess = GenerationSession(model, max_len=max_len)
+    q_sess = GenerationSession(model, max_len=max_len, cache_dtype="int8")
+
+    def timed_generate(sess):
+        sess.generate(prompts, 4, greedy=True)  # compile
+        durations, out = [], None
+        for _ in range(REPEATS):
+            start = time.perf_counter()
+            out = sess.generate(prompts, gen_tokens, greedy=True)
+            durations.append(time.perf_counter() - start)
+        n_tokens = sum(len(r) for r in out)
+        return out, n_tokens / statistics.median(durations)
+
+    fp_tokens, fp_rate = timed_generate(fp_sess)
+    q_tokens, q_rate = timed_generate(q_sess)
+    pairs = [(a, b) for ra, rb in zip(fp_tokens, q_tokens)
+             for a, b in zip(ra, rb)]
+    match_rate = float(np.mean([a == b for a, b in pairs]))
+
+    # cache-byte accounting from the REAL carries: K/V leaves (+ scale
+    # planes on the int8 side); the fp16 equivalent is the f32 K/V bytes
+    # halved — the serving dtype this row's capacity claim is against
+    def kv_bytes(sess):
+        total = 0
+        for st in sess.decode_state(1).values():
+            for key, leaf in st.items():
+                if key.startswith("cache_"):
+                    total += leaf.size * leaf.dtype.itemsize
+        return total
+
+    fp32_bytes = kv_bytes(fp_sess)
+    int8_bytes = kv_bytes(q_sess)
+    fp16_bytes = fp32_bytes // 2
+    resident_ratio = fp16_bytes / max(int8_bytes, 1)
+    return {
+        "kv_cache_bytes_per_seq_fp32": int(fp32_bytes),
+        "kv_cache_bytes_per_seq_fp16_equiv": int(fp16_bytes),
+        "kv_cache_bytes_per_seq_int8": int(int8_bytes),
+        "resident_seqs_ratio_vs_fp16": round(resident_ratio, 3),
+        "resident_ratio_gate": {"min": ratio_gate,
+                                "ok": bool(resident_ratio >= ratio_gate)},
+        "greedy_token_match_rate": round(match_rate, 4),
+        "token_match_gate": {"min": match_gate,
+                             "ok": bool(match_rate >= match_gate)},
+        "tokens_per_sec_fp_cache": round(fp_rate, 2),
+        "tokens_per_sec_int8_cache": round(q_rate, 2),
+        "generated_tokens_compared": len(pairs),
+        "batch": batch,
+        "model": {"vocab": vocab, "hidden": hidden, "layers": layers,
+                  "heads": heads, "head_dim": hidden // heads,
+                  "max_len": max_len},
+        "note": ("per-slot scale overhead is 4 bytes per cached position "
+                 "per head, so the fp16-relative ratio is 2d/(d+4) — "
+                 ">= 1.8x needs head_dim >= 64; the dequant runs inside "
+                 "decode_attention's reference path (the resident cache "
+                 "stays int8 in HBM)"),
+    }
+
+
 def measure_engine_pool_scaling(n_requests: int = 240, threads: int = 4,
                                 replicas: int = 4, distinct_payloads: int = 8,
                                 overload_requests: int = 120) -> dict:
@@ -2122,7 +2321,58 @@ _MEASUREMENTS = {
     "speculative_decode": measure_speculative_decode,
     "engine_pool_scaling": measure_engine_pool_scaling,
     "fabric_overhead": measure_fabric_overhead,
+    "quantized_infer": measure_quantized_infer,
+    "int8_kv_cache": measure_int8_kv_cache,
 }
+
+# extras row name -> measurement name (the artifact's "extras" keys, in
+# emission order). `--rows <name,...>` selects from this table, so any
+# single row — e.g. quantized_infer_speedup in CI — runs standalone.
+_EXTRA_ROWS = {
+    "bert": "bert",
+    "bert_tf_import": "bert_import",
+    "bert_tf_import_train": "bert_import_train",
+    "lstm_char_rnn": "lstm",
+    "lenet_smoke": "lenet",
+    "calibration": "calibration",
+    "input_pipeline": "input_pipeline",
+    "input_pipeline_overlap": "input_pipeline_overlap",
+    "resnet50_e2e_fit": "resnet50_e2e_fit",
+    "rewrite_passes": "rewrite_passes",
+    "tracing_overhead": "tracing_overhead",
+    "step_profile": "step_profile",
+    "zero1_updater_headroom": "zero1_updater_headroom",
+    "generate_decode": "generate_decode",
+    "speculative_decode": "speculative_decode",
+    "engine_pool_scaling": "engine_pool_scaling",
+    "fabric_overhead": "fabric_overhead",
+    "quantized_infer_speedup": "quantized_infer",
+    "int8_kv_cache": "int8_kv_cache",
+}
+# rows that only produce meaningful numbers on the chip (skipped with a
+# note under --rows on a cpu-fallback host)
+_CHIP_ONLY_ROWS = {
+    "resnet50_b128": "resnet50_b128",
+    "bert_b64": "bert_b64",
+    "flash_attention_8k": "flash_attention_8k",
+    "moe_dispatch": "moe_dispatch",
+}
+
+
+def select_rows(spec: str) -> dict:
+    """Parse a ``--rows a,b,c`` selector against the known extras rows.
+    Returns {row_name: measurement_name} preserving the caller's order;
+    raises ValueError naming any unknown row (the CI contract: a typo'd
+    row name fails loudly instead of silently benching nothing)."""
+    known = {**_EXTRA_ROWS, **_CHIP_ONLY_ROWS}
+    names = [s.strip() for s in spec.split(",") if s.strip()]
+    if not names:
+        raise ValueError("--rows needs at least one row name")
+    unknown = [n for n in names if n not in known]
+    if unknown:
+        raise ValueError(
+            f"unknown bench row(s) {unknown}; known rows: {sorted(known)}")
+    return {n: known[n] for n in names}
 
 
 # --------------------------------------------------------------------------
@@ -2244,15 +2494,69 @@ def _child_measure(name: str, platform: str) -> None:
             # both legs ride real HTTP: keep the passes short, the 1-core
             # host serializes client + server threads anyway
             "fabric_overhead": {"n_requests": 80, "threads": 4},
+            # the accuracy gate is the point on CPU (no int8 matmul
+            # unit); keep the MLP + holdout small
+            "quantized_infer": {"hidden": 128, "train_steps": 40,
+                                "infer_iters": 8, "holdout": 256},
+            # head_dim 64 keeps the >= 1.8x fp16-relative residency gate
+            # honest; short generations fit the timeout
+            "int8_kv_cache": {"hidden": 256, "heads": 4, "layers": 2,
+                              "max_len": 64, "batch": 2,
+                              "gen_tokens": 24, "train_steps": 50},
         }.get(name, {})
     result = _MEASUREMENTS[name](**kwargs)
     print(json.dumps(result))
+
+
+def _parse_rows_arg(argv):
+    """``--rows a,b`` / ``--rows=a,b`` -> the spec string, else None."""
+    for i, a in enumerate(argv):
+        if a == "--rows":
+            if i + 1 >= len(argv):
+                raise ValueError("--rows needs a comma-separated row list")
+            return argv[i + 1]
+        if a.startswith("--rows="):
+            return a.split("=", 1)[1]
+    return None
+
+
+def _run_selected_rows(selected: dict) -> None:
+    """``--rows`` mode: probe once, run ONLY the named extras rows, print
+    one JSON line keyed by row name — the standalone-row CI entry point
+    (e.g. ``python bench.py --rows quantized_infer_speedup``)."""
+    probe = _probe_tpu()
+    fallback = not probe["ok"]
+    platform = probe.get("platform", "cpu") if probe["ok"] else "cpu"
+    rows = {}
+    for row, meas in selected.items():
+        if fallback and row in _CHIP_ONLY_ROWS:
+            rows[row] = {"skipped": "chip-only row on cpu-fallback host"}
+        else:
+            rows[row] = _run_measurement(meas, platform)
+    print(json.dumps({
+        "metric": f"bench rows: {', '.join(selected)}",
+        "platform": "cpu-fallback" if fallback else platform,
+        "rows": rows,
+    }))
 
 
 def main() -> None:
     if len(sys.argv) > 2 and sys.argv[1] == "measure":
         _child_measure(sys.argv[2], sys.argv[3] if len(sys.argv) > 3
                        else "tpu")
+        return
+    if "--list-rows" in sys.argv[1:]:
+        print(json.dumps({"rows": sorted(_EXTRA_ROWS),
+                          "chip_only_rows": sorted(_CHIP_ONLY_ROWS)}))
+        return
+    try:
+        rows_spec = _parse_rows_arg(sys.argv[1:])
+        selected = select_rows(rows_spec) if rows_spec is not None else None
+    except ValueError as e:
+        print(json.dumps({"error": str(e)}), file=sys.stderr)
+        sys.exit(2)
+    if selected is not None:
+        _run_selected_rows(selected)
         return
 
     probe = _probe_tpu()
@@ -2277,36 +2581,14 @@ def main() -> None:
         device = _run_measurement("resnet50", "cpu")
         calibration = _run_measurement("calibration", "cpu")
 
-    extras = {
-        "bert": _run_measurement("bert", platform),
-        "bert_tf_import": _run_measurement("bert_import", platform),
-        "bert_tf_import_train": _run_measurement("bert_import_train",
-                                                 platform),
-        "lstm_char_rnn": _run_measurement("lstm", platform),
-        "lenet_smoke": _run_measurement("lenet", platform),
-        "calibration": calibration,
-        "input_pipeline": _run_measurement("input_pipeline", platform),
-        "input_pipeline_overlap": _run_measurement(
-            "input_pipeline_overlap", platform),
-        "resnet50_e2e_fit": _run_measurement("resnet50_e2e_fit", platform),
-        "rewrite_passes": _run_measurement("rewrite_passes", platform),
-        "tracing_overhead": _run_measurement("tracing_overhead", platform),
-        "step_profile": _run_measurement("step_profile", platform),
-        "zero1_updater_headroom": _run_measurement(
-            "zero1_updater_headroom", platform),
-        "generate_decode": _run_measurement("generate_decode", platform),
-        "speculative_decode": _run_measurement("speculative_decode",
-                                               platform),
-        "engine_pool_scaling": _run_measurement("engine_pool_scaling",
-                                                platform),
-        "fabric_overhead": _run_measurement("fabric_overhead", platform),
-    }
+    extras = {}
+    for row, meas in _EXTRA_ROWS.items():
+        # calibration already ran (it feeds the MFU denominators)
+        extras[row] = (calibration if row == "calibration"
+                       else _run_measurement(meas, platform))
     if not fallback:  # chip-only rows
-        extras["resnet50_b128"] = _run_measurement("resnet50_b128", platform)
-        extras["bert_b64"] = _run_measurement("bert_b64", platform)
-        extras["flash_attention_8k"] = _run_measurement(
-            "flash_attention_8k", platform)
-        extras["moe_dispatch"] = _run_measurement("moe_dispatch", platform)
+        for row, meas in _CHIP_ONLY_ROWS.items():
+            extras[row] = _run_measurement(meas, platform)
 
     # input-bound vs compute-bound (VERDICT r4 ask 2): compare each host
     # pipeline mode and the e2e-from-files fit against the device step rate

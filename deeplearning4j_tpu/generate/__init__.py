@@ -21,9 +21,11 @@ from .sampling import (
     top_p,
 )
 from .session import (
+    CACHE_DTYPES,
     GenerationSession,
     SpeculativeGenerationSession,
     bucket_length,
+    quantize_decode_state,
     rewind_carry,
 )
 
@@ -40,6 +42,7 @@ def __getattr__(name):
 
 
 __all__ = [
+    "CACHE_DTYPES",
     "DecodeEngine",
     "GenerationHandle",
     "GenerationSession",
@@ -47,6 +50,7 @@ __all__ = [
     "bucket_length",
     "greedy",
     "make_sampler",
+    "quantize_decode_state",
     "rewind_carry",
     "sample_tokens",
     "speculative_accept",

@@ -51,12 +51,41 @@ def bucket_length(n: int, limit: int) -> int:
     return min(b, limit)
 
 
+CACHE_DTYPES = (None, "int8")
+
+
+def quantize_decode_state(st):
+    """Convert one layer's decode carry to the int8 KV-cache layout:
+    ``cache_k``/``cache_v`` become int8 with per-slot/per-head f32 scale
+    planes ``cache_k_scale``/``cache_v_scale`` ([b, h, L]); everything
+    else (``pos``, recurrent ``h``/``c``, input caches) keeps its dtype.
+    The attention layers' ``_cached_attention`` detects the scale keys
+    and runs the quantize-on-write / dequant-on-attend path."""
+    if "cache_k" not in st or "cache_v" not in st:
+        return st
+    out = dict(st)
+    for key in ("cache_k", "cache_v"):
+        c = st[key]
+        out[key] = jnp.zeros(c.shape, jnp.int8)
+        out[key + "_scale"] = jnp.zeros(c.shape[:-1], jnp.float32)
+    return out
+
+
 class GenerationSession:
-    def __init__(self, model, *, max_len: int = 256) -> None:
+    def __init__(self, model, *, max_len: int = 256,
+                 cache_dtype: Optional[str] = None) -> None:
         model._check_init()
         migrate = getattr(model, "migrate_state", None)
         if callable(migrate):
             migrate()
+        if cache_dtype not in CACHE_DTYPES:
+            raise ValueError(
+                f"cache_dtype must be one of {CACHE_DTYPES}, got "
+                f"{cache_dtype!r}")
+        #: "int8" stores attention K/V caches quantized (per-slot/per-head
+        #: absmax scales on the carry) — ~2× the resident sequences per
+        #: fp16 HBM budget; None keeps the model dtype (exact).
+        self.cache_dtype = cache_dtype
         self.model = model
         self.max_len = int(max_len)
         last = model.layers[-1]
@@ -76,13 +105,23 @@ class GenerationSession:
 
     # ----- carry ------------------------------------------------------
     def decode_state(self, batch: int):
-        """Fresh per-sequence decode carry for ``batch`` rows."""
+        """Fresh per-sequence decode carry for ``batch`` rows (attention
+        K/V caches quantized when ``cache_dtype="int8"``)."""
         out = {}
         for name, layer in zip(self._layer_names, self.model.layers):
             st = layer.decode_state(batch, self.max_len, self.model.dtype)
             if st:
+                if self.cache_dtype == "int8":
+                    st = quantize_decode_state(st)
                 out[name] = st
         return out
+
+    def cache_bytes(self, batch: int = 1) -> int:
+        """Resident bytes of the decode carry for ``batch`` rows — the
+        per-sequence HBM cost capacity planning divides the cache budget
+        by (and the ``dl4j_tpu_generate_kv_cache_bytes`` gauge)."""
+        leaves = jax.tree_util.tree_leaves(self.decode_state(batch))
+        return int(sum(l.size * l.dtype.itemsize for l in leaves))
 
     def bucket_sizes(self, limit: Optional[int] = None) -> List[int]:
         """Prompt-length buckets a warmup should compile (powers of two up
@@ -255,7 +294,8 @@ class GenerationSession:
 # speculative decoding
 # ---------------------------------------------------------------------------
 
-_REWINDABLE_KEYS = frozenset({"cache_k", "cache_v", "pos"})
+_REWINDABLE_KEYS = frozenset({"cache_k", "cache_v", "pos",
+                              "cache_k_scale", "cache_v_scale"})
 
 
 def _check_rewindable(session: GenerationSession, role: str) -> None:
@@ -309,11 +349,15 @@ class SpeculativeGenerationSession:
     (one propose + one verify program per speculation depth, ever)."""
 
     def __init__(self, model, draft_model, *, max_len: int = 256,
-                 k: int = 4) -> None:
+                 k: int = 4, cache_dtype: Optional[str] = None) -> None:
         if k < 1:
             raise ValueError("speculative k must be >= 1")
-        self.target = GenerationSession(model, max_len=max_len)
-        self.draft = GenerationSession(draft_model, max_len=max_len)
+        # cache_dtype applies to BOTH caches: the rewind contract holds
+        # for int8 caches too (scales are position-indexed, masked by pos)
+        self.target = GenerationSession(model, max_len=max_len,
+                                        cache_dtype=cache_dtype)
+        self.draft = GenerationSession(draft_model, max_len=max_len,
+                                       cache_dtype=cache_dtype)
         if self.draft.vocab_size != self.target.vocab_size:
             raise ValueError(
                 f"draft vocab {self.draft.vocab_size} != target vocab "

@@ -59,24 +59,67 @@ def _cache_write(cache: jax.Array, new: jax.Array, pos: jax.Array) -> jax.Array:
                          pos.astype(jnp.int32))
 
 
+def _scale_write(scales: jax.Array, new: jax.Array, pos: jax.Array) -> jax.Array:
+    """Per-slot scale counterpart of :func:`_cache_write`: write ``new``
+    [b, h, t] into the scale plane [b, h, L] at ``pos + [0, t)``."""
+    def row(c, n, p):
+        z = jnp.zeros((), p.dtype)
+        return jax.lax.dynamic_update_slice(c, n, (z, p))
+
+    return jax.vmap(row)(scales, new.astype(scales.dtype),
+                         pos.astype(jnp.int32))
+
+
+def quantize_kv_rows(x: jax.Array, eps: float = 1e-8):
+    """Symmetric per-(row, head, position) int8 quantization of a K/V
+    write [b, h, t, d]: the scale is the absmax over the head dim, so one
+    f32 scale rides each cached slot. Returns ``(q int8, scale f32
+    [b, h, t])``; dequant is ``q * scale[..., None]``."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax, eps) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def _cached_attention(q, k_new, v_new, state, mask):
     """Shared KV-cache attention step: write this call's K/V into the
     cache at each row's position, then attend causally against the cache.
     Returns (output, new_state). ``mask`` (the prompt's [b, t] validity
     mask) bounds how far ``pos`` advances, so right-padded prefill rows
     keep their true length and the pad slots are overwritten by later
-    decode steps before anything ever attends to them."""
+    decode steps before anything ever attends to them.
+
+    An int8 cache (``cache_dtype="int8"`` on the session/engine — the
+    state then carries ``cache_k_scale``/``cache_v_scale`` planes) writes
+    quantized slots with per-slot/per-head scales and dequantizes inside
+    :func:`~deeplearning4j_tpu.ops.decode_attention`'s reference path —
+    the resident cache holds ~1/2 the bytes of an fp16 cache (1/4 of
+    f32), so the same HBM budget fits ~2× the concurrent sequences."""
     from ...ops import decode_attention
 
     t = q.shape[2]
     pos = state["pos"].astype(jnp.int32)
+    valid = (jnp.asarray(t, jnp.int32) if mask is None
+             else jnp.sum(mask > 0, axis=1).astype(jnp.int32))
+    if "cache_k_scale" in state:  # int8 KV cache
+        kq, ks = quantize_kv_rows(k_new)
+        vq, vs = quantize_kv_rows(v_new)
+        cache_k = _cache_write(state["cache_k"], kq, pos)
+        cache_v = _cache_write(state["cache_v"], vq, pos)
+        k_scale = _scale_write(state["cache_k_scale"], ks, pos)
+        v_scale = _scale_write(state["cache_v_scale"], vs, pos)
+        o = decode_attention(q, cache_k, cache_v, pos,
+                             k_scale=k_scale, v_scale=v_scale)
+        new_state = {"cache_k": cache_k, "cache_v": cache_v,
+                     "cache_k_scale": k_scale, "cache_v_scale": v_scale,
+                     "pos": pos + valid}
+        return o, new_state
     cache_k = _cache_write(state["cache_k"], k_new, pos)
     cache_v = _cache_write(state["cache_v"], v_new, pos)
     # query i at absolute position pos+i attends cache [0, pos+i]; the
     # single-token hot path (t == 1) dispatches to the flash decode kernel
     o = decode_attention(q, cache_k, cache_v, pos)
-    valid = (jnp.asarray(t, jnp.int32) if mask is None
-             else jnp.sum(mask > 0, axis=1).astype(jnp.int32))
     new_state = {"cache_k": cache_k, "cache_v": cache_v, "pos": pos + valid}
     return o, new_state
 

@@ -2,19 +2,23 @@
 
 Pattern-match-and-rewrite over ``MultiLayerConfiguration`` /
 ``ComputationGraphConfiguration`` configs **plus their params**: each
-pass returns a numerically equivalent (config, params, state) triple.
-Rewrites are in-memory only — serialized artifacts always store the
-un-rewritten model.
+pass returns a numerically equivalent (config, params, state) triple —
+except the post-training quantization passes (``quantize.py``), which
+trade bounded rounding error for serving capacity and therefore deploy
+through the canary gate. Rewrites are in-memory only — serialized
+artifacts always store the un-rewritten model.
 
 Entry points: ``Solver``/``GraphSolver`` ``optimize=`` (training-safe
 set), ``ModelManager`` ``optimize=`` (inference set, applied before
-warmup on every deploy/canary), or direct ``rewrite_model``.
+warmup on every deploy/canary; ``"inference:int8"``/``"inference:fp8"``
+adds weight quantization), or direct ``rewrite_model``.
 """
 
 from .base import (
     RewritePass,
     apply_passes,
     inference_passes,
+    quantization_passes,
     resolve_passes,
     rewrite_model,
     rewrite_model_inplace,
@@ -25,14 +29,33 @@ from .passes import (
     ConvBatchNormFoldPass,
     SpaceToDepthStemPass,
 )
+from .quantize import (
+    QuantizedConvolutionLayer,
+    QuantizedDenseLayer,
+    QuantizedSelfAttentionLayer,
+    QuantizedTransformerDecoderBlockLayer,
+    QuantizeWeightsPass,
+    calibrate,
+    count_quantized_layers,
+    quantize_weight,
+)
 
 __all__ = [
     "BatchNormAffinePass",
     "ConvBatchNormFoldPass",
+    "QuantizeWeightsPass",
+    "QuantizedConvolutionLayer",
+    "QuantizedDenseLayer",
+    "QuantizedSelfAttentionLayer",
+    "QuantizedTransformerDecoderBlockLayer",
     "RewritePass",
     "SpaceToDepthStemPass",
     "apply_passes",
+    "calibrate",
+    "count_quantized_layers",
     "inference_passes",
+    "quantization_passes",
+    "quantize_weight",
     "resolve_passes",
     "rewrite_model",
     "rewrite_model_inplace",

@@ -149,18 +149,35 @@ def training_passes() -> List[RewritePass]:
     return [SpaceToDepthStemPass(), BatchNormAffinePass()]
 
 
-def inference_passes() -> List[RewritePass]:
+def inference_passes(quantize: Optional[str] = None,
+                     act_ranges=None) -> List[RewritePass]:
     """Default inference pipeline (the ``ModelManager.deploy`` set):
     stem rewrite, then conv+BN fold, then affine precompute for any BN
-    the fold could not consume."""
+    the fold could not consume. ``quantize="int8"``/``"fp8"`` appends the
+    post-training weight-quantization pass AFTER the folds (so the folded
+    conv weights are what gets quantized); ``act_ranges`` (a
+    :func:`~.quantize.calibrate` result) turns on the calibrated
+    activation-quantization variant for the named Dense layers."""
     from .passes import (
         BatchNormAffinePass,
         ConvBatchNormFoldPass,
         SpaceToDepthStemPass,
     )
 
-    return [SpaceToDepthStemPass(), ConvBatchNormFoldPass(),
-            BatchNormAffinePass()]
+    passes: List[RewritePass] = [SpaceToDepthStemPass(),
+                                 ConvBatchNormFoldPass(),
+                                 BatchNormAffinePass()]
+    if quantize is not None:
+        passes += quantization_passes(quantize, act_ranges=act_ranges)
+    return passes
+
+
+def quantization_passes(dtype: str = "int8",
+                        act_ranges=None) -> List[RewritePass]:
+    """The post-training quantization set on its own (inference-only)."""
+    from .quantize import QuantizeWeightsPass
+
+    return [QuantizeWeightsPass(dtype, act_ranges=act_ranges)]
 
 
 def resolve_passes(
@@ -171,7 +188,10 @@ def resolve_passes(
     """Normalize an ``optimize=`` argument into a pass list.
 
     ``True``/``"training"`` -> the training-safe set; ``"inference"`` ->
-    the inference set; a pass or list of passes is taken verbatim. In a
+    the inference set; ``"inference:int8"``/``"inference:fp8"`` -> the
+    inference set plus post-training weight quantization (the deploy-time
+    serving spec for quantized models — see rewrite/quantize.py);
+    a pass or list of passes is taken verbatim. In a
     ``context="training"`` resolution, inference-only passes are
     rejected — folding BN into a conv that is about to be *trained*
     silently changes semantics, so it is an error, not a warning."""
@@ -184,6 +204,8 @@ def resolve_passes(
             passes = training_passes()
         elif spec == "inference":
             passes = inference_passes()
+        elif spec.startswith("inference:"):
+            passes = inference_passes(quantize=spec.split(":", 1)[1])
         else:
             raise ValueError(
                 f"Unknown rewrite pipeline {spec!r}; expected 'training', "

@@ -1,0 +1,512 @@
+"""Post-training quantization rewrite passes: int8/fp8 weights, int8 acts.
+
+Serving capacity per chip is the scarcest fleet resource (ROADMAP north
+star); post-training quantization is the classic lever — int8 weights
+halve (vs bf16) or quarter (vs f32) the weight HBM traffic per forward
+and double effective MXU throughput where the hardware has an int8 path,
+*if accuracy holds*. Following the TensorFlow-paper pattern of serving a
+rewritten, lower-precision graph distinct from the training graph
+(PAPERS.md, arxiv 1605.08695), quantization here is an inference-only
+:class:`~.base.RewritePass`, applied in memory at deploy time by
+``ModelManager(optimize="inference:int8")`` — the ``ModelStore``
+artifact stays full-precision, so rollback is free and checkpoints never
+know quantization exists.
+
+Scheme (weight-only, the default):
+
+* per-OUTPUT-channel absmax scales — ``scale_c = max|W[..., c]| / 127``
+  (int8) or ``/ 448`` (fp8 e4m3) — computed in float64 on the host;
+* the stored weight is the quantized integer/fp8 tensor; the matmul runs
+  on it directly (small integers are exact in any float compute dtype)
+  and the **dequant is folded into the output epilogue**:
+  ``y = (x @ Wq) * scale + b`` — one fused per-channel multiply, never a
+  dequantized weight copy in HBM.
+
+Activation quantization (optional, int8 only) additionally quantizes the
+layer INPUT against a per-layer absmax range measured by
+:func:`calibrate` over representative batches; the matmul then runs
+int8×int8 with int32 accumulation (``lax.dot_general(...,
+preferred_element_type=int32)``) and the combined ``s_x · s_w`` scale
+lands in the same epilogue. The calibrated ranges are carried in the
+pass config (``QuantizeWeightsPass(act_ranges=...)``), not in the model.
+
+Unlike every other pass in this package, quantization is deliberately
+NOT numerically equivalent — it trades bounded rounding error for
+capacity. That is exactly why it deploys through the canary machinery:
+``start_canary(v, optimize="inference:int8")`` serves the quantized
+graph next to the full-precision incumbent under hash-split routing, and
+``promote_canary``/``rollback`` gate it on measured accuracy/latency
+(tools/check_quantize_contract.py). The passes DO keep the framework's
+no-op contract: a graph without Dense/Conv/attention matmuls is returned
+byte-identical (tests/test_rewrite.py property test).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..activations import Activation
+from ..conf import MultiLayerConfiguration
+from ..graph_conf import ComputationGraphConfiguration, VertexSpec
+from ..layers.attention import (
+    SelfAttentionLayer,
+    TransformerDecoderBlockLayer,
+    _cached_attention,
+    _merge_heads,
+    _split_heads,
+    dot_product_attention,
+)
+from ..layers.base import Layer, LayerContext, Params, State, apply_input_dropout
+from ..layers.conv import ConvolutionLayer, _lax_padding
+from ..layers.feedforward import DenseLayer
+from .base import PassResult, RewritePass
+
+#: int8 symmetric range and fp8 e4m3 max-normal — the scale denominators.
+_INT8_MAX = 127.0
+_FP8_MAX = 448.0
+_EPS = 1e-12
+
+_FP8_DTYPE = getattr(jnp, "float8_e4m3fn", None)
+
+QUANT_DTYPES = ("int8", "fp8")
+
+
+def _quant_storage_dtype(quant_dtype: str):
+    if quant_dtype == "int8":
+        return jnp.int8
+    if quant_dtype == "fp8":
+        if _FP8_DTYPE is None:
+            raise ValueError(
+                "fp8 weight quantization needs a jaxlib with float8_e4m3fn "
+                "support; this build has none — use dtype='int8'")
+        return _FP8_DTYPE
+    raise ValueError(f"unknown quant dtype {quant_dtype!r}; "
+                     f"expected one of {QUANT_DTYPES}")
+
+
+def quantize_weight(w, quant_dtype: str, *, channel_axis: int = -1
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Per-output-channel absmax quantization of one weight tensor.
+
+    ``channel_axis`` names the OUTPUT-channel axis (kept at full
+    granularity; every other axis is reduced into the absmax). Scale math
+    runs in float64 on the host; returns ``(Wq, scale)`` with ``Wq`` in
+    the storage dtype and ``scale`` float32 of shape ``[n_channels]``.
+    The dequant identity is ``W ≈ Wq * scale`` broadcast over
+    ``channel_axis``."""
+    storage = _quant_storage_dtype(quant_dtype)
+    w64 = np.asarray(w, np.float64)
+    axis = channel_axis % w64.ndim
+    reduce_axes = tuple(a for a in range(w64.ndim) if a != axis)
+    amax = np.max(np.abs(w64), axis=reduce_axes) if reduce_axes \
+        else np.abs(w64)
+    denom = _INT8_MAX if quant_dtype == "int8" else _FP8_MAX
+    scale = np.maximum(amax, _EPS) / denom
+    expand = tuple(None if a != axis else slice(None)
+                   for a in range(w64.ndim))
+    scaled = w64 / scale[expand]
+    if quant_dtype == "int8":
+        q = jnp.asarray(np.clip(np.rint(scaled), -127, 127), storage)
+    else:
+        q = jnp.asarray(scaled, np.float32).astype(storage)
+    return q, jnp.asarray(scale, jnp.float32)
+
+
+def _epilogue_scale(scale: jax.Array, like: jax.Array) -> jax.Array:
+    """Scale cast for the output epilogue (compute-dtype multiply)."""
+    return scale.astype(like.dtype)
+
+
+def _qmm(x: jax.Array, wq: jax.Array, scale: jax.Array) -> jax.Array:
+    """Weight-only quantized matmul: operand is the raw quantized tensor
+    (exact in float), dequant scale applied to the OUTPUT columns —
+    ``(x @ Wq) * s == x @ (Wq·s)`` because ``s`` is per output channel."""
+    y = x @ wq.astype(x.dtype)
+    return y * _epilogue_scale(scale, y)
+
+
+def _act_quantize(x: jax.Array, absmax: float) -> Tuple[jax.Array, float]:
+    """Symmetric int8 activation quantization against a CALIBRATED
+    absmax (data-independent, so the shapes/ops stay static)."""
+    s = max(float(absmax), _EPS) / _INT8_MAX
+    q = jnp.clip(jnp.round(x / s), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+# ---------------------------------------------------------------------------
+# quantized layer configs (rewrite products — inference-only, never trained
+# or serialized: the store artifact always holds the full-precision layer)
+# ---------------------------------------------------------------------------
+
+from ...core.config import register_config  # noqa: E402  (import order doc'd)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class QuantizedDenseLayer(DenseLayer):
+    """Rewrite product of :class:`QuantizeWeightsPass` over a
+    :class:`DenseLayer`. Params: ``W_q`` (int8/fp8 ``[nIn, nOut]``),
+    ``W_scale`` (f32 ``[nOut]``), plus the untouched bias. With
+    ``act_absmax`` set (calibrated activation quantization, int8 only)
+    the input is quantized too and the matmul accumulates in int32."""
+
+    quant_dtype: str = "int8"
+    act_absmax: Optional[float] = None
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return ()  # inference-only: a Solver must never touch these
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        raise RuntimeError(
+            "QuantizedDenseLayer is a rewrite product — it is created by "
+            "QuantizeWeightsPass with params transformed from the "
+            "full-precision layer, never initialized fresh")
+
+    def apply(self, params: Params, state: State, x: jax.Array,
+              ctx: LayerContext) -> Tuple[jax.Array, State]:
+        x = apply_input_dropout(self, x, ctx)
+        wq, ws = params["W_q"], params["W_scale"]
+        three_d = x.ndim == 3
+        if three_d:  # recurrent [b, f, t] -> [b·t, f] (one MXU gemm)
+            b, f, t = x.shape
+            x2 = x.transpose(0, 2, 1).reshape(b * t, f)
+        else:
+            x2 = x
+        if self.act_absmax is not None and self.quant_dtype == "int8":
+            xq, sx = _act_quantize(x2, self.act_absmax)
+            acc = jax.lax.dot_general(
+                xq, wq, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            # dequant in f32: the int32 accumulator can exceed bf16's
+            # 8 mantissa bits, so the epilogue scales before the cast
+            y = (acc.astype(jnp.float32)
+                 * (ws.astype(jnp.float32) * jnp.float32(sx))).astype(x.dtype)
+        else:
+            y = _qmm(x2, wq, ws)
+        if self.has_bias:
+            y = y + params["b"].astype(y.dtype)
+        if three_d:
+            y = y.reshape(b, t, -1).transpose(0, 2, 1)
+        act = self.activation or Activation.SIGMOID  # DenseLayer default
+        return act(y), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class QuantizedConvolutionLayer(ConvolutionLayer):
+    """Rewrite product over :class:`ConvolutionLayer`: ``W_q``
+    (``[O, I, kH, kW]`` int8/fp8) + per-out-channel ``W_scale`` ``[O]``;
+    the conv runs on the quantized kernel directly and the dequant rides
+    the bias epilogue (weight-only — conv inputs stay full precision)."""
+
+    quant_dtype: str = "int8"
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return ()
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        raise RuntimeError(
+            "QuantizedConvolutionLayer is a rewrite product — see "
+            "QuantizeWeightsPass")
+
+    def apply(self, params: Params, state: State, x: jax.Array,
+              ctx: LayerContext) -> Tuple[jax.Array, State]:
+        from ...ops import helpers
+
+        x = apply_input_dropout(self, x, ctx)
+        pad = _lax_padding(self.convolution_mode, self.padding,
+                           self.kernel_size, self.dilation)
+        y = helpers.conv2d(x, params["W_q"].astype(x.dtype), self.stride,
+                           pad, self.dilation, self._dn())
+        scale = _epilogue_scale(params["W_scale"], y)
+        if self.data_format == "NCHW":
+            y = y * scale[None, :, None, None]
+            if self.has_bias:
+                y = y + params["b"].astype(y.dtype)[None, :, None, None]
+        else:
+            y = y * scale
+            if self.has_bias:
+                y = y + params["b"].astype(y.dtype)
+        act = self.activation or Activation.IDENTITY
+        return act(y), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class QuantizedSelfAttentionLayer(SelfAttentionLayer):
+    """Rewrite product over a projecting :class:`SelfAttentionLayer`:
+    Wq/Wk/Wv/Wo each stored quantized (``<name>_q`` + ``<name>_scale``),
+    dequant in each projection's epilogue. Attention math itself stays in
+    the compute dtype; ``decode_state`` (the KV cache) is inherited."""
+
+    quant_dtype: str = "int8"
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return ()
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        raise RuntimeError(
+            "QuantizedSelfAttentionLayer is a rewrite product — see "
+            "QuantizeWeightsPass")
+
+    def apply(self, params: Params, state: State, x: jax.Array,
+              ctx: LayerContext) -> Tuple[jax.Array, State]:
+        x = apply_input_dropout(self, x, ctx)
+        xt = x.transpose(0, 2, 1)
+        q = _split_heads(_qmm(xt, params["Wq_q"], params["Wq_scale"]),
+                         self.n_heads)
+        k = _split_heads(_qmm(xt, params["Wk_q"], params["Wk_scale"]),
+                         self.n_heads)
+        v = _split_heads(_qmm(xt, params["Wv_q"], params["Wv_scale"]),
+                         self.n_heads)
+        if "cache_k" in state:
+            if not self.causal:
+                raise ValueError(
+                    "KV-cached decode requires causal=True — bidirectional "
+                    "attention cannot be decoded incrementally")
+            o, new_state = _cached_attention(q, k, v, state, ctx.mask)
+        else:
+            o = dot_product_attention(q, k, v, mask=ctx.mask,
+                                      causal=self.causal)
+            new_state = state
+        o = _qmm(_merge_heads(o), params["Wo_q"], params["Wo_scale"])
+        act = self.activation or Activation.IDENTITY
+        return act(o).transpose(0, 2, 1), new_state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class QuantizedTransformerDecoderBlockLayer(TransformerDecoderBlockLayer):
+    """Rewrite product over :class:`TransformerDecoderBlockLayer`: all six
+    matmul weights (Wq/Wk/Wv/Wo attention projections + W1/W2 FFN) stored
+    quantized with per-output-channel scales; LayerNorm params and biases
+    untouched. The KV-cache decode path (``decode_state`` /
+    ``_cached_attention``) is inherited unchanged, so a quantized LM
+    serves through :class:`~deeplearning4j_tpu.generate.session.
+    GenerationSession` exactly like its full-precision original."""
+
+    quant_dtype: str = "int8"
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return ()
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        raise RuntimeError(
+            "QuantizedTransformerDecoderBlockLayer is a rewrite product — "
+            "see QuantizeWeightsPass")
+
+    def apply(self, params: Params, state: State, x: jax.Array,
+              ctx: LayerContext) -> Tuple[jax.Array, State]:
+        x = apply_input_dropout(self, x, ctx)
+        xt = x.transpose(0, 2, 1)
+        h1 = self._ln(xt, params["ln1_g"], params["ln1_b"])
+        q = _split_heads(_qmm(h1, params["Wq_q"], params["Wq_scale"]),
+                         self.n_heads)
+        k = _split_heads(_qmm(h1, params["Wk_q"], params["Wk_scale"]),
+                         self.n_heads)
+        v = _split_heads(_qmm(h1, params["Wv_q"], params["Wv_scale"]),
+                         self.n_heads)
+        if "cache_k" in state:
+            o, new_state = _cached_attention(q, k, v, state, ctx.mask)
+        else:
+            o = dot_product_attention(q, k, v, mask=ctx.mask, causal=True)
+            new_state = state
+        r1 = xt + _qmm(_merge_heads(o), params["Wo_q"], params["Wo_scale"])
+        h2 = self._ln(r1, params["ln2_g"], params["ln2_b"])
+        act = self.activation or Activation.GELU
+        ffn = act(_qmm(h2, params["W1_q"], params["W1_scale"])
+                  + params["b1"].astype(h2.dtype))
+        ffn = _qmm(ffn, params["W2_q"], params["W2_scale"]) \
+            + params["b2"].astype(h2.dtype)
+        return (r1 + ffn).transpose(0, 2, 1), new_state
+
+
+_QUANTIZED_TYPES = (QuantizedDenseLayer, QuantizedConvolutionLayer,
+                    QuantizedSelfAttentionLayer,
+                    QuantizedTransformerDecoderBlockLayer)
+
+
+def count_quantized_layers(model) -> int:
+    """How many layers of ``model`` are quantization rewrite products
+    (the serving gauge ``dl4j_tpu_serving_quantized_live``)."""
+    conf = getattr(model, "conf", None)
+    if conf is None:
+        return 0
+    if isinstance(conf, ComputationGraphConfiguration):
+        layers = [v.layer for v in conf.vertices if v.layer is not None]
+    else:
+        layers = list(getattr(conf, "layers", ()))
+    return sum(1 for l in layers if isinstance(l, _QUANTIZED_TYPES))
+
+
+# ---------------------------------------------------------------------------
+# the pass
+# ---------------------------------------------------------------------------
+
+class QuantizeWeightsPass(RewritePass):
+    """Quantize the matmul weights of Dense / Conv / attention-projection
+    layers to ``dtype`` (``"int8"`` or ``"fp8"``), per-output-channel
+    absmax scales, dequant folded into each op's output epilogue.
+
+    ``act_ranges`` (``{layer_name: input_absmax}``, from
+    :func:`calibrate`) additionally turns on int8 activation quantization
+    for the named Dense layers — the per-layer range is carried HERE, in
+    the pass config, so the model params stay range-free.
+
+    Matching is by exact layer type (quantized products and output/loss
+    layers are never re-matched, so the pass is idempotent and the final
+    logit matmul keeps full precision). A graph with no matching layer is
+    returned byte-identical — the framework no-op contract."""
+
+    training_safe = False
+
+    def __init__(self, dtype: str = "int8",
+                 act_ranges: Optional[Mapping[str, float]] = None) -> None:
+        if dtype not in QUANT_DTYPES:
+            raise ValueError(f"unknown quant dtype {dtype!r}; expected one "
+                             f"of {QUANT_DTYPES}")
+        _quant_storage_dtype(dtype)  # fail fast on missing fp8 support
+        self.dtype = dtype
+        self.act_ranges = dict(act_ranges or {})
+        self.name = f"quantize_weights_{dtype}"
+
+    # ---- per-layer transforms ----------------------------------------
+    def _quantize_named(self, lparams: Dict[str, Any],
+                        names_axes: Sequence[Tuple[str, int]]
+                        ) -> Dict[str, Any]:
+        """Replace each ``name`` weight with ``name_q``/``name_scale``;
+        every other param entry (biases, LN) passes through."""
+        out = dict(lparams)
+        for pname, axis in names_axes:
+            w = out.pop(pname)
+            q, s = quantize_weight(w, self.dtype, channel_axis=axis)
+            out[f"{pname}_q"] = q
+            out[f"{pname}_scale"] = s
+        return out
+
+    def _rewrite_layer(self, layer: Layer, name: str,
+                       lparams: Dict[str, Any]):
+        """(new_layer, new_params) for a matching layer, else None."""
+        if type(layer) is DenseLayer and "W" in lparams:
+            act_absmax = self.act_ranges.get(name)
+            new = QuantizedDenseLayer(
+                **{f.name: getattr(layer, f.name)
+                   for f in dataclasses.fields(layer)},
+                quant_dtype=self.dtype,
+                act_absmax=(float(act_absmax)
+                            if act_absmax is not None
+                            and self.dtype == "int8" else None))
+            return new, self._quantize_named(lparams, [("W", 1)])
+        if type(layer) is ConvolutionLayer and "W" in lparams:
+            new = QuantizedConvolutionLayer(
+                **{f.name: getattr(layer, f.name)
+                   for f in dataclasses.fields(layer)},
+                quant_dtype=self.dtype)
+            return new, self._quantize_named(lparams, [("W", 0)])
+        if (type(layer) is SelfAttentionLayer and layer.project_input
+                and "Wq" in lparams):
+            new = QuantizedSelfAttentionLayer(
+                **{f.name: getattr(layer, f.name)
+                   for f in dataclasses.fields(layer)},
+                quant_dtype=self.dtype)
+            return new, self._quantize_named(
+                lparams, [("Wq", 1), ("Wk", 1), ("Wv", 1), ("Wo", 1)])
+        if type(layer) is TransformerDecoderBlockLayer and "Wq" in lparams:
+            new = QuantizedTransformerDecoderBlockLayer(
+                **{f.name: getattr(layer, f.name)
+                   for f in dataclasses.fields(layer)},
+                quant_dtype=self.dtype)
+            return new, self._quantize_named(
+                lparams, [("Wq", 1), ("Wk", 1), ("Wv", 1), ("Wo", 1),
+                          ("W1", 1), ("W2", 1)])
+        return None
+
+    # ---- sequential ---------------------------------------------------
+    def apply_sequential(self, conf: MultiLayerConfiguration,
+                         params: Params, state: State) -> PassResult:
+        new_layers: List[Layer] = []
+        new_params = dict(params)
+        changed = False
+        for i, layer in enumerate(conf.layers):
+            name = conf.layer_name(i)
+            hit = self._rewrite_layer(layer, name, params.get(name, {}))
+            if hit is None:
+                new_layers.append(layer)
+                continue
+            new_layer, lparams = hit
+            new_layers.append(new_layer)
+            new_params[name] = lparams
+            changed = True
+        if not changed:
+            return conf, params, state, False
+        new_conf = dataclasses.replace(conf, layers=tuple(new_layers))
+        return new_conf, new_params, state, True
+
+    # ---- graph --------------------------------------------------------
+    def apply_graph(self, conf: ComputationGraphConfiguration,
+                    params: Params, state: State) -> PassResult:
+        new_vertices: List[VertexSpec] = []
+        new_params = dict(params)
+        changed = False
+        for spec in conf.vertices:
+            if spec.layer is None:
+                new_vertices.append(spec)
+                continue
+            hit = self._rewrite_layer(spec.layer, spec.name,
+                                      params.get(spec.name, {}))
+            if hit is None:
+                new_vertices.append(spec)
+                continue
+            new_layer, lparams = hit
+            new_vertices.append(dataclasses.replace(spec, layer=new_layer))
+            new_params[spec.name] = lparams
+            changed = True
+        if not changed:
+            return conf, params, state, False
+        new_conf = dataclasses.replace(conf, vertices=tuple(new_vertices))
+        return new_conf, new_params, state, True
+
+
+# ---------------------------------------------------------------------------
+# calibration
+# ---------------------------------------------------------------------------
+
+def calibrate(model, batches, *, mask=None) -> Dict[str, float]:
+    """Sweep representative ``batches`` through ``model`` and record each
+    quantizable Dense layer's INPUT absmax — the per-layer ranges the
+    activation-quantization variant clips against
+    (``QuantizeWeightsPass(act_ranges=calibrate(model, batches))``).
+
+    The ranges live in the returned dict (carried in the pass config),
+    never in the model, so the same artifact can be re-calibrated per
+    deployment. Sequential models only (the graph family has no Dense
+    activation-quant variant yet)."""
+    from ..sequential import MultiLayerNetwork
+
+    if not isinstance(model, MultiLayerNetwork):
+        raise ValueError(
+            "calibrate() sweeps a MultiLayerNetwork; got "
+            f"{type(model).__name__}")
+    model._check_init()
+    from ...core.dtypes import as_input
+
+    names = model.layer_names()
+    ranges: Dict[str, float] = {}
+    for batch in batches:
+        x = as_input(batch, model.dtype, model.keeps_int_input())
+        _, _, _, acts = model.forward_pure(
+            model.params, model.state, x, train=False, rng=None, mask=mask,
+            collect=True)
+        inputs = [x] + list(acts[:-1])
+        for i, layer in enumerate(model.layers):
+            if type(layer) is not DenseLayer:
+                continue
+            amax = float(jnp.max(jnp.abs(inputs[i])))
+            ranges[names[i]] = max(ranges.get(names[i], 0.0), amax)
+    return ranges

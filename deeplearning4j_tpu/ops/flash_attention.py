@@ -802,13 +802,28 @@ def decode_attention(
     v: jax.Array,
     start_pos: jax.Array,
     scale: Optional[float] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Helper-seam dispatch for KV-cache decode attention (mirrors
     :func:`mha_attention`): the Pallas single-query kernel when "flash" is
     selected (or automatically on TPU) and the single-row query fits it,
     the builtin XLA spelling otherwise. ``set_attention_impl`` switches
     every decode step in the process, so flash-vs-reference parity checks
-    run the same model code both ways."""
+    run the same model code both ways.
+
+    ``k_scale``/``v_scale`` ([b, h, L] f32, per-slot/per-head) mark an
+    int8-quantized cache: the dequant (``cache * scale``) happens here,
+    inside the reference path, where XLA fuses it into the score/value
+    matmuls — the cache itself stays int8 in HBM (the capacity win). The
+    Pallas kernel is fp-only, so quantized caches always take the
+    reference spelling."""
+    if k_scale is not None or v_scale is not None:
+        if k_scale is not None:
+            k = k.astype(q.dtype) * k_scale[..., None].astype(q.dtype)
+        if v_scale is not None:
+            v = v.astype(q.dtype) * v_scale[..., None].astype(q.dtype)
+        return decode_attention_reference(q, k, v, start_pos, scale=scale)
     impl = _IMPL
     if impl == "auto":
         impl = "flash" if jax.default_backend() == "tpu" else "xla"

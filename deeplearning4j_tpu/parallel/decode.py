@@ -203,6 +203,7 @@ class DecodeEngine:
         adaptive: bool = False,
         target_p95_s: float = 0.05,
         adjust_interval: float = 0.5,
+        cache_dtype: Optional[str] = None,
     ) -> None:
         """``draft_model=`` turns on speculative decoding: the draft
         proposes up to ``speculative_k`` tokens per step, one tq=k+1
@@ -212,15 +213,22 @@ class DecodeEngine:
         (:class:`DecodeAIMD`): the current ``k`` and the active-slot
         target adapt against ``target_p95_s`` per-token latency, ticked
         every ``adjust_interval`` seconds on the engine loop
-        (``adjust_interval=0`` -> manual :meth:`adjust`)."""
+        (``adjust_interval=0`` -> manual :meth:`adjust`).
+        ``cache_dtype="int8"`` stores the attention KV caches quantized
+        (per-slot/per-head scales on the carry; dequant inside the decode
+        attention) — the same cache HBM budget holds ~2× the concurrent
+        sequences of an fp16 cache, at a bounded logit error the greedy
+        token-match bench row gates (``int8_kv_cache``)."""
         if draft_model is not None:
             self._spec = SpeculativeGenerationSession(
                 model, draft_model, max_len=max_len,
-                k=max(1, int(speculative_k)))
+                k=max(1, int(speculative_k)), cache_dtype=cache_dtype)
             self.session = self._spec.target
         else:
             self._spec = None
-            self.session = GenerationSession(model, max_len=max_len)
+            self.session = GenerationSession(model, max_len=max_len,
+                                             cache_dtype=cache_dtype)
+        self.cache_dtype = cache_dtype
         self.max_len = int(max_len)
         self.slots = int(slots)
         self.default_timeout = default_timeout
@@ -247,6 +255,10 @@ class DecodeEngine:
                              else self._spec.draft.decode_state(self.slots))
         self._draft_row = (None if self._spec is None
                            else self._spec.draft.decode_state(1))
+        self._kv_cache_bytes = int(sum(
+            l.size * l.dtype.itemsize for l in jax.tree_util.tree_leaves(
+                (self._carry, self._draft_carry))))
+        self._g_kv_bytes.set(self._kv_cache_bytes)
         self._active = np.zeros((self.slots,), bool)
         self._last = np.zeros((self.slots,), np.int32)
         self._steps = np.zeros((self.slots,), np.int32)
@@ -329,6 +341,11 @@ class DecodeEngine:
             "AIMD active-slot target (admission fills at most this many "
             "cache slots)", ("instance",)).labels(inst)
         self._g_slot_target.set(self._slot_target)
+        self._g_kv_bytes = reg.gauge(
+            "dl4j_tpu_generate_kv_cache_bytes",
+            "Resident bytes of the preallocated decode carry (target + "
+            "draft KV caches across all slots; int8 caches hold ~1/2 the "
+            "fp16 bytes per sequence)", ("instance",)).labels(inst)
 
     @property
     def tracer(self) -> Tracer:
@@ -835,6 +852,8 @@ class DecodeEngine:
             "slot_target": self._slot_target,
             "tokens": int(self._c_tokens.value),
             "max_len": self.max_len,
+            "cache_dtype": self.cache_dtype or str(self.session.model.dtype),
+            "kv_cache_bytes": self._kv_cache_bytes,
             "circuit_state": self._breaker.state.value,
             "draining": self._draining,
             # zero-guarded (PR-7 convention): derived ratios are None, not

@@ -877,7 +877,17 @@ class EnginePool:
                 "failovers": {n: int(c.value)
                               for n, c in self._failover_children.items()},
             }
-        if self.decode_replicas:
+        # remote replicas surface their host's speculative counters (the
+        # `/stats` `generate.speculative` section, cached by the adapter's
+        # staleness-bounded poll) — a cross-host pool's generate block
+        # aggregates them next to the local decode replicas' counters
+        remote_spec = {}
+        for e in all_replicas:
+            if getattr(e, "is_remote", False):
+                sp = (out["replicas"].get(e.name) or {}).get("speculative")
+                if sp:
+                    remote_spec[e.name] = sp
+        if self.decode_replicas or remote_spec:
             # pool-level generation view: per-replica circuits + the
             # acceptance counters aggregated across decode replicas
             # (zero-guarded ratios, PR-7 convention)
@@ -888,18 +898,26 @@ class EnginePool:
                 prop += int(sp.get("proposed") or 0)
                 acc += int(sp.get("accepted") or 0)
                 steps += int(sp.get("steps") or 0)
+            for sp in remote_spec.values():
+                prop += int(sp.get("proposed") or 0)
+                acc += int(sp.get("accepted") or 0)
+                steps += int(sp.get("steps") or 0)
             out["generate"] = {
-                "replicas": [e.name for e in self.decode_replicas],
+                "replicas": ([e.name for e in self.decode_replicas]
+                             + sorted(remote_spec)),
                 "dispatched": {e.name: dispatched.get(e.name, 0)
                                for e in self.decode_replicas},
                 "circuits": {e.name: e.circuit_state.value
                              for e in self.decode_replicas},
                 "proposed": prop,
                 "accepted": acc,
+                "steps": steps,
                 "acceptance_rate": (acc / prop) if prop else None,
                 "accepted_tokens_per_step": ((acc + steps) / steps)
                 if steps else None,
             }
+            if remote_spec:
+                out["generate"]["remote_replicas"] = sorted(remote_spec)
         if self._cache is not None:
             out["cache"] = {
                 "hits": hits,

@@ -165,6 +165,7 @@ class RemoteReplica:
         self._remote_score_at = 0.0
         self._score_refreshing = False
         self._identity: Optional[dict] = None
+        self._remote_speculative: Optional[dict] = None
         self._model_version: Optional[str] = None
         self._shutdown = False
         self._request_site = f"{REQUEST_SITE}.{self.name}"
@@ -430,20 +431,46 @@ class RemoteReplica:
             with self._lock:
                 self._score_refreshing = False
 
+    @staticmethod
+    def _extract_speculative(s: dict) -> Optional[dict]:
+        """Normalize the host's speculative-decoding counters out of a
+        ``/stats`` payload: a direct ``generator=`` host carries them
+        under ``generate.speculative``; a host fronting its own pool of
+        decode replicas under ``pool.generate``. Returns
+        ``{proposed, accepted, steps}`` or None when the host serves no
+        generation."""
+        gen = s.get("generate")
+        if isinstance(gen, dict) and isinstance(gen.get("speculative"),
+                                                dict):
+            gen = gen["speculative"]
+        else:
+            pool = s.get("pool")
+            gen = pool.get("generate") if isinstance(pool, dict) else None
+        if not isinstance(gen, dict) or "proposed" not in gen:
+            return None
+        return {"proposed": int(gen.get("proposed") or 0),
+                "accepted": int(gen.get("accepted") or 0),
+                "steps": int(gen.get("steps") or 0)}
+
     def poll_stats(self, timeout: Optional[float] = None) -> dict:
         """Synchronous ``GET /stats``: the staleness-bounded fallback for
-        the piggybacked load score, and the source of the remote identity
-        block (``name``/``uptime_seconds``/``pid``)."""
+        the piggybacked load score, the source of the remote identity
+        block (``name``/``uptime_seconds``/``pid``), and of the host's
+        speculative-decoding counters (folded into a front pool's
+        ``stats()["generate"]`` aggregation)."""
         t = timeout if timeout is not None else self.connect_timeout
         with urllib_request.urlopen(f"{self._base}/stats", timeout=t) as r:
             s = json.loads(r.read())
         qd = s.get("queue_depth")
+        spec = self._extract_speculative(s)
         with self._lock:
             if s.get("replica"):
                 self._identity = s["replica"]
             if qd is not None:
                 self._remote_score = float(qd)
                 self._remote_score_at = self._clock()
+            if spec is not None:
+                self._remote_speculative = spec
         return s
 
     # ----- health prober -------------------------------------------------
@@ -574,7 +601,10 @@ class RemoteReplica:
                              if self._identity else None)
             except Exception:
                 pass
-        return {
+        with self._lock:
+            spec = (dict(self._remote_speculative)
+                    if self._remote_speculative else None)
+        out = {
             "name": self.name,
             "endpoint": self.endpoint,
             "remote": ident,
@@ -586,6 +616,11 @@ class RemoteReplica:
             "load_score": self.load_score(),
             "probes": {o: int(c.value) for o, c in self._c_probe.items()},
         }
+        if spec is not None:
+            # the host serves generation: surface its acceptance counters
+            # so the front pool's stats()["generate"] can fold them in
+            out["speculative"] = spec
+        return out
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         end = None if timeout is None else time.monotonic() + timeout

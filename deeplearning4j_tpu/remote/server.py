@@ -351,11 +351,16 @@ class JsonModelServer:
 
             def _handle_admin(self):
                 """``POST /v1/models/<name>/deploy`` (body
-                ``{"version": N|"vN"|"latest"}``) and ``POST
-                /v1/models/<name>/rollback`` against a registered
+                ``{"version": N|"vN"|"latest", "optimize"?:
+                "inference"|"inference:int8"|"inference:fp8"|null}``) and
+                ``POST /v1/models/<name>/rollback`` against a registered
                 ModelManager — the remote end of the pool's deploy
                 fan-out (a front pool with RemoteReplicas rolls each
-                host through this route)."""
+                host through this route). ``optimize`` overrides the
+                host manager's rewrite pipeline for this deploy, so a
+                quantized rollout fans out across fabric hosts like any
+                version (each host loads the shared full-precision
+                artifact and quantizes in memory)."""
                 rest = self.path[len(_MODELS_PREFIX) + 1:]
                 mname, _, action = rest.rpartition("/")
                 mgr = outer._managers.get(mname)
@@ -371,8 +376,18 @@ class JsonModelServer:
                     return
                 try:
                     if action == "deploy":
+                        kw = {}
+                        if "optimize" in payload:
+                            opt = payload["optimize"]
+                            if opt is not None and not isinstance(opt, str):
+                                self._send(400, {
+                                    "error": "optimize must be a pipeline "
+                                             "name string or null"})
+                                return
+                            kw["optimize"] = opt
                         previous = mgr.live_version
-                        entry = mgr.deploy(payload.get("version", "latest"))
+                        entry = mgr.deploy(payload.get("version", "latest"),
+                                           **kw)
                         self._send(200, {"deployed": str(entry.version),
                                          "previous": previous})
                     else:
@@ -380,6 +395,8 @@ class JsonModelServer:
                         self._send(200, {"live": mgr.live_version})
                 except VersionNotFoundError as e:
                     self._send(404, {"error": str(e)})
+                except ValueError as e:  # unknown pipeline name: caller bug
+                    self._send(400, {"error": str(e)})
                 except Exception as e:
                     self._send(500, {"error": f"{action} failed: {e}"})
 

@@ -66,21 +66,29 @@ WARMUP_SITE = "model_manager.warmup"  # FaultInjector: per-bucket warmup fwd
 _SWAP_OUTCOMES = ("completed", "warmup_failed", "rolled_back",
                   "canary_started", "canary_promoted", "canary_stopped")
 
+#: sentinel: "use the manager's default optimize pipeline" — distinct from
+#: None, which explicitly disables rewrites for one deploy/canary
+_DEFAULT_OPTIMIZE = object()
+
 
 class SwapError(RuntimeError):
     """A deploy/rollback could not complete; the prior version is live."""
 
 
 class _Deployment:
-    """A resident version: servable + the breaker that judged it."""
+    """A resident version: servable + the breaker that judged it + the
+    rewrite pipeline it was loaded under (so a canary promotion replays
+    the canary's optimize spec, and re-deploying the same version under a
+    DIFFERENT pipeline — quantize/de-quantize — is a real swap)."""
 
-    __slots__ = ("entry", "servable", "breaker")
+    __slots__ = ("entry", "servable", "breaker", "optimize")
 
     def __init__(self, entry: Optional[ModelVersion], servable: Servable,
-                 breaker: CircuitBreaker) -> None:
+                 breaker: CircuitBreaker, optimize=None) -> None:
         self.entry = entry
         self.servable = servable
         self.breaker = breaker
+        self.optimize = optimize
 
     @property
     def version(self) -> str:
@@ -143,6 +151,14 @@ class ModelManager:
             "dl4j_tpu_serving_live_version",
             "Version id currently serving 100% (or primary) traffic",
             ("model",)).labels(model_name)
+        self._g_quant_live = self.registry.gauge(
+            "dl4j_tpu_serving_quantized_live",
+            "Quantized layers in the graph serving primary traffic (0 = "
+            "full-precision serving)", ("model",)).labels(model_name)
+        self._c_quant_family = self.registry.counter(
+            "dl4j_tpu_serving_quantized_deploys_total",
+            "Loads (deploy or canary) whose rewrite pipeline applied a "
+            "weight-quantization pass", ("model", "dtype"))
 
         self._lock = threading.RLock()
         self._probation_until = 0.0
@@ -158,7 +174,9 @@ class ModelManager:
                 entry = store.resolve(model_name, engine.model_version)
             except VersionNotFoundError:
                 pass
-            self._live = _Deployment(entry, engine._servable, engine._breaker)
+            self._live = _Deployment(entry, engine._servable,
+                                     engine._breaker,
+                                     optimize=self._optimize)
         else:
             entry = None
             if model is None:
@@ -171,9 +189,11 @@ class ModelManager:
                 model, circuit_breaker=breaker, registry=self.registry,
                 name=f"{model_name}-live", model_version=initial_version,
                 **self._engine_opts)
-            self._live = _Deployment(entry, self.engine._servable, breaker)
+            self._live = _Deployment(entry, self.engine._servable, breaker,
+                                     optimize=self._optimize)
         self._previous: Optional[_Deployment] = None
         self._set_live_gauge()
+        self._set_quantized_gauge()
 
     # ----- helpers ----------------------------------------------------
     def _inj(self):
@@ -183,26 +203,47 @@ class ModelManager:
     def tracer(self) -> Tracer:
         return self._tracer if self._tracer is not None else get_tracer()
 
-    def _load(self, version: Union[int, str]):
+    def _resolve_optimize(self, optimize):
+        return self._optimize if optimize is _DEFAULT_OPTIMIZE else optimize
+
+    def _load(self, version: Union[int, str], *,
+              optimize=_DEFAULT_OPTIMIZE):
         """Load + checksum-verify from the store, then apply the inference
         rewrite pipeline to the in-memory copy (the artifact on disk stays
         un-rewritten). Warmup — and therefore probation — always measures
-        the graph that will actually serve."""
+        the graph that will actually serve. ``optimize`` overrides the
+        manager default for this load (the per-deploy knob: e.g. canary a
+        quantized ``"inference:int8"`` build of a version against the
+        full-precision incumbent)."""
+        opt = self._resolve_optimize(optimize)
         with self.tracer.span("manager.load",
                               attrs={"model": self.model_name,
                                      "version": str(version)}):
             self._inj().fire(LOAD_SITE)
             model, entry = self.store.load(self.model_name, version)
-            if self._optimize:
+            if opt:
                 from ..nn.rewrite import rewrite_model
 
-                model, applied = rewrite_model(model, self._optimize,
+                model, applied = rewrite_model(model, opt,
                                                context="inference")
                 if applied:
                     self.registry.log_event(
                         "model_rewrite", model=self.model_name,
                         version=str(entry.version), passes=applied)
+                    for pname in applied:
+                        if pname.startswith("quantize_weights_"):
+                            self._c_quant_family.labels(
+                                self.model_name,
+                                pname.rsplit("_", 1)[-1]).inc()
         return model, entry
+
+    def _set_quantized_gauge(self) -> None:
+        from ..nn.rewrite import count_quantized_layers
+
+        model = getattr(self._live.servable, "model", None)
+        self._g_quant_live.set(
+            float(count_quantized_layers(model)) if model is not None
+            else 0.0)
 
     def _set_live_gauge(self) -> None:
         try:
@@ -253,16 +294,23 @@ class ModelManager:
     def canary_version(self) -> Optional[str]:
         return self._canary.version if self._canary else None
 
-    def deploy(self, version: Union[int, str] = LATEST) -> ModelVersion:
+    def deploy(self, version: Union[int, str] = LATEST, *,
+               optimize=_DEFAULT_OPTIMIZE) -> ModelVersion:
         """Zero-downtime hot swap to ``version``: load + verify + warm off
         the serving path, then atomically install. On warmup failure the
         prior version stays live and :class:`SwapError` is raised. The
         new version serves under a fresh circuit breaker and is on
         probation for ``probation_seconds`` — a breaker-open inside that
-        window rolls back automatically."""
+        window rolls back automatically. ``optimize`` overrides the
+        manager's rewrite pipeline for this deploy (e.g.
+        ``"inference:int8"`` serves the quantized build of the version;
+        the store artifact stays full-precision either way) — redeploying
+        the LIVE version under a different pipeline is a real swap."""
         with self._lock:
+            opt = self._resolve_optimize(optimize)
             entry = self.store.resolve(self.model_name, version)
-            if str(entry.version) == self._live.version:
+            if (str(entry.version) == self._live.version
+                    and opt == self._live.optimize):
                 return entry
             # a slow deploy must be diagnosable after the fact: the whole
             # load→warm→swap sequence is one trace, children per stage
@@ -271,7 +319,7 @@ class ModelManager:
                     attrs={"model": self.model_name,
                            "version": str(entry.version),
                            "previous": self._live.version}) as dspan:
-                model, entry = self._load(entry.version)
+                model, entry = self._load(entry.version, optimize=opt)
                 servable = self.engine.make_servable(
                     model, version=str(entry.version))
                 try:
@@ -291,11 +339,13 @@ class ModelManager:
                     self.engine.swap(servable, circuit_breaker=breaker)
                 old_breaker.remove_observer(self._on_candidate_transition)
                 self._previous = self._live
-                self._live = _Deployment(entry, servable, breaker)
+                self._live = _Deployment(entry, servable, breaker,
+                                         optimize=opt)
                 self._probation_until = self._clock() + self.probation_seconds
                 self._rolling_back = False
                 self._c_swap["completed"].inc()
                 self._set_live_gauge()
+                self._set_quantized_gauge()
                 dspan.set_attribute("outcome", "completed")
                 self.registry.log_event(
                     "model_swap", model=self.model_name,
@@ -359,6 +409,7 @@ class ModelManager:
             self._previous = None  # the bad version is not a rollback target
             self._probation_until = 0.0
             self._set_live_gauge()
+            self._set_quantized_gauge()
             self.registry.log_event(
                 "model_rollback", model=self.model_name,
                 version=good.version, rolled_back_from=bad.version)
@@ -373,22 +424,30 @@ class ModelManager:
     # ----- canary / shadow --------------------------------------------
     def start_canary(self, version: Union[int, str], *,
                      weight: float = 0.05, shadow: bool = False,
-                     workers: int = 1) -> ModelVersion:
+                     workers: int = 1,
+                     optimize=_DEFAULT_OPTIMIZE) -> ModelVersion:
         """Load + warm ``version`` on a second engine and route ``weight``
         of traffic (deterministic per request key) to it — or, with
         ``shadow=True``, mirror every request to it while responses keep
         coming from the live version. A canary breaker-open inside the
-        probation window stops the canary automatically."""
+        probation window stops the canary automatically. ``optimize``
+        overrides the rewrite pipeline for the canary only — the
+        quantization rollout path: ``start_canary(v,
+        optimize="inference:int8")`` serves the int8 build next to the
+        full-precision incumbent under the hash split, and
+        :meth:`promote_canary` replays the same pipeline on the live
+        engine (rollback stays free: the incumbent servable is resident)."""
         with self._lock:
             if self._canary is not None:
                 raise SwapError(f"{self.model_name}: canary v"
                                 f"{self._canary.version} already running")
+            opt = self._resolve_optimize(optimize)
             with self.tracer.span(
                     "manager.canary_start",
                     attrs={"model": self.model_name,
                            "version": str(version), "weight": weight,
                            "shadow": bool(shadow)}):
-                model, entry = self._load(version)
+                model, entry = self._load(version, optimize=opt)
                 breaker = self._breaker_factory()
                 opts = dict(self._engine_opts)
                 opts["workers"] = workers
@@ -405,7 +464,8 @@ class ModelManager:
                         f"{self.model_name} v{entry.version}: canary warmup "
                         f"failed: {e}") from e
             breaker.add_observer(self._on_canary_transition)
-            self._canary = _Deployment(entry, engine._servable, breaker)
+            self._canary = _Deployment(entry, engine._servable, breaker,
+                                       optimize=opt)
             self._canary_engine = engine
             self._router = ModelRouter(
                 self.engine,
@@ -450,14 +510,17 @@ class ModelManager:
 
     def promote_canary(self) -> ModelVersion:
         """The canary won: hot-swap its version onto the live engine
-        (full deploy path: warmed, fresh breaker, probation), then tear
-        the canary engine down."""
+        (full deploy path: warmed, fresh breaker, probation — under the
+        SAME rewrite pipeline the canary was judged on, so a quantized
+        canary promotes to quantized serving), then tear the canary
+        engine down."""
         with self._lock:
             if self._canary is None:
                 raise SwapError(f"{self.model_name}: no canary to promote")
             version = self._canary.entry.version
+            optimize = self._canary.optimize
             self._stop_canary_locked()
-            entry = self.deploy(version)
+            entry = self.deploy(version, optimize=optimize)
             self._c_swap["canary_promoted"].inc()
             return entry
 
@@ -521,6 +584,8 @@ class ModelManager:
 
     # ----- introspection / lifecycle ----------------------------------
     def describe(self) -> Dict:
+        from ..nn.rewrite import count_quantized_layers
+
         with self._lock:
             canary = None
             if self._canary is not None:
@@ -529,8 +594,12 @@ class ModelManager:
                     "weight": self._router.canary_weight if self._router else 0.0,
                     "shadow": bool(self._router and self._router.shadow is not None),
                     "circuit": self._canary.breaker.state.value,
+                    "quantized_layers": count_quantized_layers(
+                        getattr(self._canary.servable, "model", None)),
                 }
+            live_model = getattr(self._live.servable, "model", None)
             return {
+                "quantized_layers": count_quantized_layers(live_model),
                 "name": self.model_name,
                 "live_version": self._live.version,
                 "previous_version": self.previous_version,

@@ -1,0 +1,71 @@
+"""bench.py ``--rows`` selector smoke (ISSUE 13 satellite): a single
+extras row — e.g. ``quantized_infer_speedup`` — must be runnable
+standalone in CI, the selector must filter exactly, and a typo'd row
+name must fail loudly (exit 2) instead of silently benching nothing.
+No measurement actually runs here: the selection layer is pure."""
+
+import json
+import os
+import subprocess
+import sys
+
+_BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+
+
+def _load_bench():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("_bench_under_test",
+                                                  _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)  # imports stdlib only at module level
+    return mod
+
+
+def test_select_rows_filters_exactly():
+    bench = _load_bench()
+    sel = bench.select_rows("quantized_infer_speedup")
+    assert sel == {"quantized_infer_speedup": "quantized_infer"}
+    sel = bench.select_rows(" int8_kv_cache , lenet_smoke ")
+    assert list(sel) == ["int8_kv_cache", "lenet_smoke"]
+    assert sel["int8_kv_cache"] == "int8_kv_cache"
+    # every selectable row maps to a registered measurement
+    for row, meas in {**bench._EXTRA_ROWS, **bench._CHIP_ONLY_ROWS}.items():
+        assert meas in bench._MEASUREMENTS, (row, meas)
+
+
+def test_select_rows_rejects_unknown_and_empty():
+    import pytest
+
+    bench = _load_bench()
+    with pytest.raises(ValueError, match="bogus_row"):
+        bench.select_rows("lenet_smoke,bogus_row")
+    with pytest.raises(ValueError):
+        bench.select_rows("  ,  ")
+
+
+def test_rows_arg_parsing():
+    bench = _load_bench()
+    assert bench._parse_rows_arg(["--rows", "a,b"]) == "a,b"
+    assert bench._parse_rows_arg(["--rows=a,b"]) == "a,b"
+    assert bench._parse_rows_arg(["other"]) is None
+    import pytest
+
+    with pytest.raises(ValueError):
+        bench._parse_rows_arg(["--rows"])
+
+
+def test_cli_list_rows_and_unknown_row_exit():
+    # --list-rows answers without importing jax or probing hardware
+    out = subprocess.run([sys.executable, _BENCH, "--list-rows"],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0
+    listing = json.loads(out.stdout.strip())
+    assert "quantized_infer_speedup" in listing["rows"]
+    assert "int8_kv_cache" in listing["rows"]
+    # an unknown row fails fast (exit 2, error names the row) BEFORE any
+    # probe/measurement work
+    bad = subprocess.run([sys.executable, _BENCH, "--rows", "nope"],
+                         capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 2
+    assert "nope" in bad.stderr

@@ -469,3 +469,68 @@ def _free_port():
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def test_pool_generate_stats_fold_remote_speculative():
+    """ISSUE 13 satellite: a front pool's ``stats()["generate"]`` must
+    aggregate speculative acceptance counters from REMOTE decode hosts
+    too — the adapter surfaces the host's `/stats` `speculative` section
+    and the pool folds it next to any local decode replicas'."""
+    from deeplearning4j_tpu.model.zoo import TransformerLM
+    from deeplearning4j_tpu.parallel.decode import DecodeEngine
+
+    cfg = TransformerLM(vocab_size=16, hidden=32, n_layers=1, n_heads=2,
+                        max_len=32)
+    target = cfg.init()
+    draft = TransformerLM.draft_of(cfg, hidden=16, n_layers=1,
+                                   n_heads=2).init()
+    gen = DecodeEngine(target, draft_model=draft, speculative_k=2,
+                       max_len=32, slots=2, registry=MetricsRegistry(),
+                       name="rem-gen")
+    srv = JsonModelServer(_small_model(), port=0, workers=1,
+                          generator=gen, registry=MetricsRegistry()).start()
+    reg = MetricsRegistry()
+    rep = _replica(srv.port, "spec-host", registry=reg)
+    pool = EnginePool(engines=[rep], registry=reg, name="spec-pool")
+    try:
+        # drive speculative traffic THROUGH the remote host
+        gen.generate([1, 2, 3], max_tokens=8, greedy=True)
+        host_spec = gen.stats()["speculative"]
+        assert host_spec["proposed"] > 0
+        rep.poll_stats()  # the staleness-bounded refresh the pool rides
+        assert rep.stats()["speculative"] == {
+            "proposed": host_spec["proposed"],
+            "accepted": host_spec["accepted"],
+            "steps": host_spec["steps"]}
+        s = pool.stats()
+        assert "generate" in s, "remote speculative host must feed the block"
+        g = s["generate"]
+        assert g["remote_replicas"] == ["spec-host"]
+        assert g["replicas"] == ["spec-host"]
+        assert g["proposed"] == host_spec["proposed"]
+        assert g["accepted"] == host_spec["accepted"]
+        assert g["steps"] == host_spec["steps"]
+        assert g["acceptance_rate"] == pytest.approx(
+            host_spec["accepted"] / host_spec["proposed"])
+    finally:
+        pool.shutdown(drain=False)
+        srv.stop(drain=False)
+        gen.shutdown(drain=False)
+
+
+def test_pool_generate_stats_without_remote_generation_unchanged():
+    """A remote host that serves NO generation contributes no speculative
+    section, and a pool of such replicas emits no generate block — the
+    PR-11 local shape is untouched."""
+    srv = JsonModelServer(_small_model(), port=0, workers=1,
+                          registry=MetricsRegistry()).start()
+    reg = MetricsRegistry()
+    rep = _replica(srv.port, "plain-host", registry=reg)
+    pool = EnginePool(engines=[rep], registry=reg, name="plain-pool")
+    try:
+        rep.poll_stats()
+        assert "speculative" not in rep.stats()
+        assert "generate" not in pool.stats()
+    finally:
+        pool.shutdown(drain=False)
+        srv.stop(drain=False)

@@ -252,7 +252,8 @@ def test_canary_rollout_and_promotion(store):
         mgr.start_canary(2, weight=0.5)
         desc = mgr.describe()
         assert desc["canary"] == {"version": "2", "weight": 0.5,
-                                  "shadow": False, "circuit": "closed"}
+                                  "shadow": False, "circuit": "closed",
+                                  "quantized_layers": 0}
         served = set()
         for i in range(40):
             fut, v = mgr.submit(x, key=f"user-{i}")
@@ -342,5 +343,36 @@ def test_manager_gc_protects_resident_versions(store):
         removed = mgr.gc(keep_last=1)
         assert removed == {"m": [1, 2, 3]}
         assert [v.version for v in store.versions("m")] == [4, 5]
+    finally:
+        mgr.shutdown(drain=False)
+
+
+def test_gc_never_collects_running_canary(store):
+    """ISSUE 13 satellite regression: the manager reports its CANARY
+    version in ``in_use`` alongside live/previous, so a long-running
+    canary can never be collected mid-experiment — and the protection
+    lifts the moment the canary stops."""
+    for seed in (3, 4, 5):
+        store.publish("m", _model(seed))  # now v1..v5
+    mgr = ModelManager(store, "m", version=5, registry=MetricsRegistry(),
+                      batch_limit=4, probation_seconds=3600.0)
+    x = np.ones((1, 4), np.float32)
+    try:
+        mgr.output(x)
+        mgr.start_canary(2, weight=1.0)  # canary on an OLD version
+        assert mgr.resident_versions() == {2, 5}
+        removed = mgr.gc(keep_last=1)
+        # v2 (canary) and v5 (live + latest) survive; everything else goes
+        assert removed == {"m": [1, 3, 4]}
+        assert [v.version for v in store.versions("m")] == [2, 5]
+        # the canary still serves from its (protected) artifact
+        fut, served = mgr.submit(x, key="canary-bound")
+        fut.result(timeout=10)
+        assert served == "2"
+        # protection is tied to the canary's lifetime, not permanent
+        mgr.stop_canary()
+        assert mgr.resident_versions() == {5}
+        assert mgr.gc(keep_last=1) == {"m": [2]}
+        assert [v.version for v in store.versions("m")] == [5]
     finally:
         mgr.shutdown(drain=False)

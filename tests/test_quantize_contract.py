@@ -1,0 +1,21 @@
+"""Tier-1 wiring for tools/check_quantize_contract.py: the int8
+weight-only pass must deploy through ModelManager → start_canary →
+promote_canary end-to-end (hash-split routing inside the accuracy gate),
+the ModelStore artifact must stay byte-identical (un-rewritten), rollback
+must restore exact full-precision serving, and the remote admin deploy
+route must roll a quantized build across fabric hosts — enforced on
+every test run, not just when someone runs the tool."""
+
+import os
+import sys
+
+_TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+
+
+def test_quantize_serving_contract():
+    sys.path.insert(0, _TOOLS)
+    try:
+        import check_quantize_contract
+    finally:
+        sys.path.remove(_TOOLS)
+    assert check_quantize_contract.main(log=lambda m: None) == 0

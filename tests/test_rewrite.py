@@ -308,3 +308,64 @@ def test_manager_optimize_none_serves_original(tmp_path):
                                    np.asarray(model.output(x)), atol=1e-6)
     finally:
         mgr.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# auto-discovered no-op property (ISSUE 13 satellite): EVERY pass either
+# pipeline can emit — including passes added in the future — must be
+# byte-identical on a model without its pattern, so new passes inherit
+# the PR-5 no-op contract without hand-written cases.
+# ---------------------------------------------------------------------------
+
+def _discovered_passes():
+    """Every pass the pipelines can emit, deduped by pass name — future
+    passes land here automatically via training_passes()/
+    inference_passes() (including the quantization variants)."""
+    from deeplearning4j_tpu.nn.rewrite import (inference_passes,
+                                               training_passes)
+
+    candidates = list(training_passes()) + list(inference_passes())
+    for quant in ("int8", "fp8"):
+        try:
+            candidates += inference_passes(quantize=quant)
+        except ValueError:
+            pass  # jaxlib without fp8 support: int8 still covered
+    out = {}
+    for p in candidates:
+        out.setdefault(p.name, p)
+    return sorted(out.items())
+
+
+def _patternless_model():
+    """A model none of the discovered passes can match: LSTM stack (no
+    conv/BN/stem for the structural passes, no Dense/Conv/attention
+    matmul weights for the quantization passes; the output layer is
+    excluded from quantization by design)."""
+    from deeplearning4j_tpu.nn import InputType, LossFunction
+    from deeplearning4j_tpu.nn.layers import LSTMLayer, RnnOutputLayer
+
+    b = NeuralNetConfiguration.builder().seed(17).list()
+    b.layer(LSTMLayer(n_out=8))
+    b.layer(LSTMLayer(n_out=8))
+    b.layer(RnnOutputLayer(n_out=4, loss=LossFunction.MCXENT,
+                           activation=Activation.SOFTMAX))
+    b.set_input_type(InputType.recurrent(5, 6))
+    return MultiLayerNetwork(b.build()).init()
+
+
+def test_every_discovered_pass_is_noop_on_patternless_model():
+    from deeplearning4j_tpu.core.config import to_json
+
+    passes = _discovered_passes()
+    assert len(passes) >= 4  # 3 structural + at least int8 quantization
+    assert any(n.startswith("quantize_weights") for n, _ in passes)
+    model = _patternless_model()
+    before_json = to_json(model.conf)
+    for name, p in passes:
+        conf2, params2, state2, changed = p.apply(
+            model.conf, model.params, model.state)
+        assert not changed, f"{name} claimed a match on a patternless model"
+        assert conf2 is model.conf, f"{name} rebuilt the config object"
+        assert params2 is model.params, f"{name} rebuilt the params pytree"
+        assert state2 is model.state, f"{name} rebuilt the state pytree"
+        assert to_json(conf2) == before_json, f"{name} mutated the config"
