@@ -144,6 +144,7 @@ class ComputationGraph:
         rng: Optional[jax.Array],
         masks: Optional[Sequence[Optional[jax.Array]]] = None,
         stop_at_outputs: bool = True,
+        dist=None,
     ):
         """Topo-order forward. Returns ({vertex: activation}, new_state)."""
         params, inputs = self._to_compute(params, inputs)
@@ -158,7 +159,7 @@ class ComputationGraph:
             if spec.layer is not None:
                 x = xs[0]
                 key = jax.random.fold_in(rng, vi) if rng is not None else None
-                ctx = LayerContext(train=train, rng=key, mask=in_mask)
+                ctx = LayerContext(train=train, rng=key, mask=in_mask, dist=dist)
                 if spec.preprocessor is not None:
                     x, _ = spec.preprocessor.apply({}, {}, x, ctx)
                 lstate = dict(state.get(spec.name, {}))
@@ -185,6 +186,7 @@ class ComputationGraph:
         masks=None,
         label_masks: Optional[Sequence[Optional[jax.Array]]] = None,
         train: bool = True,
+        dist=None,
     ):
         """Weighted sum of output-layer losses + regularization."""
         # regularization runs on master (uncast) params; forward math in
@@ -211,7 +213,7 @@ class ComputationGraph:
             if spec.layer is not None:
                 x = xs[0]
                 key = jax.random.fold_in(rng, vi) if rng is not None else None
-                ctx = LayerContext(train=train, rng=key, mask=in_mask)
+                ctx = LayerContext(train=train, rng=key, mask=in_mask, dist=dist)
                 if spec.preprocessor is not None:
                     x, _ = spec.preprocessor.apply({}, {}, x, ctx)
                 lstate = dict(state.get(spec.name, {}))

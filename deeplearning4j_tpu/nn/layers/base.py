@@ -39,12 +39,34 @@ State = Dict[str, Any]
 
 
 @dataclasses.dataclass(frozen=True)
+class DistContext:
+    """Static data-parallel execution context for layers that compute
+    cross-replica statistics (distributed batch norm). Set by
+    ``DistributedTrainer`` when building its step; ``None`` everywhere
+    else (single-device Solver, inference), so layers fall back to their
+    local spelling.
+
+    ``axis`` is the named data-mesh axis when the forward runs inside
+    ``shard_map`` (the explicit strategy path — collectives like
+    ``lax.psum`` may bind it); ``None`` on the implicit GSPMD path,
+    where the batch array is GLOBAL and group statistics are spelled as
+    a sharding-friendly reshape instead. ``n_shards`` is the data-axis
+    width either way, and ``bn_group_size`` the trainer-level default
+    statistics group size (overridable per layer)."""
+
+    axis: Optional[str] = None
+    n_shards: int = 1
+    bn_group_size: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class LayerContext:
     """Per-call dynamic context threaded through layer application."""
 
     train: bool = False
     rng: Optional[jax.Array] = None  # dropout/noise key (None in inference)
     mask: Optional[jax.Array] = None  # sequence mask [batch, time] where applicable
+    dist: Optional[DistContext] = None  # data-parallel context (trainer only)
 
 
 @register_config
@@ -149,7 +171,8 @@ def apply_layer(layer, lparams, lstate, x, ctx, *, remat: bool = False):
         return layer.apply(lparams, lstate, x, ctx)
 
     def fn(p, s, xx, key, mask):
-        c = LayerContext(train=ctx.train, rng=key, mask=mask)
+        # dist is static config (axis name / group sizes), safe to close over
+        c = LayerContext(train=ctx.train, rng=key, mask=mask, dist=ctx.dist)
         return layer.apply(p, s, xx, c)
 
     return jax.checkpoint(fn)(lparams, lstate, x, ctx.rng, ctx.mask)

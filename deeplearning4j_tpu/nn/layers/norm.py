@@ -9,7 +9,7 @@ into neighbours, no helper needed).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +42,15 @@ class BatchNormalizationLayer(Layer):
     # 4-op subtract/rsqrt/scale/shift chain — same math to float tolerance,
     # but XLA fuses the single FMA into the neighbouring op's epilogue.
     fused: bool = False
+    # Distributed batch norm (MLPerf TPU-pods paper, arxiv 1909.09756):
+    # training batch statistics are averaged over groups of this many
+    # adjacent data-parallel replicas instead of whichever batch slice one
+    # replica sees — the per-chip batch shrinks as DP widens and
+    # per-replica moments degrade. None inherits the trainer's
+    # bn_group_size= default (and stays fully local outside a
+    # DistributedTrainer). Running-stat state keeps its [n_out] shape, so
+    # checkpoints are group-size independent.
+    stats_axis_group: Optional[int] = None
 
     def with_input(self, input_type: InputType) -> "BatchNormalizationLayer":
         if self.n_out:
@@ -75,6 +84,25 @@ class BatchNormalizationLayer(Layer):
             "var": jnp.ones((self.n_out,), dtype),
         }
 
+    def _stats_group(self, ctx: LayerContext) -> Optional[int]:
+        """Resolved statistics group size (replicas per group), or None
+        for the classic local spelling. Layer field wins over the
+        trainer's ``bn_group_size=`` default; validated against the data
+        axis at trace time."""
+        dist = ctx.dist
+        if dist is None:
+            return None
+        g = (self.stats_axis_group if self.stats_axis_group is not None
+             else dist.bn_group_size)
+        if g is None:
+            return None
+        g = int(g)
+        if g < 1 or dist.n_shards % g:
+            raise ValueError(
+                f"BatchNormalization stats_axis_group={g} must divide the "
+                f"data axis ({dist.n_shards} shards)")
+        return g
+
     def apply(self, params: Params, state: State, x: jax.Array, ctx: LayerContext) -> Tuple[jax.Array, State]:
         # reduce over all axes except the feature axis (1)
         axes = (0,) + tuple(range(2, x.ndim))
@@ -84,9 +112,36 @@ class BatchNormalizationLayer(Layer):
         # bits (running state arrives in the master dtype and stays there)
         stat_dtype = jnp.promote_types(x.dtype, jnp.float32)
         x32 = x.astype(stat_dtype)
+        group = self._stats_group(ctx) if ctx.train else None
+        if ctx.train and group is not None and ctx.dist.axis is None:
+            # GSPMD path: x is the GLOBAL batch; one group = the rows of
+            # `group` adjacent replicas (the batch-dim sharding places row
+            # blocks on replicas in order), spelled as a reshape so XLA
+            # keeps each group's moments on its own devices
+            return self._apply_grouped_global(params, state, x, x32,
+                                              stat_dtype, group, ctx)
         if ctx.train:
-            mean = jnp.mean(x32, axis=axes)
-            var = jnp.var(x32, axis=axes)
+            if group is not None:
+                # explicit (shard_map) path: x is this replica's shard —
+                # group moments are slice-local sums psummed over the
+                # replica groups of the data axis
+                dist = ctx.dist
+                groups = [list(range(i, i + group))
+                          for i in range(0, dist.n_shards, group)]
+                s1 = jnp.sum(x32, axis=axes)
+                s2 = jnp.sum(jnp.square(x32), axis=axes)
+                tot = jax.lax.psum(jnp.stack([s1, s2]), dist.axis,
+                                   axis_index_groups=groups)
+                denom = float(x32.size // self.n_out) * group
+                mean = tot[0] / denom
+                var = jnp.maximum(tot[1] / denom - jnp.square(mean), 0.0)
+            else:
+                mean = jnp.mean(x32, axis=axes)
+                var = jnp.var(x32, axis=axes)
+            # grouped: each replica folds ITS group's moments into the
+            # running stats; the trainer's cross-replica state average
+            # then yields the across-group mean (same value the GSPMD
+            # spelling writes directly)
             new_state = {
                 "mean": self.decay * state["mean"] + (1.0 - self.decay) * mean.astype(state["mean"].dtype),
                 "var": self.decay * state["var"] + (1.0 - self.decay) * var.astype(state["var"].dtype),
@@ -109,6 +164,48 @@ class BatchNormalizationLayer(Layer):
                         + params["beta"].astype(stat_dtype).reshape(bshape))
         act = self.activation or Activation.IDENTITY
         return act(xhat).astype(x.dtype), new_state
+
+    def _apply_grouped_global(self, params: Params, state: State,
+                              x: jax.Array, x32: jax.Array, stat_dtype,
+                              group: int, ctx: LayerContext) -> Tuple[jax.Array, State]:
+        """Grouped statistics over a GLOBAL batch array (the implicit
+        GSPMD trainer path): reshape [B, ...] -> [G, B/G, ...] so each
+        group of ``group`` adjacent replicas normalizes with its own
+        moments (same moments as the explicit path's grouped psum — the
+        batch-dim sharding lays contiguous row blocks out in replica
+        order). Running stats take the across-group mean, which is what
+        the explicit path's per-replica update + trainer state average
+        converges to, so both paths write identical state."""
+        dist = ctx.dist
+        n_groups = dist.n_shards // group
+        b = x32.shape[0]
+        if b % max(n_groups, 1):
+            raise ValueError(
+                f"global batch {b} not divisible into {n_groups} "
+                f"batch-norm statistics groups")
+        xg = x32.reshape((n_groups, b // n_groups) + x32.shape[1:])
+        axes_g = (1,) + tuple(range(3, xg.ndim))
+        mean_g = jnp.mean(xg, axis=axes_g)  # [G, C]
+        var_g = jnp.maximum(
+            jnp.mean(jnp.square(xg), axis=axes_g) - jnp.square(mean_g), 0.0)
+        gshape = (n_groups, 1, self.n_out) + (1,) * (xg.ndim - 3)
+        # per-group affine form: gamma/beta fold into scale/shift like the
+        # fused spelling (same math to float tolerance as the 4-op chain)
+        rstd_g = jax.lax.rsqrt(var_g + self.eps)
+        if self.lock_gamma_beta:
+            scale_g, shift_g = rstd_g, -mean_g * rstd_g
+        else:
+            scale_g = params["gamma"].astype(stat_dtype)[None, :] * rstd_g
+            shift_g = params["beta"].astype(stat_dtype)[None, :] - mean_g * scale_g
+        yg = xg * scale_g.reshape(gshape) + shift_g.reshape(gshape)
+        new_state = {
+            "mean": self.decay * state["mean"]
+            + (1.0 - self.decay) * jnp.mean(mean_g, axis=0).astype(state["mean"].dtype),
+            "var": self.decay * state["var"]
+            + (1.0 - self.decay) * jnp.mean(var_g, axis=0).astype(state["var"].dtype),
+        }
+        act = self.activation or Activation.IDENTITY
+        return act(yg.reshape(x32.shape)).astype(x.dtype), new_state
 
 
 @register_config

@@ -168,6 +168,7 @@ class MultiLayerNetwork:
         rnn_state: Optional[Dict[str, Dict[str, jax.Array]]] = None,
         upto: Optional[int] = None,
         collect: bool = False,
+        dist=None,
     ):
         """Pure forward through layers [0, upto). Returns
         (out, new_state, new_rnn_state, activations?)."""
@@ -186,7 +187,7 @@ class MultiLayerNetwork:
             if rnn_state is not None and name in rnn_state:
                 lstate.update(rnn_state[name])
             key = jax.random.fold_in(rng, i) if rng is not None else None
-            ctx = LayerContext(train=train, rng=key, mask=cur_mask)
+            ctx = LayerContext(train=train, rng=key, mask=cur_mask, dist=dist)
             y, lstate_out = _apply_layer(
                 layer, params.get(name, {}), lstate, x, ctx,
                 remat=self.conf.gradient_checkpointing and train)
@@ -217,6 +218,7 @@ class MultiLayerNetwork:
         label_mask: Optional[jax.Array] = None,
         rnn_state=None,
         train: bool = True,
+        dist=None,
     ):
         """Score = loss + regularization (reference: computeGradientAndScore).
         Returns (score, (new_state, new_rnn_state))."""
@@ -229,7 +231,7 @@ class MultiLayerNetwork:
         params, x = self._to_compute(params, x)
         feat, new_state, new_rnn = self.forward_pure(
             params, state, x, train=train, rng=rng, mask=mask,
-            rnn_state=rnn_state, upto=len(self.layers) - 1,
+            rnn_state=rnn_state, upto=len(self.layers) - 1, dist=dist,
         )
         # mask as transformed by the stack for the output layer
         cur_mask = mask

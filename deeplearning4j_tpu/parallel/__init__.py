@@ -27,6 +27,7 @@ from .sharded_embedding import ShardedEmbeddingTable, shard_rows
 from .mesh import (MeshSpec, initialize_distributed, make_mesh,
                    zero1_partition_spec)
 from .strategies import (
+    BucketedAllReduceSync,
     GradientSyncStrategy,
     ParameterAveragingSync,
     SyncAllReduce,
@@ -43,6 +44,7 @@ from .pool import AdaptiveBatcher, EnginePool, PoolServable, ResponseCache
 
 __all__ = [
     "AdaptiveBatcher",
+    "BucketedAllReduceSync",
     "DecodeAIMD",
     "DecodeEngine",
     "EnginePool",

@@ -20,10 +20,18 @@ gradients, and ``jax.lax.p*`` collectives see the named mesh axis.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+import re
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+def _natural_key(s: str):
+    """layer_10 sorts after layer_9 (the model's depth order), not after
+    layer_1 — bucket packing follows true layer order."""
+    return [int(t) if t.isdigit() else t for t in re.split(r"(\d+)", s)]
 
 
 class GradientSyncStrategy:
@@ -74,6 +82,115 @@ class SyncAllReduce(GradientSyncStrategy):
 
     def sync(self, grads, state, axis):  # pragma: no cover - implicit path skips this
         return jax.tree_util.tree_map(lambda g: jax.lax.pmean(g, axis), grads), state
+
+
+class BucketedAllReduceSync(GradientSyncStrategy):
+    """Backward-overlapped gradient exchange: the gradient tree is packed
+    into fixed-byte buckets in REVERSE layer order (output layer first —
+    the order grads become available during backprop) and each bucket is
+    psummed as its own collective.
+
+    Why this helps (MLPerf TPU-pods paper, arxiv 1909.09756 §"gradient
+    summation"): one tree-wide fused all-reduce cannot start until the
+    LAST gradient (the input stem's) exists, so the interconnect idles for
+    the whole backward pass. Per-bucket collectives each depend only on
+    their own layers' grads, so the scheduler (XLA async collectives on
+    TPU) starts exchanging the output-side buckets while the input-side
+    backward is still computing — on a DCN-path mesh the exchange hides
+    almost entirely. On the implicit GSPMD path XLA already derives and
+    schedules its own collectives from the shardings; this strategy is
+    the EXPLICIT-path spelling (hand-written per-bucket psum inside
+    ``shard_map``), numerically identical to :class:`SyncAllReduce`
+    (psum of a concatenation == concatenation of psums), so the
+    trajectory gate is exact equality, and it composes with ``zero1=True``
+    (synced grads agree on every replica).
+
+    ``bucket_bytes`` trades overlap granularity against per-collective
+    latency: small buckets overlap more but pay more collective launches;
+    a leaf larger than the budget gets a bucket of its own (leaves are
+    never split). ``compression_stats()`` reports the realized layout —
+    bucket count and per-bucket byte volume — for DCN provisioning and
+    the bench row.
+
+    The bucket layout is sized from the param template at ``init_state``
+    and held on the instance — use one strategy instance per trainer
+    (sharing one across differently-shaped models would leave the layout
+    of whichever initialized last).
+    """
+
+    explicit = True
+    replicated_grads = True
+
+    def __init__(self, bucket_bytes: int = 4 << 20) -> None:
+        if bucket_bytes <= 0:
+            raise ValueError(f"bucket_bytes must be > 0, got {bucket_bytes}")
+        self.bucket_bytes = int(bucket_bytes)
+        # [(dtype, [(layer, param, shape, size), ...]), ...] — host-side
+        # layout computed once from the param template in init_state
+        self._buckets: Optional[List[Tuple[Any, List[Tuple[str, str, Tuple[int, ...], int]]]]] = None
+
+    def init_state(self, params):
+        buckets: List[Tuple[Any, List[Tuple[str, str, Tuple[int, ...], int]]]] = []
+        fill: Dict[Any, int] = {}
+        open_bucket: Dict[Any, List[Tuple[str, str, Tuple[int, ...], int]]] = {}
+        for ln in sorted(params, key=_natural_key, reverse=True):
+            for pn in sorted(params[ln], key=_natural_key):
+                leaf = params[ln][pn]
+                shape = tuple(np.shape(leaf))
+                size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+                dt = jnp.asarray(leaf).dtype if not hasattr(leaf, "dtype") \
+                    else jnp.dtype(leaf.dtype)
+                nbytes = size * dt.itemsize
+                cur = open_bucket.get(dt)
+                if cur is not None and fill[dt] + nbytes > self.bucket_bytes:
+                    buckets.append((dt, cur))
+                    cur = None
+                if cur is None:
+                    cur = []
+                    open_bucket[dt] = cur
+                    fill[dt] = 0
+                cur.append((ln, pn, shape, size))
+                fill[dt] += nbytes
+        for dt, cur in open_bucket.items():
+            if cur:
+                buckets.append((dt, cur))
+        self._buckets = buckets
+        return ()
+
+    def sync(self, grads, state, axis):
+        if self._buckets is None:
+            self.init_state(grads)
+        out: Dict[str, Dict[str, jax.Array]] = {ln: {} for ln in grads}
+        for dt, bucket in self._buckets:
+            if len(bucket) == 1:
+                ln, pn, shape, _ = bucket[0]
+                out[ln][pn] = jax.lax.pmean(grads[ln][pn], axis)
+                continue
+            flat = jnp.concatenate(
+                [grads[ln][pn].reshape(-1) for ln, pn, _, _ in bucket])
+            summed = jax.lax.pmean(flat, axis)
+            off = 0
+            for ln, pn, shape, size in bucket:
+                out[ln][pn] = summed[off:off + size].reshape(shape)
+                off += size
+        return out, state
+
+    def compression_stats(self, state):
+        """Not compression — the realized bucket layout: how many
+        collectives the exchange issues and the byte volume each one
+        carries (``None`` before ``init_state`` sized the layout)."""
+        if self._buckets is None:
+            return None
+        volumes = [
+            sum(size for _, _, _, size in bucket) * dt.itemsize
+            for dt, bucket in self._buckets
+        ]
+        return {
+            "buckets": len(self._buckets),
+            "bucket_bytes_target": self.bucket_bytes,
+            "bucket_volume_bytes": volumes,
+            "total_exchanged_bytes": int(sum(volumes)),
+        }
 
 
 class ThresholdCompressedSync(GradientSyncStrategy):

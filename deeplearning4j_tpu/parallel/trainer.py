@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.dtypes import as_input, as_input_np
+from ..nn.layers.base import DistContext
 from ..train.solver import LayerOptimizers, _normalize_gradients
 from .mesh import make_mesh, shmap, zero1_partition_spec
 from .strategies import GradientSyncStrategy, SyncAllReduce
@@ -88,12 +89,25 @@ class DistributedTrainer:
         param slice if every replica's update agrees. Leaves whose dim 0
         the data axis does not divide, and layers whose updater is not
         elementwise (``IUpdater.elementwise``), stay replicated.
+    bn_group_size: distributed batch norm — every
+        :class:`~deeplearning4j_tpu.nn.layers.BatchNormalizationLayer`
+        without its own ``stats_axis_group`` averages its training batch
+        statistics over groups of this many adjacent data-parallel
+        replicas (must divide the data axis). The per-chip batch shrinks
+        as DP widens and per-replica moments degrade (MLPerf TPU-pods
+        paper); a group of 2-8 replicas restores the effective
+        normalization batch without paying a full-axis collective.
+        ``None`` keeps each path's historical spelling (explicit: local
+        stats; implicit GSPMD: global-batch stats).
     registry: metrics registry (default: process-global) for the
         ``dl4j_tpu_training_updater_state_bytes{sharded=}`` gauge and —
         for compressed strategies — the
-        ``dl4j_tpu_training_grad_compression_ratio`` histogram.
-    metrics_every: record the compression ratio every N iterations
-        (reading it fetches the measured-density scalar from device;
+        ``dl4j_tpu_training_grad_compression_ratio`` histogram, plus the
+        ``dl4j_tpu_training_trust_ratio{layer=}`` /
+        ``dl4j_tpu_training_grad_norm{layer=}`` series when the updater
+        is trust-ratio based (Lars/Lamb).
+    metrics_every: record the compression ratio / trust-ratio series
+        every N iterations (reading them fetches device scalars;
         0 disables the per-step recording entirely).
     """
 
@@ -106,6 +120,7 @@ class DistributedTrainer:
         data_axis: str = "data",
         donate_inputs: bool = False,
         zero1: bool = False,
+        bn_group_size: Optional[int] = None,
         registry=None,
         metrics_every: int = 1,
     ) -> None:
@@ -121,6 +136,13 @@ class DistributedTrainer:
         self.zero1 = bool(zero1)
         if data_axis not in self.mesh.axis_names:
             raise ValueError(f"mesh has no {data_axis!r} axis: {self.mesh.axis_names}")
+        self.bn_group_size = None if bn_group_size is None else int(bn_group_size)
+        if self.bn_group_size is not None and (
+                self.bn_group_size < 1
+                or self.n_data_shards % self.bn_group_size):
+            raise ValueError(
+                f"bn_group_size {self.bn_group_size} must divide the data "
+                f"axis ({self.n_data_shards} shards)")
         if param_sharding_rules and self.strategy.explicit:
             raise ValueError(
                 "param_sharding_rules (tensor parallelism) requires the default "
@@ -281,9 +303,10 @@ class DistributedTrainer:
 
         is_graph = self._is_graph
 
-        def local_grads(params, state, x, y, rng):
+        def local_grads(params, state, x, y, rng, dist):
             def loss_fn(p):
-                return model.loss_pure(p, state, x, y, rng=rng, train=True)
+                return model.loss_pure(p, state, x, y, rng=rng, train=True,
+                                       dist=dist)
 
             if is_graph:  # graph aux is new_state directly
                 (score, new_state), grads = jax.value_and_grad(
@@ -313,8 +336,12 @@ class DistributedTrainer:
                     for ln, lp in model.params.items()
                 }
 
+            dist = DistContext(axis=None, n_shards=self.n_data_shards,
+                               bn_group_size=self.bn_group_size)
+
             def step(params, opt_state, state, strat_state, x, y, rng, it):
-                score, new_state, grads = local_grads(params, state, x, y, rng)
+                score, new_state, grads = local_grads(
+                    params, state, x, y, rng, dist)
                 grads = _normalize_gradients(
                     grads, conf.gradient_normalization, conf.gradient_normalization_threshold
                 )
@@ -352,10 +379,20 @@ class DistributedTrainer:
         # updated param slices — the hand-spelled ZeRO-1 schedule.
         n = self.n_data_shards
         flags = self._zero1_flags if self.zero1 else None
+        if flags is not None:
+            # trust-ratio updaters (Lars/Lamb) must compute their layer
+            # norms as slice-local sums + psum when applied to 1/N
+            # slices; the zero1-spelled chains share state trees with
+            # self.optim, so init/checkpoints stay compatible
+            optim = LayerOptimizers(model, zero1_axis=axis,
+                                    zero1_sliced=flags)
+        dist = DistContext(axis=axis, n_shards=n,
+                           bn_group_size=self.bn_group_size)
 
         def shard_step(params, opt_state, state, strat_state, x, y, rng, it):
             rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
-            score, new_state, grads = local_grads(params, state, x, y, rng)
+            score, new_state, grads = local_grads(
+                params, state, x, y, rng, dist)
             grads, new_strat = strategy.sync(grads, strat_state, axis)
             grads = _normalize_gradients(
                 grads, conf.gradient_normalization, conf.gradient_normalization_threshold
@@ -710,15 +747,82 @@ class DistributedTrainer:
                 buckets=(1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
                          1000.0, 10000.0),
             ).labels(type(self.strategy).__name__)
+        self._trust_gauge = self._gnorm_gauge = None
+        if self._has_trust_state():
+            self._trust_gauge = self.registry.gauge(
+                "dl4j_tpu_training_trust_ratio",
+                "Last recorded LARS/LAMB layer-wise trust ratio "
+                "(||w||/||update||) per parameter tensor",
+                labelnames=("layer",))
+            self._gnorm_gauge = self.registry.gauge(
+                "dl4j_tpu_training_grad_norm",
+                "Last recorded per-parameter-tensor update norm (the "
+                "trust-ratio denominator: grad/adam direction + decoupled "
+                "weight decay)", labelnames=("layer",))
+
+    def _has_trust_state(self) -> bool:
+        """Structure-only probe: does any layer's updater state carry the
+        trust-ratio scalars (Lars/Lamb)? No device fetch."""
+        found = [False]
+
+        def walk(node):
+            if found[0]:
+                return
+            if isinstance(node, dict):
+                if "trust" in node and isinstance(node["trust"], dict):
+                    found[0] = True
+                    return
+                for v in node.values():
+                    walk(v)
+            elif isinstance(node, (list, tuple)):
+                for v in node:
+                    walk(v)
+
+        walk(self.opt_state)
+        return found[0]
+
+    def trust_ratio_stats(self) -> dict:
+        """Per-parameter-tensor trust ratio and update norm from a
+        trust-ratio updater's state (Lars/Lamb):
+        ``{"layer/param": {"trust_ratio": float, "update_norm": float}}``.
+        Empty for other updaters. Reads device scalars — a blocking
+        fetch, so call it off the hot loop (``metrics_every`` paces the
+        automatic recording)."""
+        out = {}
+
+        def walk(node, lname):
+            if isinstance(node, dict):
+                if "trust" in node and isinstance(node["trust"], dict):
+                    for pn, v in node["trust"].items():
+                        entry = {"trust_ratio": float(np.asarray(v))}
+                        gn = node.get("gnorm", {})
+                        if pn in gn:
+                            entry["update_norm"] = float(np.asarray(gn[pn]))
+                        out[f"{lname}/{pn}"] = entry
+                    return
+                for v in node.values():
+                    walk(v, lname)
+            elif isinstance(node, (list, tuple)):
+                for v in node:
+                    walk(v, lname)
+
+        for lname, lstate in (self.opt_state or {}).items():
+            walk(lstate, lname)
+        return out
 
     def _record_compression(self) -> None:
-        if (self._comp_hist is None or self.metrics_every <= 0
-                or self.iteration % self.metrics_every):
+        if self.metrics_every <= 0 or self.iteration % self.metrics_every:
             return
-        stats = self.compression_stats() or {}
-        ratio = stats.get("compression_ratio")
-        if ratio:
-            self._comp_hist.observe(float(ratio))
+        if self._comp_hist is not None:
+            stats = self.compression_stats() or {}
+            ratio = stats.get("compression_ratio")
+            if ratio:
+                self._comp_hist.observe(float(ratio))
+        if self._trust_gauge is not None:
+            for label, entry in self.trust_ratio_stats().items():
+                self._trust_gauge.labels(label).set(entry["trust_ratio"])
+                if "update_norm" in entry:
+                    self._gnorm_gauge.labels(label).set(entry["update_norm"])
 
     def updater_state_bytes(self, *, per_replica: bool = True) -> int:
         """Bytes of updater (optimizer) state — per replica (the HBM that
@@ -752,6 +856,7 @@ class DistributedTrainer:
             "data_shards": self.n_data_shards,
             "strategy": type(self.strategy).__name__,
             "zero1": self.zero1,
+            "bn_group_size": self.bn_group_size,
             "updater_state_bytes": self.updater_state_bytes(),
             "updater_state_bytes_global": self.updater_state_bytes(
                 per_replica=False),

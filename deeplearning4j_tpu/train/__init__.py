@@ -13,6 +13,7 @@ from .schedules import (
     ScheduleType,
     SigmoidSchedule,
     StepSchedule,
+    WarmupSchedule,
 )
 from .fault_tolerance import (HeartbeatListener, Watchdog,
                               elastic_fit, read_heartbeat)
@@ -25,11 +26,14 @@ from .updaters import (
     Adam,
     AdamW,
     IUpdater,
+    Lamb,
+    Lars,
     Nadam,
     Nesterovs,
     NoOp,
     RmsProp,
     Sgd,
+    registered_updaters,
 )
 
 from .orbax_checkpoint import OrbaxCheckpointer  # orbax itself is lazy

@@ -176,3 +176,26 @@ class RampSchedule(ISchedule):
         base = self.underlying.value_at(iteration, epoch) if self.underlying else 1.0
         warm = jnp.minimum((iteration + 1) / self.num_iterations, 1.0)
         return base * warm
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class WarmupSchedule(ISchedule):
+    """Linear LR warmup from 0 over ``warmup_iterations`` steps, then the
+    base schedule unmodified — the large-batch LARS/LAMB recipe's first
+    ingredient (the trust ratio is undefined-noisy while the moments are
+    cold, so the first steps must be small). ``base`` may be any
+    ISchedule or a plain float; composes like every other schedule and
+    JSON round-trips (nested configs serialize polymorphically)."""
+
+    base: Optional[ISchedule] = None
+    warmup_iterations: int = 100
+    base_value: float = 1.0  # used when ``base`` is None (flat warmup target)
+
+    def value_at(self, iteration, epoch):
+        v = (self.base.value_at(iteration, epoch)
+             if self.base is not None else self.base_value)
+        if self.warmup_iterations <= 0:
+            return v
+        warm = jnp.clip((iteration + 1.0) / self.warmup_iterations, 0.0, 1.0)
+        return v * warm

@@ -72,9 +72,18 @@ def _normalize_gradients(
 
 
 class LayerOptimizers:
-    """Per-layer optax chains (reference: UpdaterBlock boundaries)."""
+    """Per-layer optax chains (reference: UpdaterBlock boundaries).
 
-    def __init__(self, model) -> None:
+    ``zero1_axis``/``zero1_sliced`` select the explicit-path ZeRO-1
+    spelling of each layer's transformation
+    (:meth:`~deeplearning4j_tpu.train.updaters.IUpdater.to_optax_zero1`):
+    updaters whose math includes cross-element reductions (LARS/LAMB
+    trust-ratio norms) re-spell them as slice-local + psum over the data
+    axis, so applying the chain to 1/N parameter slices stays exactly the
+    replicated update. State trees are identical either way."""
+
+    def __init__(self, model, *, zero1_axis: Optional[str] = None,
+                 zero1_sliced: Optional[Dict[str, Dict[str, bool]]] = None) -> None:
         conf = model.conf
         self.txs: Dict[str, optax.GradientTransformation] = {}
         # per-layer: is the whole update chain elementwise per tensor
@@ -90,6 +99,7 @@ class LayerOptimizers:
                 continue
             updater = updater_from_any(layer.updater) if layer.updater is not None else global_updater
             self.elementwise[name] = bool(getattr(updater, "elementwise", False))
+            sliced = (zero1_sliced or {}).get(name)
             parts = []
             wd = layer.weight_decay
             if wd:
@@ -100,7 +110,10 @@ class LayerOptimizers:
                         {k: (k in weight_names) for k in layer.trainable_param_names()},
                     )
                 )
-            parts.append(updater.to_optax())
+            if zero1_axis is not None and sliced and any(sliced.values()):
+                parts.append(updater.to_optax_zero1(zero1_axis, sliced))
+            else:
+                parts.append(updater.to_optax())
             self.txs[name] = optax.chain(*parts) if len(parts) > 1 else parts[0]
 
     def init(self, params) -> Dict[str, Any]:

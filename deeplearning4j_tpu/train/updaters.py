@@ -16,8 +16,10 @@ Learning rates accept either a float or an ISchedule (train/schedules.py).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Union
+from typing import Any, Callable, Dict, Optional, Tuple, Union
 
+import jax
+import jax.numpy as jnp
 import optax
 
 from ..core.config import register_config
@@ -32,6 +34,147 @@ def _lr_fn(lr: LR):
         # the trainer level (iteration-based inside jit).
         return lambda count: lr.value_at(count, 0)
     return float(lr)
+
+
+def _lr_at(lr_fn, count):
+    return lr_fn(count) if callable(lr_fn) else lr_fn
+
+
+# ---- layer-wise trust-ratio machinery (LARS/LAMB) -------------------------
+
+NormFn = Callable[[Any, jax.Array], jax.Array]
+
+
+def _leaf_name(path) -> str:
+    """Last key of a tree_map_with_path path (the param name inside a
+    layer's {pname: array} dict)."""
+    k = path[-1]
+    return getattr(k, "key", str(k))
+
+
+def make_norm_fn(axis: Optional[str] = None,
+                 sliced: Optional[Dict[str, bool]] = None) -> NormFn:
+    """Squared-norm reducer for trust-ratio updaters.
+
+    Default (``axis=None``): identity — the leaf's local squared sum IS
+    the global squared norm (replicated or GSPMD-global arrays).
+
+    ZeRO-1 explicit path: each replica holds a 1/N slice of the leaves in
+    ``sliced``, so the global norm is the slice-local squared sum psummed
+    over the data axis. Norms are the ONLY cross-element coupling in
+    LARS/LAMB and they reduce, so this spelling keeps the 1/N-slice
+    update exactly the replicated update (``IUpdater.elementwise`` stays
+    honest). Non-sliced leaves (non-divisible dim 0) are full-size on
+    every replica — psumming them would count them N times.
+    """
+    if axis is None:
+        return lambda path, s: s
+    flags = dict(sliced or {})
+
+    def fn(path, s):
+        if flags.get(_leaf_name(path), False):
+            return jax.lax.psum(s, axis)
+        return s
+
+    return fn
+
+
+def _sq_sum(a: jax.Array) -> jax.Array:
+    return jnp.sum(jnp.square(a.astype(jnp.float32)))
+
+
+def _trust_ratio(wn: jax.Array, un: jax.Array, coeff: float,
+                 eps: float) -> jax.Array:
+    """phi(||w||)/||u|| with the standard guards: a zero-norm param (fresh
+    bias) or a zero update falls back to ratio 1 (plain step)."""
+    return jnp.where((wn > 0.0) & (un > 0.0), coeff * wn / (un + eps), 1.0)
+
+
+def _lars_tx(lr: LR, momentum: float, weight_decay: float,
+             trust_coefficient: float, eps: float,
+             norm_fn: Optional[NormFn] = None) -> optax.GradientTransformation:
+    lr_fn = _lr_fn(lr)
+    norm_fn = norm_fn or make_norm_fn()
+
+    def init_fn(params):
+        return {
+            "count": jnp.zeros((), jnp.int32),
+            "trace": jax.tree_util.tree_map(jnp.zeros_like, params),
+            "trust": jax.tree_util.tree_map(
+                lambda p: jnp.zeros((), jnp.float32), params),
+            "gnorm": jax.tree_util.tree_map(
+                lambda p: jnp.zeros((), jnp.float32), params),
+        }
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("Lars requires params")
+        count = state["count"] + 1
+        lr_t = _lr_at(lr_fn, count - 1)
+
+        def one(path, g, m, p):
+            u = g + weight_decay * p if weight_decay else g
+            wn = jnp.sqrt(norm_fn(path, _sq_sum(p)))
+            un = jnp.sqrt(norm_fn(path, _sq_sum(u)))
+            trust = _trust_ratio(wn, un, trust_coefficient, eps)
+            new_m = momentum * m + (trust * u.astype(jnp.float32)).astype(m.dtype)
+            return (-lr_t * new_m).astype(g.dtype), new_m, trust, un
+
+        mapped = jax.tree_util.tree_map_with_path(
+            one, updates, state["trace"], params)
+        outer = jax.tree_util.tree_structure(updates)
+        upd, trace, trust, gnorm = jax.tree_util.tree_transpose(
+            outer, jax.tree_util.tree_structure((0, 0, 0, 0)), mapped)
+        return upd, {"count": count, "trace": trace, "trust": trust,
+                     "gnorm": gnorm}
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def _lamb_tx(lr: LR, b1: float, b2: float, eps: float, weight_decay: float,
+             trust_coefficient: float,
+             norm_fn: Optional[NormFn] = None) -> optax.GradientTransformation:
+    lr_fn = _lr_fn(lr)
+    norm_fn = norm_fn or make_norm_fn()
+
+    def init_fn(params):
+        return {
+            "count": jnp.zeros((), jnp.int32),
+            "mu": jax.tree_util.tree_map(jnp.zeros_like, params),
+            "nu": jax.tree_util.tree_map(jnp.zeros_like, params),
+            "trust": jax.tree_util.tree_map(
+                lambda p: jnp.zeros((), jnp.float32), params),
+            "gnorm": jax.tree_util.tree_map(
+                lambda p: jnp.zeros((), jnp.float32), params),
+        }
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("Lamb requires params")
+        count = state["count"] + 1
+        lr_t = _lr_at(lr_fn, count - 1)
+        c1 = 1.0 - b1 ** count.astype(jnp.float32)
+        c2 = 1.0 - b2 ** count.astype(jnp.float32)
+
+        def one(path, g, m, v, p):
+            new_m = b1 * m + (1.0 - b1) * g
+            new_v = b2 * v + (1.0 - b2) * jnp.square(g)
+            adam = (new_m / c1) / (jnp.sqrt(new_v / c2) + eps)
+            u = adam + weight_decay * p if weight_decay else adam
+            wn = jnp.sqrt(norm_fn(path, _sq_sum(p)))
+            un = jnp.sqrt(norm_fn(path, _sq_sum(u)))
+            trust = _trust_ratio(wn, un, trust_coefficient, 0.0)
+            return (-lr_t * trust * u).astype(g.dtype), new_m, new_v, trust, un
+
+        mapped = jax.tree_util.tree_map_with_path(
+            one, updates, state["mu"], state["nu"], params)
+        outer = jax.tree_util.tree_structure(updates)
+        upd, mu, nu, trust, gnorm = jax.tree_util.tree_transpose(
+            outer, jax.tree_util.tree_structure((0, 0, 0, 0, 0)), mapped)
+        return upd, {"count": count, "mu": mu, "nu": nu, "trust": trust,
+                     "gnorm": gnorm}
+
+    return optax.GradientTransformation(init_fn, update_fn)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -54,11 +197,27 @@ class IUpdater:
         This is the contract ZeRO-1 weight-update sharding relies on:
         an elementwise update applied to each replica's 1/N slice of
         (grads, params, state) followed by an all-gather of the param
-        slices is exactly the replicated update. An updater whose state
-        couples elements across the tensor (e.g. a factored second
+        slices is exactly the replicated update. An updater whose only
+        cross-element coupling is a REDUCTION (the LARS/LAMB layer
+        norms) may keep ``True`` *if* it re-spells that reduction as
+        slice-local + psum via :meth:`to_optax_zero1`; an updater whose
+        state couples elements non-reducibly (e.g. a factored second
         moment) must override this to ``False`` — the trainer then keeps
         that layer's updater state replicated."""
         return True
+
+    def to_optax_zero1(self, axis: str,
+                       sliced: Dict[str, bool]) -> optax.GradientTransformation:
+        """The transformation as applied to per-replica 1/N parameter
+        slices on the explicit (shard_map) ZeRO-1 path. ``sliced`` maps
+        param name -> whether that leaf arrives sliced over ``axis``.
+        Fully elementwise updaters need no collectives — the default
+        returns :meth:`to_optax` unchanged. Trust-ratio updaters
+        (Lars/Lamb) override this to psum their slice-local squared
+        norms (see :func:`make_norm_fn`); state trees are identical in
+        both spellings, so checkpoints and the replicated-path init stay
+        compatible."""
+        return self.to_optax()
 
     def state_partition_spec(self, param_shape, n_shards: int, axis: str = "data",
                              base=None):
@@ -177,11 +336,17 @@ class AdaGrad(IUpdater):
 @register_config
 @dataclasses.dataclass(frozen=True)
 class AdaDelta(IUpdater):
+    # AdaDelta is self-scaling; the reference exposes no LR and applies
+    # the raw delta (lr == 1). Surfaced by the auto-discovered updater
+    # sweep: optax.adadelta's learning_rate defaults to None, which
+    # crashes at update time.
+    learning_rate: LR = 1.0
     rho: float = 0.95
     epsilon: float = 1e-6
 
     def to_optax(self) -> optax.GradientTransformation:
-        return optax.adadelta(rho=self.rho, eps=self.epsilon)
+        return optax.adadelta(_lr_fn(self.learning_rate), rho=self.rho,
+                              eps=self.epsilon)
 
 
 @register_config
@@ -195,6 +360,71 @@ class AdaMax(IUpdater):
     def to_optax(self) -> optax.GradientTransformation:
         return optax.adamax(_lr_fn(self.learning_rate), b1=self.beta1, b2=self.beta2,
                             eps=self.epsilon)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class Lars(IUpdater):
+    """Layer-wise Adaptive Rate Scaling (You et al. 2017) — the
+    large-batch SGD recipe from the MLPerf TPU-pods paper (PAPERS.md,
+    arxiv 1909.09756): each parameter tensor's LR is scaled by the trust
+    ratio ``trust_coefficient * ||w|| / ||g + wd*w||`` before the
+    momentum accumulation, so layers whose gradients are large relative
+    to their weights (the instability source at huge global batch) take
+    proportionally smaller steps. Pair with
+    :class:`~deeplearning4j_tpu.train.schedules.WarmupSchedule` —
+    large-batch recipes need LR warmup.
+
+    State per leaf: momentum ``trace`` (param-shaped, ZeRO-1-shardable)
+    plus ``trust``/``gnorm`` scalars (last step's trust ratio and update
+    norm — the ``dl4j_tpu_training_trust_ratio{layer=}`` feed)."""
+
+    learning_rate: LR = 1e-1
+    momentum: float = 0.9
+    weight_decay: float = 0.0
+    trust_coefficient: float = 1e-3
+    epsilon: float = 1e-9
+
+    def to_optax(self) -> optax.GradientTransformation:
+        return _lars_tx(self.learning_rate, self.momentum, self.weight_decay,
+                        self.trust_coefficient, self.epsilon)
+
+    def to_optax_zero1(self, axis, sliced) -> optax.GradientTransformation:
+        return _lars_tx(self.learning_rate, self.momentum, self.weight_decay,
+                        self.trust_coefficient, self.epsilon,
+                        norm_fn=make_norm_fn(axis, sliced))
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class Lamb(IUpdater):
+    """Layer-wise adaptive Adam (LAMB, You et al. 2019) — LARS's trust
+    ratio applied to the bias-corrected Adam direction plus decoupled
+    weight decay: ``update = -lr * (||w||/||adam + wd*w||) * (adam +
+    wd*w)``. The large-batch updater for attention/BERT-family models
+    where plain Adam stops converging past ~8x the tuned global batch.
+
+    Same state layout notes as :class:`Lars` (``mu``/``nu`` moments are
+    ZeRO-1-shardable; ``trust``/``gnorm`` scalars feed the trust-ratio
+    metric series)."""
+
+    learning_rate: LR = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-6
+    weight_decay: float = 0.0
+    trust_coefficient: float = 1.0
+
+    def to_optax(self) -> optax.GradientTransformation:
+        return _lamb_tx(self.learning_rate, self.beta1, self.beta2,
+                        self.epsilon, self.weight_decay,
+                        self.trust_coefficient)
+
+    def to_optax_zero1(self, axis, sliced) -> optax.GradientTransformation:
+        return _lamb_tx(self.learning_rate, self.beta1, self.beta2,
+                        self.epsilon, self.weight_decay,
+                        self.trust_coefficient,
+                        norm_fn=make_norm_fn(axis, sliced))
 
 
 @register_config
@@ -216,3 +446,17 @@ def updater_from_any(u: Any) -> IUpdater:
     if u is None:
         return Sgd()
     raise TypeError(f"Not an updater: {u!r}")
+
+
+def registered_updaters() -> Tuple[type, ...]:
+    """Every ``@register_config``'d IUpdater subclass, sorted by name —
+    the discovery feed for per-updater contract tests (a future updater
+    automatically inherits e.g. the zero1==replicated trajectory
+    check)."""
+    from ..core.config import _CONFIG_REGISTRY
+
+    return tuple(sorted(
+        (c for c in _CONFIG_REGISTRY.values()
+         if isinstance(c, type) and issubclass(c, IUpdater)
+         and c is not IUpdater),
+        key=lambda c: c.__name__))

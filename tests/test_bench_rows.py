@@ -29,6 +29,9 @@ def test_select_rows_filters_exactly():
     sel = bench.select_rows(" int8_kv_cache , lenet_smoke ")
     assert list(sel) == ["int8_kv_cache", "lenet_smoke"]
     assert sel["int8_kv_cache"] == "int8_kv_cache"
+    # ISSUE 14: the large-batch row is a standalone CI entry point
+    sel = bench.select_rows("large_batch_scaling")
+    assert sel == {"large_batch_scaling": "large_batch_scaling"}
     # every selectable row maps to a registered measurement
     for row, meas in {**bench._EXTRA_ROWS, **bench._CHIP_ONLY_ROWS}.items():
         assert meas in bench._MEASUREMENTS, (row, meas)
@@ -63,6 +66,7 @@ def test_cli_list_rows_and_unknown_row_exit():
     listing = json.loads(out.stdout.strip())
     assert "quantized_infer_speedup" in listing["rows"]
     assert "int8_kv_cache" in listing["rows"]
+    assert "large_batch_scaling" in listing["rows"]
     # an unknown row fails fast (exit 2, error names the row) BEFORE any
     # probe/measurement work
     bad = subprocess.run([sys.executable, _BENCH, "--rows", "nope"],

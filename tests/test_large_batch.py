@@ -1,0 +1,467 @@
+"""Pod-scale large-batch training stack (ISSUE 14): LARS/LAMB trust-ratio
+updaters, distributed batch norm, and bucketed backward-overlapped gradient
+exchange — unit + equivalence coverage on the 8-virtual-device CPU mesh.
+
+The three MLPerf-0.6 TPU-pods walls (PAPERS.md, arxiv 1909.09756) and the
+contracts enforced here:
+
+* plain SGD/Adam stops converging at huge global batch → Lars/Lamb with
+  the layer-wise trust ratio; their norms are the only cross-element
+  coupling, spelled slice-local + psum under ZeRO-1 (zero1==replicated is
+  auto-discovered per updater in tests/test_zero1.py).
+* per-replica BN statistics degrade as the per-chip batch shrinks →
+  ``BatchNormalizationLayer(stats_axis_group=)`` /
+  ``DistributedTrainer(bn_group_size=)`` — grouped moments agree between
+  the explicit (psum over replica groups) and implicit (sharded reshape)
+  spellings, and running-stat state keeps its shape.
+* serial gradient exchange idles the DCN during backprop →
+  ``BucketedAllReduceSync`` — per-bucket psums in reverse layer order,
+  trajectory EXACTLY the unbucketed all-reduce.
+
+Plus the ISSUE 14 audit: ``GradientNormalization`` CLIP/RENORM per-layer
+norms must act on POST-SYNC global gradients on both trainer paths.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.core.config import from_json, to_json
+from deeplearning4j_tpu.nn import (
+    Activation,
+    GradientNormalization,
+    InputType,
+    LossFunction,
+    MultiLayerNetwork,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.layers import (
+    BatchNormalizationLayer,
+    DenseLayer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.layers.base import DistContext, LayerContext
+from deeplearning4j_tpu.obs import MetricsRegistry
+from deeplearning4j_tpu.parallel import (
+    BucketedAllReduceSync,
+    DistributedTrainer,
+    TopKCompressedSync,
+    make_mesh,
+)
+from deeplearning4j_tpu.train import (
+    Adam,
+    ExponentialSchedule,
+    Lamb,
+    Lars,
+    Sgd,
+    WarmupSchedule,
+)
+
+
+def _mlp(seed=7, updater=None, bn=False, bn_group=None, grad_norm=None,
+         nin=16, hidden=64, nout=8):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater(updater or Adam(0.01)))
+    if grad_norm is not None:
+        b = b.gradient_normalization(grad_norm)
+        b = b.gradient_normalization_threshold(0.5)
+    b = b.list().layer(DenseLayer(n_out=hidden, activation=Activation.TANH))
+    if bn:
+        b = b.layer(BatchNormalizationLayer(stats_axis_group=bn_group))
+    conf = (b.layer(OutputLayer(n_out=nout, loss=LossFunction.MCXENT))
+            .set_input_type(InputType.feed_forward(nin)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64, seed=0, nin=16, nout=8):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, nin).astype(np.float32)
+    y = np.eye(nout, dtype=np.float32)[rng.randint(0, nout, n)]
+    return x, y
+
+
+def _params_close(a, b, rtol=3e-5, atol=3e-6):
+    for ln in a:
+        for pn in a[ln]:
+            np.testing.assert_allclose(
+                np.asarray(a[ln][pn]), np.asarray(b[ln][pn]),
+                rtol=rtol, atol=atol, err_msg=f"{ln}/{pn}")
+
+
+# ---------------------------------------------------------------- updaters
+class TestTrustRatioUpdaters:
+    def test_lamb_trains_and_exposes_trust(self):
+        x, y = _data()
+        t = DistributedTrainer(_mlp(3, Lamb(0.02)), mesh=make_mesh(data=8))
+        first = float(t.fit_batch(x, y))
+        for _ in range(30):
+            last = float(t.fit_batch(x, y))
+        assert last < first
+        stats = t.trust_ratio_stats()
+        assert "layer_0/W" in stats and "layer_1/b" in stats
+        for entry in stats.values():
+            assert entry["trust_ratio"] > 0.0
+            assert entry["update_norm"] >= 0.0
+
+    def test_lars_trains(self):
+        x, y = _data()
+        t = DistributedTrainer(_mlp(5, Lars(0.5, trust_coefficient=1e-2)),
+                               mesh=make_mesh(data=8))
+        first = float(t.fit_batch(x, y))
+        for _ in range(30):
+            last = float(t.fit_batch(x, y))
+        assert last < first
+
+    def test_trust_ratio_zero_norm_falls_back_to_one(self):
+        """A zero-initialized param (bias) must take a plain (ratio-1)
+        step, not a 0/0 one."""
+        import jax.numpy as jnp
+
+        tx = Lamb(0.01).to_optax()
+        params = {"b": jnp.zeros((4,))}
+        st = tx.init(params)
+        upd, st = tx.update({"b": jnp.full((4,), 0.5)}, st, params)
+        assert np.all(np.isfinite(np.asarray(upd["b"])))
+        assert float(st["trust"]["b"]) == pytest.approx(1.0)
+
+    def test_zero1_explicit_path_psum_norms(self):
+        """The hand-spelled shard_map ZeRO-1 schedule with a trust-ratio
+        updater: slice-local + psum'd norms keep the 1/N-slice update
+        exactly the replicated one (losses AND params), under the
+        bucketed exchange too."""
+        x, y = _data()
+        mesh = make_mesh(data=8)
+        for updater in (Lamb(0.01), Lars(0.1)):
+            t_rep = DistributedTrainer(_mlp(5, updater), mesh=mesh,
+                                       strategy=BucketedAllReduceSync())
+            t_z = DistributedTrainer(_mlp(5, updater), mesh=mesh,
+                                     strategy=BucketedAllReduceSync(),
+                                     zero1=True)
+            for _ in range(5):
+                s_rep = float(t_rep.fit_batch(x, y))
+                s_z = float(t_z.fit_batch(x, y))
+            assert np.isclose(s_rep, s_z, rtol=1e-5), (updater, s_rep, s_z)
+            t_rep.sync_to_model()
+            t_z.sync_to_model()
+            _params_close(t_rep.model.params, t_z.model.params)
+
+    def test_trust_metrics_land_in_registry(self):
+        x, y = _data()
+        reg = MetricsRegistry()
+        t = DistributedTrainer(_mlp(3, Lamb(0.01)), mesh=make_mesh(data=8),
+                               registry=reg, metrics_every=2)
+        for _ in range(4):
+            t.fit_batch(x, y)
+        g = reg.get("dl4j_tpu_training_trust_ratio")
+        assert g is not None and g.labels("layer_0/W").value > 0
+        gn = reg.get("dl4j_tpu_training_grad_norm")
+        assert gn is not None and gn.labels("layer_0/W").value > 0
+
+    def test_non_trust_updater_has_no_trust_series(self):
+        x, y = _data()
+        reg = MetricsRegistry()
+        t = DistributedTrainer(_mlp(3, Adam(0.01)), mesh=make_mesh(data=8),
+                               registry=reg)
+        t.fit_batch(x, y)
+        assert t.trust_ratio_stats() == {}
+        assert reg.get("dl4j_tpu_training_trust_ratio") is None
+
+    def test_updater_json_round_trip(self):
+        for u in (Lars(0.1, momentum=0.8, weight_decay=1e-4),
+                  Lamb(0.01, weight_decay=0.01, trust_coefficient=0.9)):
+            assert from_json(to_json(u)) == u
+
+
+# ---------------------------------------------------------------- schedule
+class TestWarmupSchedule:
+    def test_linear_warmup_then_base(self):
+        s = WarmupSchedule(base=None, warmup_iterations=10, base_value=2.0)
+        assert float(s(0)) == pytest.approx(0.2)
+        assert float(s(4)) == pytest.approx(1.0)
+        assert float(s(9)) == pytest.approx(2.0)
+        assert float(s(100)) == pytest.approx(2.0)
+
+    def test_composes_with_any_base(self):
+        base = ExponentialSchedule(initial_value=1.0, gamma=0.5)
+        s = WarmupSchedule(base=base, warmup_iterations=2)
+        # warmup factor 0.5 at it=0, then the base value unmodified
+        assert float(s(0)) == pytest.approx(0.5 * float(base(0)))
+        assert float(s(3)) == pytest.approx(float(base(3)))
+
+    def test_zero_warmup_is_identity(self):
+        s = WarmupSchedule(base=None, warmup_iterations=0, base_value=3.0)
+        assert float(s(0)) == pytest.approx(3.0)
+
+    def test_json_round_trip_nested(self):
+        s = WarmupSchedule(base=ExponentialSchedule(initial_value=0.01),
+                           warmup_iterations=50)
+        s2 = from_json(to_json(s))
+        assert s2 == s
+        assert float(s2(25)) == pytest.approx(float(s(25)))
+
+    def test_drives_an_updater_inside_jit(self):
+        x, y = _data()
+        sched = WarmupSchedule(warmup_iterations=3, base_value=0.02)
+        t = DistributedTrainer(_mlp(3, Lamb(sched)), mesh=make_mesh(data=8),
+                               zero1=True)
+        scores = [float(t.fit_batch(x, y)) for _ in range(5)]
+        assert all(np.isfinite(s) for s in scores)
+
+
+# ------------------------------------------------------- distributed BN
+class TestDistributedBatchNorm:
+    def test_explicit_matches_implicit_grouped(self):
+        """Grouped moments agree between the two spellings: psum over
+        replica groups (shard_map) vs the sharded reshape (GSPMD) —
+        trajectory AND running stats."""
+        x, y = _data()
+        mesh = make_mesh(data=8)
+        t_imp = DistributedTrainer(_mlp(9, bn=True), mesh=mesh,
+                                   bn_group_size=2)
+        t_exp = DistributedTrainer(_mlp(9, bn=True), mesh=mesh,
+                                   bn_group_size=2,
+                                   strategy=BucketedAllReduceSync())
+        for _ in range(4):
+            s_i = float(t_imp.fit_batch(x, y))
+            s_e = float(t_exp.fit_batch(x, y))
+        assert np.isclose(s_i, s_e, rtol=1e-4), (s_i, s_e)
+        t_imp.sync_to_model()
+        t_exp.sync_to_model()
+        for k in ("mean", "var"):
+            np.testing.assert_allclose(
+                np.asarray(t_imp.model.state["layer_1"][k]),
+                np.asarray(t_exp.model.state["layer_1"][k]),
+                rtol=1e-4, atol=1e-6, err_msg=k)
+
+    def test_full_axis_group_equals_global_stats(self):
+        """group == data axis width: the explicit path's grouped stats
+        ARE the global batch stats — i.e. the implicit path's historical
+        (ungrouped) spelling."""
+        x, y = _data()
+        mesh = make_mesh(data=8)
+        t_global = DistributedTrainer(_mlp(9, bn=True), mesh=mesh)  # implicit
+        t_exp = DistributedTrainer(_mlp(9, bn=True), mesh=mesh,
+                                   bn_group_size=8,
+                                   strategy=BucketedAllReduceSync())
+        for _ in range(3):
+            s_g = float(t_global.fit_batch(x, y))
+            s_e = float(t_exp.fit_batch(x, y))
+        assert np.isclose(s_g, s_e, rtol=1e-4), (s_g, s_e)
+
+    def test_cnn_4d_activations_grouped(self):
+        """Per-channel grouped moments on [b, c, h, w] conv activations:
+        both spellings reduce rows+spatial per group and agree."""
+        from deeplearning4j_tpu.nn.layers import ConvolutionLayer
+
+        conf = (NeuralNetConfiguration.builder().seed(3)
+                .updater(Lamb(0.01)).list()
+                .layer(ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                        stride=(1, 1)))
+                .layer(BatchNormalizationLayer())
+                .layer(OutputLayer(n_out=4, loss=LossFunction.MCXENT))
+                .set_input_type(InputType.convolutional(8, 8, 1)).build())
+
+        def build():
+            return MultiLayerNetwork(conf).init()
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(32, 1, 8, 8).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 32)]
+        mesh = make_mesh(data=8)
+        t_i = DistributedTrainer(build(), mesh=mesh, bn_group_size=4)
+        t_e = DistributedTrainer(build(), mesh=mesh, bn_group_size=4,
+                                 strategy=BucketedAllReduceSync())
+        for _ in range(3):
+            s_i = float(t_i.fit_batch(x, y))
+            s_e = float(t_e.fit_batch(x, y))
+        assert np.isclose(s_i, s_e, rtol=1e-4), (s_i, s_e)
+
+    def test_group_size_changes_training_statistics(self):
+        """bn_group_size=1 (per-replica stats) vs the global batch: the
+        moments genuinely differ, so the trajectories must diverge —
+        grouping is not a no-op."""
+        x, y = _data()
+        mesh = make_mesh(data=8)
+        t_local = DistributedTrainer(_mlp(9, bn=True), mesh=mesh,
+                                     bn_group_size=1)
+        t_global = DistributedTrainer(_mlp(9, bn=True), mesh=mesh)
+        for _ in range(3):
+            s_l = float(t_local.fit_batch(x, y))
+            s_g = float(t_global.fit_batch(x, y))
+        assert not np.isclose(s_l, s_g, rtol=1e-6), (s_l, s_g)
+
+    def test_layer_field_overrides_trainer_default(self):
+        x, y = _data()
+        mesh = make_mesh(data=8)
+        # layer pins group 4; trainer default 2 must not apply to it
+        t_a = DistributedTrainer(_mlp(9, bn=True, bn_group=4), mesh=mesh,
+                                 bn_group_size=2)
+        t_b = DistributedTrainer(_mlp(9, bn=True, bn_group=4), mesh=mesh,
+                                 bn_group_size=4)
+        for _ in range(3):
+            s_a = float(t_a.fit_batch(x, y))
+            s_b = float(t_b.fit_batch(x, y))
+        assert np.isclose(s_a, s_b, rtol=1e-6), (s_a, s_b)
+
+    def test_state_shape_and_checkpoint_compat(self):
+        """Running-stat state keeps its [n_out] shape under grouping —
+        group-size independent, so checkpoints stay compatible."""
+        x, y = _data()
+        t = DistributedTrainer(_mlp(9, bn=True), mesh=make_mesh(data=8),
+                               bn_group_size=4)
+        t.fit_batch(x, y)
+        t.sync_to_model()
+        st = t.model.state["layer_1"]
+        assert np.shape(st["mean"]) == (64,)
+        assert np.shape(st["var"]) == (64,)
+        assert t.stats()["bn_group_size"] == 4
+
+    def test_invalid_group_rejected(self):
+        with pytest.raises(ValueError, match="divide the data"):
+            DistributedTrainer(_mlp(9, bn=True), mesh=make_mesh(data=8),
+                               bn_group_size=3)
+        x, y = _data()
+        t = DistributedTrainer(_mlp(9, bn=True, bn_group=5),
+                               mesh=make_mesh(data=8))
+        with pytest.raises(ValueError, match="stats_axis_group"):
+            t.fit_batch(x, y)
+
+    def test_no_dist_context_is_classic_local(self):
+        """Outside a DistributedTrainer (Solver path, ctx.dist None) the
+        layer ignores stats_axis_group and normalizes locally."""
+        import jax.numpy as jnp
+
+        layer = BatchNormalizationLayer(n_out=4, stats_axis_group=4)
+        params = layer.init(jax.random.PRNGKey(0), jnp.float32)
+        state = layer.init_state(jnp.float32)
+        x = jnp.asarray(np.random.RandomState(0).randn(8, 4), jnp.float32)
+        y, _ = layer.apply(params, state, x, LayerContext(train=True))
+        ref, _ = BatchNormalizationLayer(n_out=4).apply(
+            params, state, x, LayerContext(train=True))
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-6)
+
+    def test_config_json_round_trip(self):
+        layer = BatchNormalizationLayer(n_out=32, stats_axis_group=4)
+        assert from_json(to_json(layer)) == layer
+
+
+# -------------------------------------------------- bucketed all-reduce
+class TestBucketedAllReduceSync:
+    def test_exact_trajectory_vs_implicit_all_reduce(self):
+        """psum of a concatenation == concatenation of psums: the
+        bucketed exchange follows the unbucketed trajectory exactly
+        (losses and params), across bucket granularities."""
+        x, y = _data()
+        mesh = make_mesh(data=8)
+        t_ref = DistributedTrainer(_mlp(7), mesh=mesh)
+        trainers = [DistributedTrainer(
+            _mlp(7), mesh=mesh,
+            strategy=BucketedAllReduceSync(bucket_bytes=bb))
+            for bb in (1 << 8, 1 << 12, 4 << 20)]
+        for _ in range(4):
+            s_ref = float(t_ref.fit_batch(x, y))
+            for t in trainers:
+                assert np.isclose(s_ref, float(t.fit_batch(x, y)),
+                                  rtol=1e-5)
+        t_ref.sync_to_model()
+        for t in trainers:
+            t.sync_to_model()
+            _params_close(t_ref.model.params, t.model.params)
+
+    def test_bucket_layout_reverse_layer_order(self):
+        strat = BucketedAllReduceSync(bucket_bytes=1 << 8)  # 256B: splits
+        params = {
+            "layer_0": {"W": np.zeros((16, 64), np.float32),
+                        "b": np.zeros((64,), np.float32)},
+            "layer_1": {"W": np.zeros((64, 8), np.float32),
+                        "b": np.zeros((8,), np.float32)},
+        }
+        strat.init_state(params)
+        order = [(ln, pn) for _, bucket in strat._buckets
+                 for ln, pn, _, _ in bucket]
+        # reverse layer order: the output layer's grads exist first
+        assert order[0][0] == "layer_1"
+        assert order.index(("layer_1", "W")) < order.index(("layer_0", "W"))
+        stats = strat.compression_stats(())
+        assert stats["buckets"] == len(strat._buckets) > 1
+        total = sum(p.size * 4 for lp in params.values() for p in lp.values())
+        assert stats["total_exchanged_bytes"] == total
+        assert sum(stats["bucket_volume_bytes"]) == total
+
+    def test_composes_with_zero1(self):
+        x, y = _data()
+        mesh = make_mesh(data=8)
+        t = DistributedTrainer(_mlp(5), mesh=mesh, zero1=True,
+                               strategy=BucketedAllReduceSync())
+        t_ref = DistributedTrainer(_mlp(5), mesh=mesh)
+        for _ in range(4):
+            s = float(t.fit_batch(x, y))
+            s_ref = float(t_ref.fit_batch(x, y))
+        assert np.isclose(s, s_ref, rtol=1e-5), (s, s_ref)
+        # zero1 actually sharded the moments
+        assert t.updater_state_bytes() < t.updater_state_bytes(
+            per_replica=False) / 5
+
+    def test_no_compression_metrics_but_stats_visible(self):
+        x, y = _data()
+        reg = MetricsRegistry()
+        t = DistributedTrainer(_mlp(5), mesh=make_mesh(data=8), registry=reg,
+                               strategy=BucketedAllReduceSync())
+        t.fit_batch(x, y)
+        comp = t.compression_stats()
+        assert comp["buckets"] >= 1
+        assert comp["total_exchanged_bytes"] > 0
+        assert t.threshold_value() is None
+        # not a compressed strategy: no compression-ratio histogram
+        assert reg.get("dl4j_tpu_training_grad_compression_ratio") is None
+
+    def test_invalid_bucket_bytes_rejected(self):
+        with pytest.raises(ValueError):
+            BucketedAllReduceSync(bucket_bytes=0)
+
+
+# ------------------------------------------- gradient-normalization audit
+class TestGradNormPostSync:
+    """ISSUE 14 audit: per-layer CLIP/RENORM must act on the POST-SYNC
+    global gradients on BOTH paths. The implicit path's grads are global
+    by construction; the explicit path syncs FIRST then normalizes — if
+    it ever clipped pre-sync local grads the per-layer norms (computed
+    from a 1/N batch slice) would differ and these trajectories would
+    silently diverge."""
+
+    @pytest.mark.parametrize("mode", [
+        GradientNormalization.CLIP_L2_PER_LAYER,
+        GradientNormalization.RENORMALIZE_L2_PER_LAYER,
+        GradientNormalization.CLIP_L2_PER_PARAM_TYPE,
+    ], ids=["clip-layer", "renorm-layer", "clip-param"])
+    def test_explicit_matches_implicit(self, mode):
+        x, y = _data()
+        mesh = make_mesh(data=8)
+        # Sgd: stateless, so ANY divergence is the normalization's
+        t_imp = DistributedTrainer(_mlp(3, Sgd(0.5), grad_norm=mode),
+                                   mesh=mesh)
+        t_exp = DistributedTrainer(_mlp(3, Sgd(0.5), grad_norm=mode),
+                                   mesh=mesh,
+                                   strategy=BucketedAllReduceSync())
+        for _ in range(5):
+            s_i = float(t_imp.fit_batch(x, y))
+            s_e = float(t_exp.fit_batch(x, y))
+        assert np.isclose(s_i, s_e, rtol=1e-5), (mode, s_i, s_e)
+        t_imp.sync_to_model()
+        t_exp.sync_to_model()
+        _params_close(t_imp.model.params, t_exp.model.params)
+
+    def test_clip_actually_engages(self):
+        """The threshold (0.5) genuinely clips on this task — the
+        equivalence above is not vacuous."""
+        x, y = _data()
+        mesh = make_mesh(data=8)
+        t_clip = DistributedTrainer(
+            _mlp(3, Sgd(0.5), grad_norm=GradientNormalization.CLIP_L2_PER_LAYER),
+            mesh=mesh)
+        t_none = DistributedTrainer(_mlp(3, Sgd(0.5)), mesh=mesh)
+        for _ in range(3):
+            s_c = float(t_clip.fit_batch(x, y))
+            s_n = float(t_none.fit_batch(x, y))
+        assert not np.isclose(s_c, s_n, rtol=1e-6), (s_c, s_n)

@@ -22,6 +22,10 @@ sharded weight update and the compressed gradient exchange end to end:
   * **Observability**: ``dl4j_tpu_training_updater_state_bytes{sharded=}``
     and ``dl4j_tpu_training_grad_compression_ratio`` land in the registry
     and survive Prometheus exposition.
+  * **Trust-ratio composition** (ISSUE 14): zero1 × {Lars, Lamb} ×
+    {BucketedAllReduceSync, TopKCompressedSync} — the slice-local +
+    psum'd layer norms keep every combination on the replicated
+    trajectory, and the trust-ratio series is exposed.
 
 Runs standalone (``python tools/check_dp_update_contract.py``) and as a
 tier-1 pytest via tests/test_dp_update_contract.py (mirroring
@@ -80,9 +84,10 @@ def main(log=print) -> int:
     from deeplearning4j_tpu.obs import MetricsRegistry
     from deeplearning4j_tpu.obs.prom import render_prometheus
     from deeplearning4j_tpu.parallel import (
-        DistributedTrainer, TopKCompressedSync, make_mesh)
+        BucketedAllReduceSync, DistributedTrainer, TopKCompressedSync,
+        make_mesh)
     from deeplearning4j_tpu.parallel.mesh import shmap
-    from deeplearning4j_tpu.train import Sgd
+    from deeplearning4j_tpu.train import Lamb, Lars, Sgd
 
     n_dev = len(jax.devices())
     mesh = make_mesh(data=n_dev)
@@ -166,6 +171,29 @@ def main(log=print) -> int:
             assert "incompatible" in str(e) and "opt_state" in str(e), e
         ck.close()
     log("PASS zero1->replicated checkpoint round trip + mismatch error")
+
+    # --- 4b. zero1 x {Lars, Lamb} x {Bucketed, TopK} (ISSUE 14) -----------
+    for updater in (Lars(0.1), Lamb(0.01)):
+        for strat_cls, kw in ((BucketedAllReduceSync, {"bucket_bytes": 1 << 12}),
+                              (TopKCompressedSync, {"density": 0.05})):
+            u_name = type(updater).__name__
+            s_name = strat_cls.__name__
+            c_rep = DistributedTrainer(_mlp(7, updater=updater), mesh=mesh,
+                                       strategy=strat_cls(**kw))
+            c_z = DistributedTrainer(_mlp(7, updater=updater), mesh=mesh,
+                                     strategy=strat_cls(**kw), zero1=True)
+            for _ in range(4):
+                sr = float(c_rep.fit_batch(x, y))
+                sz = float(c_z.fit_batch(x, y))
+            assert np.isclose(sr, sz, rtol=1e-5), (u_name, s_name, sr, sz)
+            c_rep.sync_to_model()
+            c_z.sync_to_model()
+            _params_close(c_rep.model.params, c_z.model.params)
+            trust = c_z.trust_ratio_stats()
+            assert trust and all(v["trust_ratio"] > 0 for v in trust.values()), \
+                (u_name, s_name, trust)
+            log(f"PASS zero1 x {u_name} x {s_name}: trajectory == replicated, "
+                f"trust ratios exposed")
 
     # --- 5. metrics land in the registry and the exposition ---------------
     reg = MetricsRegistry()
