@@ -2456,6 +2456,152 @@ def measure_fabric_overhead(n_requests: int = 120, threads: int = 4) -> dict:
     }
 
 
+def measure_checkpoint_stall(nin: int = 256, hidden: int = 512,
+                             nout: int = 64, batch: int = 64,
+                             warmup_steps: int = 3, steps: int = 12,
+                             save_every: int = 1) -> dict:
+    """Fault-tolerant-training row (ISSUE 15 acceptance): the per-step
+    STALL checkpointing puts on the step critical path — measured as the
+    time spent inside the CheckpointListener's ``iteration_done`` hook —
+    for sync saves (serialize + fsync + pointer flip on the step thread)
+    vs async saves (device fetch + enqueue; a bounded daemon writer does
+    the rest). Gate: async stall < 20% of the sync stall. Second gate:
+    an injected ``checkpoint.write`` fault NEVER aborts fit — the
+    failure is counted and training continues."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from deeplearning4j_tpu.core.listeners import TrainingListener
+    from deeplearning4j_tpu.core.resilience import (
+        FaultInjector, set_fault_injector)
+    from deeplearning4j_tpu.nn import (
+        Activation, InputType, LossFunction, MultiLayerNetwork,
+        NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+    from deeplearning4j_tpu.train.checkpoint import (
+        CHECKPOINT_WRITE_SITE, CheckpointListener)
+    from deeplearning4j_tpu.train.solver import Solver
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(batch, nin).astype(np.float32)
+    y = np.eye(nout, dtype=np.float32)[rng.randint(0, nout, batch)]
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-3))
+                .list()
+                .layer(DenseLayer(n_out=hidden, activation=Activation.RELU))
+                .layer(DenseLayer(n_out=hidden, activation=Activation.RELU))
+                .layer(OutputLayer(n_out=nout, loss=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(nin)).build())
+        return MultiLayerNetwork(conf).init()
+
+    class _TimedHook(TrainingListener):
+        """Times the wrapped checkpoint listener's hook — the exact
+        critical-path cost the async writer is supposed to remove."""
+
+        def __init__(self, inner=None):
+            self.inner = inner
+            self.hook_s = []
+
+        def iteration_done(self, model, iteration, epoch, score):
+            t0 = time.perf_counter()
+            if self.inner is not None:
+                self.inner.iteration_done(model, iteration, epoch, score)
+            self.hook_s.append(time.perf_counter() - t0)
+
+    def run(mode):
+        d = tempfile.mkdtemp(prefix=f"ckpt_stall_{mode}_")
+        reg = MetricsRegistry()
+        model = build()
+        solver = Solver(model)
+        model._trainer = solver
+        inner = None
+        if mode != "none":
+            inner = CheckpointListener(
+                d, save_every_n_iterations=save_every,
+                async_save=(mode == "async"), registry=reg,
+                log_fn=lambda m: None)
+        hook = _TimedHook(inner)
+        model.add_listeners(hook)
+        step_s = []
+        for i in range(warmup_steps + steps):
+            t0 = time.perf_counter()
+            model.fit(x, y, epochs=1)
+            step_s.append(time.perf_counter() - t0)
+        if inner is not None:
+            inner.close()
+        shutil.rmtree(d, ignore_errors=True)
+        saved = ((warmup_steps + steps) // save_every) if inner else 0
+        return {
+            "hook_ms": 1e3 * float(np.median(hook.hook_s[warmup_steps:])),
+            "step_ms": 1e3 * float(np.median(step_s[warmup_steps:])),
+            "saves": saved,
+        }
+
+    none_r = run("none")
+    sync_r = run("sync")
+    async_r = run("async")
+    sync_stall = max(sync_r["hook_ms"] - none_r["hook_ms"], 1e-6)
+    async_stall = max(async_r["hook_ms"] - none_r["hook_ms"], 0.0)
+
+    # fault leg: an armed checkpoint.write fault must not abort fit
+    d = tempfile.mkdtemp(prefix="ckpt_stall_fault_")
+    reg = MetricsRegistry()
+    model = build()
+    model._trainer = Solver(model)
+    ck = CheckpointListener(d, save_every_n_iterations=1, registry=reg,
+                            log_fn=lambda m: None)
+    model.add_listeners(ck)
+    inj = FaultInjector()
+    inj.inject_error(CHECKPOINT_WRITE_SITE,
+                     lambda: OSError("injected disk failure"), times=2)
+    prev = set_fault_injector(inj)
+    try:
+        fit_survived = True
+        try:
+            for _ in range(4):
+                model.fit(x, y, epochs=1)
+        except BaseException:
+            fit_survived = False
+    finally:
+        set_fault_injector(prev)
+    failures = reg.counter(
+        "dl4j_tpu_training_checkpoint_failures_total", "").value
+    saves_after_fault = reg.counter(
+        "dl4j_tpu_training_checkpoint_saves_total", "", ("mode",)
+    ).labels("sync").value
+    ck.close()
+    shutil.rmtree(d, ignore_errors=True)
+
+    return {
+        "step_ms_no_checkpoint": round(none_r["step_ms"], 3),
+        "step_ms_sync_save": round(sync_r["step_ms"], 3),
+        "step_ms_async_save": round(async_r["step_ms"], 3),
+        "hook_ms_no_checkpoint": round(none_r["hook_ms"], 4),
+        "hook_ms_sync_save": round(sync_r["hook_ms"], 3),
+        "hook_ms_async_save": round(async_r["hook_ms"], 3),
+        "sync_stall_ms": round(sync_stall, 3),
+        "async_stall_ms": round(async_stall, 3),
+        "async_vs_sync_stall_ratio": round(async_stall / sync_stall, 4),
+        "async_checkpoint_stall_under_20pct": bool(
+            async_stall < 0.2 * sync_stall),
+        "injected_faults": int(inj.fired(CHECKPOINT_WRITE_SITE)),
+        "checkpoint_failures_counted": int(failures),
+        "saves_after_fault": int(saves_after_fault),
+        "checkpoint_fault_never_aborts_fit": bool(
+            fit_survived and failures == 2 and saves_after_fault == 2),
+        "note": ("stall = time inside the checkpoint listener's "
+                 "iteration_done hook (the step critical path); async "
+                 "pays one device fetch + enqueue, sync pays serialize "
+                 "+ fsync + pointer flip"),
+    }
+
+
 _MEASUREMENTS = {
     "lenet": measure_lenet,
     "resnet50": measure_resnet50,
@@ -2482,6 +2628,7 @@ _MEASUREMENTS = {
     "fabric_overhead": measure_fabric_overhead,
     "quantized_infer": measure_quantized_infer,
     "int8_kv_cache": measure_int8_kv_cache,
+    "checkpoint_stall": measure_checkpoint_stall,
 }
 
 # extras row name -> measurement name (the artifact's "extras" keys, in
@@ -2508,6 +2655,7 @@ _EXTRA_ROWS = {
     "fabric_overhead": "fabric_overhead",
     "quantized_infer_speedup": "quantized_infer",
     "int8_kv_cache": "int8_kv_cache",
+    "checkpoint_stall": "checkpoint_stall",
 }
 # rows that only produce meaningful numbers on the chip (skipped with a
 # note under --rows on a cpu-fallback host)
@@ -2666,6 +2814,10 @@ def _child_measure(name: str, platform: str) -> None:
             "int8_kv_cache": {"hidden": 256, "heads": 4, "layers": 2,
                               "max_len": 64, "batch": 2,
                               "gen_tokens": 24, "train_steps": 50},
+            # stall contrast needs a serialization cost worth hiding:
+            # keep hidden wide enough that the zip write dominates the
+            # device fetch, few steps so the row stays fast
+            "checkpoint_stall": {"hidden": 384, "steps": 10},
         }.get(name, {})
     result = _MEASUREMENTS[name](**kwargs)
     print(json.dumps(result))

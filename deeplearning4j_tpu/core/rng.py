@@ -37,6 +37,29 @@ class RngState:
         self._count += 1
         return out
 
+    # ----- exact-resume protocol (train/checkpoint.py sidecar) ----------
+    def state_dict(self) -> dict:
+        """JSON-able snapshot of the stream position. Restoring it makes
+        the NEXT :meth:`next_key` return exactly what the snapshotted
+        stream would have returned — the per-step dropout/shuffle keys of
+        a resumed run continue the killed run's sequence bit-exactly."""
+        import numpy as np
+
+        return {
+            "seed": self._seed,
+            "count": self._count,
+            "key_data": np.asarray(jax.random.key_data(self._key),
+                                   dtype=np.uint32).tolist(),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        import numpy as np
+
+        self._seed = int(state["seed"])
+        self._count = int(state["count"])
+        self._key = jax.random.wrap_key_data(
+            jnp.asarray(np.asarray(state["key_data"], dtype=np.uint32)))
+
     def split(self, n: int) -> jax.Array:
         self._key, *keys = jax.random.split(self._key, n + 1)
         self._count += n

@@ -25,7 +25,18 @@ _prefetch_seq = itertools.count()
 
 
 class DataSetIterator:
-    """Base iterator protocol (reference: DataSetIterator)."""
+    """Base iterator protocol (reference: DataSetIterator).
+
+    **Iterator-state protocol** (exact mid-epoch resume;
+    train/checkpoint.py captures it in the checkpoint sidecar): a
+    stateful iterator implements :meth:`state_dict` — a small JSON-able
+    dict with at least ``{"epoch": int, "batches": int}`` describing the
+    CONSUMER position (batches handed out this epoch, NOT any prefetch
+    run-ahead) — and :meth:`load_state_dict`, which repositions a freshly
+    built identical iterator so the next ``next()`` yields exactly the
+    first batch the snapshotted consumer had not yet received. Wrappers
+    delegate; iterators without a deterministic position (plain
+    generators) keep the base behavior and raise."""
 
     def __iter__(self) -> Iterator[DataSet]:
         self.reset()
@@ -47,6 +58,16 @@ class DataSetIterator:
 
     def batch_size(self) -> int:
         raise NotImplementedError
+
+    def state_dict(self) -> dict:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support iterator-state "
+            "checkpointing (state_dict)")
+
+    def load_state_dict(self, state: dict) -> None:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support iterator-state "
+            "checkpointing (load_state_dict)")
 
 
 class ListDataSetIterator(DataSetIterator):
@@ -85,6 +106,21 @@ class ListDataSetIterator(DataSetIterator):
 
     def batch_size(self) -> int:
         return self.batch
+
+    def state_dict(self) -> dict:
+        return {"epoch": self._epoch, "batches": self._pos // self.batch}
+
+    def load_state_dict(self, state: dict) -> None:
+        # the active epoch's order was drawn with seed + (_epoch - 1)
+        # (reset() draws, THEN increments _epoch) — regenerate it rather
+        # than storing the permutation itself
+        self._epoch = int(state["epoch"])
+        self._pos = int(state["batches"]) * self.batch
+        if self.shuffle and self._epoch > 0:
+            rng = np.random.default_rng(self.seed + self._epoch - 1)
+            self._order = rng.permutation(self.data.num_examples())
+        else:
+            self._order = np.arange(self.data.num_examples())
 
 
 class AsyncDataSetIterator(DataSetIterator):
@@ -144,6 +180,7 @@ class AsyncDataSetIterator(DataSetIterator):
         self._stop = threading.Event()
         self._close_lock = threading.Lock()
         self._hits = 0  # dequeues served without waiting
+        self._consumed = 0  # batches handed to the consumer this epoch
         self._dev_slots = self._make_ring()
         reg = registry if registry is not None else get_registry()
         self.registry = reg
@@ -278,6 +315,7 @@ class AsyncDataSetIterator(DataSetIterator):
             raise StopIteration
         item = self._next_item
         self._advance()
+        self._consumed += 1
         return item
 
     def close(self, timeout: float = 5.0) -> None:
@@ -308,12 +346,36 @@ class AsyncDataSetIterator(DataSetIterator):
         self.close()
         with self._close_lock:
             self.underlying.reset()
-            self._queue = queue.Queue(maxsize=self.queue_size)
-            self._stop = threading.Event()
-            self._error = None
-            self._started = False
-            self._next_item = None
-            self._dev_slots = self._make_ring()
+            self._reinit_pipeline()
+
+    def _reinit_pipeline(self) -> None:
+        self._queue = queue.Queue(maxsize=self.queue_size)
+        self._stop = threading.Event()
+        self._error = None
+        self._started = False
+        self._next_item = None
+        self._consumed = 0
+        self._dev_slots = self._make_ring()
+
+    def state_dict(self) -> dict:
+        """Consumer-position snapshot: the underlying iterator's epoch
+        identity with ``batches`` overridden by the batches actually
+        HANDED OUT — the prefetch thread's run-ahead (queued batches and
+        the lookahead item) is deliberately not counted, so a resume
+        re-produces exactly the batches the consumer never saw. Requires
+        an underlying whose epoch only advances via ``reset()`` (the
+        whole iterator family here; do not stack the async wrapper ON
+        TOP of :class:`MultipleEpochsIterator` if you need resume)."""
+        st = dict(self.underlying.state_dict())
+        st["batches"] = self._consumed
+        return st
+
+    def load_state_dict(self, state: dict) -> None:
+        self.close()
+        with self._close_lock:
+            self.underlying.load_state_dict(state)
+            self._reinit_pipeline()
+            self._consumed = int(state["batches"])
 
     def stats(self) -> dict:
         """Per-instance view over the registry children (one source of
@@ -383,6 +445,15 @@ class MultipleEpochsIterator(DataSetIterator):
     def batch_size(self) -> int:
         return self.underlying.batch_size()
 
+    def state_dict(self) -> dict:
+        st = dict(self.underlying.state_dict())
+        st["multi_epoch"] = self._epoch
+        return st
+
+    def load_state_dict(self, state: dict) -> None:
+        self._epoch = int(state.get("multi_epoch", 0))
+        self.underlying.load_state_dict(state)
+
 
 class MappedDataSetIterator(DataSetIterator):
     """Applies ``feature_fn`` (and optionally ``label_fn``) to each batch —
@@ -414,3 +485,9 @@ class MappedDataSetIterator(DataSetIterator):
 
     def batch_size(self) -> int:
         return self.underlying.batch_size()
+
+    def state_dict(self) -> dict:
+        return self.underlying.state_dict()
+
+    def load_state_dict(self, state: dict) -> None:
+        self.underlying.load_state_dict(state)

@@ -50,6 +50,17 @@ class RecordReader:
     def __iter__(self) -> Iterator[Record]:
         raise NotImplementedError
 
+    def iter_records(self, skip: int = 0) -> Iterator[Record]:
+        """One pass over the records, skipping the first ``skip`` — the
+        mid-epoch resume entry point. The generic fallback produces and
+        discards the skipped records (correct for any reader); readers
+        with per-record cost (image decode) override with a free skip."""
+        it = iter(self)
+        for _ in range(skip):
+            if next(it, None) is None:
+                return
+        yield from it
+
     def reset(self) -> None:
         """Default: readers here re-create their state in __iter__."""
 
@@ -212,7 +223,9 @@ class ImageRecordReader(RecordReader):
         self.transform = transform
         self.output_dtype = output_dtype
         self.workers = resolve_data_workers(workers)
+        self._seed = int(seed)
         self._rng = np.random.RandomState(seed)
+        self._epochs_started = 0  # passes begun — the rng-stream position
         # resolved once: PIL availability can't change mid-scan, and the
         # walk below tests this per file at ImageNet scale
         self.EXTENSIONS = self.NETPBM_EXTENSIONS + (
@@ -337,29 +350,48 @@ class ImageRecordReader(RecordReader):
         return np.ascontiguousarray(img)
 
     def __iter__(self) -> Iterator[Record]:
-        if self.workers > 1:
-            yield from self._iter_parallel()
-            return
-        # same per-image rng derivation as the worker pool, so the
-        # augmented stream is bit-identical for EVERY worker count (the
-        # loader-determinism contract; see tests/test_sharded_loader.py)
+        return self.iter_records(0)
+
+    def iter_records(self, skip: int = 0) -> Iterator[Record]:
+        # per-image independent rngs (same derivation for every worker
+        # count — the loader-determinism contract, see
+        # tests/test_sharded_loader.py) make the skip FREE: the full seed
+        # vector is drawn so the pass's rng stream stays identical, but
+        # skipped images are never decoded.
         seeds = self._rng.randint(0, 2**31 - 1, size=len(self.paths))
-        for i, p in enumerate(self.paths):
+        self._epochs_started += 1
+        if self.workers > 1:
+            yield from self._iter_parallel(seeds, skip)
+            return
+        for i in range(skip, len(self.paths)):
             rec: Record = [self._load(
-                p, rng=np.random.RandomState(seeds[i]))]
+                self.paths[i], rng=np.random.RandomState(seeds[i]))]
             if self.label_from_path:
                 rec.append(self._path_labels[i])
             yield rec
 
-    def _iter_parallel(self) -> Iterator[Record]:
+    def state_dict(self) -> dict:
+        """Reader-level resume state: how many passes have STARTED. Each
+        pass draws one per-image seed vector from the reader's stateful
+        rng, so the pass index pins the augmentation stream; the record
+        cursor within the pass belongs to the dataset iterator above."""
+        return {"epoch": self._epochs_started}
+
+    def load_state_dict(self, state: dict) -> None:
+        """Repositions the rng stream so the next :meth:`iter_records`
+        call RE-ENTERS the snapshotted pass — it draws the exact seed
+        vector that pass drew, and the caller skips to its cursor."""
+        epoch = max(0, int(state.get("epoch", 0)) - 1)
+        self._rng = np.random.RandomState(self._seed)
+        for _ in range(epoch):  # replay the completed passes' seed draws
+            self._rng.randint(0, 2**31 - 1, size=len(self.paths))
+        self._epochs_started = epoch
+
+    def _iter_parallel(self, seeds, skip: int = 0) -> Iterator[Record]:
         """Thread-pool decode+augment, order-preserving, bounded in-flight
         window (the reference's multi-threaded image ingestion; decode and
         resize release the GIL, so workers scale with real cores)."""
         from concurrent.futures import ThreadPoolExecutor
-
-        # per-image independent rngs keep augmentation deterministic
-        # regardless of worker scheduling
-        seeds = self._rng.randint(0, 2**31 - 1, size=len(self.paths))
 
         def load(i: int):
             return self._load(self.paths[i],
@@ -368,8 +400,8 @@ class ImageRecordReader(RecordReader):
         window = 4 * self.workers
         with ThreadPoolExecutor(max_workers=self.workers) as pool:
             pending = {}
-            nxt = 0
-            for i in range(len(self.paths)):
+            nxt = skip
+            for i in range(skip, len(self.paths)):
                 pending[i] = pool.submit(load, i)
                 while len(pending) >= window or (
                         nxt in pending and pending[nxt].done()):
@@ -410,13 +442,20 @@ class RecordReaderDataSetIterator:
     # composes with AsyncDataSetIterator / MappedDataSetIterator ----------
     _gen = None
     _lookahead = None
+    _epochs_started = 0
+    _batches_out = 0
 
     def batch_size(self) -> int:
         return self._batch
 
+    def _start_generation(self, skip_batches: int = 0):
+        self._epochs_started += 1
+        self._batches_out = skip_batches
+        return self._generate(skip_records=skip_batches * self._batch)
+
     def has_next(self) -> bool:
         if self._gen is None:
-            self._gen = self._generate()
+            self._gen = self._start_generation()
         if self._lookahead is None:
             self._lookahead = next(self._gen, None)
         return self._lookahead is not None
@@ -425,21 +464,45 @@ class RecordReaderDataSetIterator:
         if not self.has_next():
             raise StopIteration
         item, self._lookahead = self._lookahead, None
+        self._batches_out += 1
         return item
 
     def reset(self) -> None:
         self.reader.reset()
         self._gen = None
         self._lookahead = None
+        self._batches_out = 0
 
     def __iter__(self) -> Iterator[DataSet]:
         self.reset()
-        return self._generate()
+        return self._start_generation()
 
-    def _generate(self) -> Iterator[DataSet]:
+    def state_dict(self) -> dict:
+        # the lookahead batch was pulled from the generator but never
+        # handed out — _batches_out only counts next() returns, so it is
+        # correctly re-produced on resume
+        return {"epoch": self._epochs_started, "batches": self._batches_out}
+
+    def load_state_dict(self, state: dict) -> None:
+        epoch = int(state["epoch"])
+        batches = int(state["batches"])
+        loader = getattr(self.reader, "load_state_dict", None)
+        if callable(loader):
+            self.reader.load_state_dict({"epoch": epoch})
+        else:
+            self.reader.reset()
+        self._epochs_started = max(0, epoch - 1)
+        self._lookahead = None
+        if epoch > 0:
+            self._gen = self._start_generation(skip_batches=batches)
+        else:
+            self._gen = None
+            self._batches_out = 0
+
+    def _generate(self, skip_records: int = 0) -> Iterator[DataSet]:
         feats: List[np.ndarray] = []
         labels: List[np.ndarray] = []
-        for rec in self.reader:
+        for rec in self.reader.iter_records(skip_records):
             li = self.label_index if self.label_index >= 0 \
                 else len(rec) + self.label_index
             label_val = rec[li]

@@ -164,6 +164,16 @@ class ShardedDataSetIterator(DataSetIterator):
     def local_batch_size(self) -> int:
         return self.underlying.batch_size()
 
+    def state_dict(self) -> dict:
+        """Delegates: the sharded assembly is stateless per batch, so the
+        consumer position IS the per-host underlying's position. Every
+        host checkpoints/restores its own shard's cursor — PR 7's
+        deterministic sharding makes the union exact."""
+        return self.underlying.state_dict()
+
+    def load_state_dict(self, state: dict) -> None:
+        self.underlying.load_state_dict(state)
+
     def stats(self) -> dict:
         s = getattr(self.underlying, "stats", None)
         return s() if callable(s) else {}

@@ -16,7 +16,7 @@ import json
 import math
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
-from .records import Record
+from .records import Record, RecordReader
 
 
 class ColumnType(enum.Enum):
@@ -384,9 +384,12 @@ class TransformProcessBuilder:
         return tp
 
 
-class TransformProcessRecordReader:
+class TransformProcessRecordReader(RecordReader):
     """Reader decorator applying a TransformProcess on the fly (reference:
-    TransformProcessRecordReader)."""
+    TransformProcessRecordReader). A real :class:`RecordReader` so the
+    base ``iter_records(skip=)`` resume path applies — the skip counts
+    POST-transform records, which is the consumer-visible cursor even
+    when filters drop rows."""
 
     def __init__(self, reader, process: TransformProcess) -> None:
         self.reader = reader

@@ -68,13 +68,19 @@ def _npz_to_leaves(data: bytes, template: Any) -> Any:
     return jax.tree_util.tree_unflatten(treedef, cast)
 
 
-def write_model(model, path: str, save_updater: bool = False, normalizer=None) -> None:
+def write_model(model, path: str, save_updater: bool = False, normalizer=None,
+                *, class_name: Optional[str] = None) -> None:
     """Reference: ModelSerializer.writeModel(model, file, saveUpdater[, normalizer]).
 
     Atomic: the zip is assembled in a temp file in the destination
     directory, fsynced, then ``os.replace``d onto ``path`` — a crash
     mid-write never leaves a truncated artifact at ``path`` (an existing
-    file there survives untouched)."""
+    file there survives untouched).
+
+    ``class_name=`` overrides the recorded model class: the async
+    checkpoint writer (train/checkpoint.py) serializes a host-memory
+    SNAPSHOT shim instead of the live model, and meta.json must still
+    name the real class for :func:`restore_model` dispatch."""
     dirname = os.path.dirname(os.path.abspath(path))
     fd, tmp_path = tempfile.mkstemp(dir=dirname, prefix=".tmp-",
                                     suffix=os.path.basename(path))
@@ -88,7 +94,7 @@ def write_model(model, path: str, save_updater: bool = False, normalizer=None) -
                 zf.writestr(_COEFF, buf.getvalue())
                 zf.writestr(_STATE, _leaves_to_npz(model.state))
                 meta = {
-                    "model_class": type(model).__name__,
+                    "model_class": class_name or type(model).__name__,
                     "framework": _FRAMEWORK,
                     "version": __version__,
                 }

@@ -627,15 +627,31 @@ class DistributedTrainer:
         (global jax.Arrays on :attr:`data_sharding`) skip host prep and
         ``device_put`` entirely, so per-step H2D happens only on the
         loader's prefetch thread. Batch sizes must already divide the
-        data axis (the sharded assembly guarantees it)."""
+        data axis (the sharded assembly guarantees it).
+
+        Exact mid-epoch resume: a ``DataSetIterator`` is consumed from
+        its CURRENT position (an iterator repositioned via
+        ``load_state_dict()`` continues the interrupted epoch, which
+        counts as the first of ``epochs``) and ``reset()`` only when
+        exhausted. Plain iterables without ``has_next`` keep the old
+        reset-per-epoch ``for`` path."""
         model = self.model
         sync = bool(model.listeners.listeners)
         last = None
+        resumable = hasattr(iterator, "has_next")
         for _ in range(epochs):
             model.listeners.epoch_start(model)
-            for ds in iterator:
-                last = self.fit_batch(ds.features, ds.labels)
-                self._fit_iteration_done(sync, last)
+            if resumable:
+                if not iterator.has_next():
+                    iterator.reset()
+                while iterator.has_next():
+                    ds = iterator.next()
+                    last = self.fit_batch(ds.features, ds.labels)
+                    self._fit_iteration_done(sync, last)
+            else:
+                for ds in iterator:
+                    last = self.fit_batch(ds.features, ds.labels)
+                    self._fit_iteration_done(sync, last)
             model.listeners.epoch_end(model)
             model.epoch_count += 1
         if last is not None:

@@ -15,8 +15,10 @@ from .schedules import (
     StepSchedule,
     WarmupSchedule,
 )
-from .fault_tolerance import (HeartbeatListener, Watchdog,
-                              elastic_fit, read_heartbeat)
+from .checkpoint import CheckpointListener, restore_training_state
+from .fault_tolerance import (PREEMPTED_EXIT_CODE, STALL_EXIT_CODE,
+                              HeartbeatListener, PreemptionHandler,
+                              Watchdog, elastic_fit, read_heartbeat)
 from .solver import Solver
 from .updaters import (
     AMSGrad,

@@ -3,16 +3,106 @@
 Reference: org.deeplearning4j.optimize.listeners.CheckpointListener (every N
 iters/epochs, keep-last-K policy, lastCheckpoint() resume helper) and
 EvaluativeListener (periodic evaluation during fit) — SURVEY.md §5.4/§5.5.
+
+Fault-tolerant training (README "Fault-tolerant training"): with
+``async_save=True`` the step thread only SNAPSHOTS training state to host
+memory (one device fetch); a bounded background writer does serialization +
+fsync + the atomic ``lastCheckpoint.json`` flip, so checkpointing is off the
+step critical path. Crash-consistency rule: the pointer file only ever names
+a fully-fsynced artifact (zip THEN sidecar THEN pointer, each atomic via
+tmp + fsync + ``os.replace``), and the pointer only moves FORWARD in
+(epoch, iteration) order — a slow async write can never clobber a newer
+preemption save. A failed save (disk full, injected ``checkpoint.write``
+fault) increments ``dl4j_tpu_training_checkpoint_failures_total`` and
+training CONTINUES; losing one checkpoint must not kill a pod-scale fit.
+
+Each checkpoint zip has a ``.state.json`` sidecar carrying everything the
+zip format can't: iteration/epoch counters, the model's RNG stream position
+(core/rng.py), and the data iterator's consumer cursor
+(``DataSetIterator.state_dict``) — :func:`restore_training_state` puts them
+back so a killed run resumes BIT-EXACTLY where it stopped, consuming only
+the batches the killed run never did.
 """
 
 from __future__ import annotations
 
+import collections
+import glob
 import json
 import os
+import re
+import tempfile
+import threading
 import time
-from typing import Any, List, Optional
+import warnings
+from typing import Any, List, Optional, Tuple
 
 from ..core.listeners import TrainingListener
+
+# FaultInjector site fired before every checkpoint write (both modes)
+CHECKPOINT_WRITE_SITE = "checkpoint.write"
+
+_STATE_SUFFIX = ".state.json"
+_POINTER = "lastCheckpoint.json"
+
+
+def _atomic_write_json(path: str, payload: dict) -> None:
+    """tmp + fsync + os.replace: readers never see a torn file, and a
+    crash mid-write leaves any existing file untouched."""
+    dirname = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=dirname, prefix=".tmp-",
+                               suffix=os.path.basename(path))
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _fsync_dir(dirname: str) -> None:
+    """Durably record a rename in its directory (crash-consistency: the
+    pointer flip is only complete once the directory entry is on disk)."""
+    try:
+        fd = os.open(dirname, os.O_RDONLY)
+    except OSError:  # pragma: no cover - platform-dependent
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover - some filesystems refuse
+        pass
+    finally:
+        os.close(fd)
+
+
+class _TrainerShim:
+    def __init__(self, opt_state: Any) -> None:
+        self.opt_state = opt_state
+
+
+class _ModelSnapshot:
+    """Host-memory view of everything ONE checkpoint write needs — taken
+    on the step thread (device fetch only) so the background writer never
+    touches live device buffers (which the donated train step invalidates
+    every iteration)."""
+
+    def __init__(self, model, *, save_updater: bool) -> None:
+        import jax
+
+        self.class_name = type(model).__name__
+        self.conf = model.conf
+        self.params, self.state = jax.device_get((model.params, model.state))
+        trainer = getattr(model, "_trainer", None)
+        if save_updater and trainer is not None:
+            self._trainer = _TrainerShim(jax.device_get(trainer.opt_state))
+        else:
+            self._trainer = None
 
 
 class CheckpointListener(TrainingListener):
@@ -26,6 +116,11 @@ class CheckpointListener(TrainingListener):
         save_updater: bool = True,
         log_fn=None,
         trainer: Optional[Any] = None,
+        *,
+        async_save: bool = False,
+        iterator: Optional[Any] = None,
+        registry=None,
+        max_pending_writes: int = 2,
     ) -> None:
         """``trainer=`` attaches the live
         :class:`~deeplearning4j_tpu.parallel.trainer.DistributedTrainer`:
@@ -37,9 +132,28 @@ class CheckpointListener(TrainingListener):
         params, because the trainer only syncs back at fit() end. Note the
         zip artifact never carries the trainer's sharded opt_state — use
         :class:`~deeplearning4j_tpu.train.orbax_checkpoint.OrbaxCheckpointer`
-        for resumable sharded training state."""
+        for resumable sharded training state.
+
+        ``async_save=True`` moves serialization + fsync off the step
+        thread: the step pays one device fetch, a bounded daemon writer
+        does the rest. At most ``max_pending_writes`` snapshots queue;
+        an older still-unwritten snapshot is superseded (dropped) by a
+        newer one — checkpointing wants the newest state, not a backlog.
+
+        ``iterator=`` attaches the training data iterator; its
+        ``state_dict()`` (consumer cursor) rides in the ``.state.json``
+        sidecar, the exact-mid-epoch-resume half of the contract.
+
+        Both save modes NEVER raise out of ``iteration_done``: a failed
+        write is counted in ``checkpoint_failures_total`` and training
+        continues (the previous checkpoint + pointer stay intact)."""
         if not (save_every_n_iterations or save_every_n_epochs or save_every_n_seconds):
             raise ValueError("Configure at least one save frequency")
+        if max_pending_writes < 1:
+            raise ValueError(
+                f"max_pending_writes must be >= 1, got {max_pending_writes}")
+        from ..obs.metrics import get_registry
+
         self.trainer = trainer
         self.directory = directory
         self.every_iter = save_every_n_iterations
@@ -48,41 +162,259 @@ class CheckpointListener(TrainingListener):
         self.keep_last = keep_last
         self.save_updater = save_updater
         self.log_fn = log_fn
+        self.async_save = bool(async_save)
+        self.iterator = iterator
+        self.max_pending_writes = int(max_pending_writes)
         self._last_save_time = time.time()
-        self._saved: List[str] = []
         os.makedirs(directory, exist_ok=True)
+        # pre-restart checkpoints count against keep_last too: a restart
+        # cycle must not grow the directory unboundedly (each run used to
+        # start with an empty _saved list and never prune older files).
+        # _saved holds ((epoch, iteration), path) and pruning evicts the
+        # LOWEST key — completion order would evict the newest checkpoint
+        # when a forced sync save lands before stale async stragglers.
+        self._saved: List[Tuple[Tuple[int, int], str]] = sorted(
+            (key, p) for p in glob.glob(
+                os.path.join(directory, "checkpoint_iter*.zip"))
+            if (key := self._ckpt_key(p)) is not None)
+        # a SIGKILL mid-write leaves the writer's tmp file behind; the
+        # pointer never names it, so it is pure debris — sweep on restart
+        for debris in glob.glob(os.path.join(directory, ".tmp-*")):
+            try:
+                os.remove(debris)
+            except OSError:
+                pass
+        self._ptr_lock = threading.RLock()
+        self._last_ptr: Optional[Tuple[int, int]] = None
+        self._q: collections.deque = collections.deque()
+        self._q_cond = threading.Condition()
+        self._writer: Optional[threading.Thread] = None
+        self._writer_stop = False
+        self._inflight = False
+        reg = registry if registry is not None else get_registry()
+        self._c_saves = reg.counter(
+            "dl4j_tpu_training_checkpoint_saves_total",
+            "Completed checkpoint writes (pointer flipped)", ("mode",))
+        self._c_failures = reg.counter(
+            "dl4j_tpu_training_checkpoint_failures_total",
+            "Checkpoint writes that failed (training continued; the "
+            "previous checkpoint remains the resume point)")
+        self._h_write = reg.histogram(
+            "dl4j_tpu_training_checkpoint_write_seconds",
+            "Serialization + fsync + pointer-flip duration per checkpoint")
+        self._g_pending = reg.gauge(
+            "dl4j_tpu_training_checkpoint_pending_writes",
+            "Snapshots queued or in flight on the async writer")
 
-    def _save(self, model, iteration: int, epoch: int) -> None:
-        from ..model.serializer import write_model
+    @staticmethod
+    def _ckpt_key(path: str) -> Optional[Tuple[int, int]]:
+        """(epoch, iteration) parsed from a checkpoint filename — the
+        recency order pruning and the pointer rule share."""
+        m = re.match(r"checkpoint_iter(\d+)_epoch(\d+)\.zip$",
+                     os.path.basename(path))
+        return (int(m.group(2)), int(m.group(1))) if m else None
 
+    # ----- snapshot (step thread) --------------------------------------
+    def _snapshot(self, model, iteration: int, epoch: int,
+                  score: float = float("nan")) -> dict:
         if self.trainer is not None:
             self.trainer.sync_to_model()
             model = self.trainer.model
-        fname = os.path.join(
-            self.directory, f"checkpoint_iter{iteration}_epoch{epoch}.zip"
-        )
-        write_model(model, fname, save_updater=self.save_updater)
-        self._saved.append(fname)
-        meta = {
-            "iteration": iteration, "epoch": epoch, "time": time.time(),
-            "file": os.path.basename(fname),
+        snap = _ModelSnapshot(model, save_updater=self.save_updater)
+        sidecar = {
+            "iteration": iteration,
+            "epoch": epoch,
+            "model_iteration_count": getattr(model, "iteration_count", iteration),
+            "model_epoch_count": getattr(model, "epoch_count", epoch),
+            "score": None if score != score else float(score),
+            "time": time.time(),
         }
-        with open(os.path.join(self.directory, "lastCheckpoint.json"), "w") as f:
-            json.dump(meta, f)
-        if self.keep_last is not None:
-            while len(self._saved) > self.keep_last:
-                old = self._saved.pop(0)
-                if os.path.exists(old):
-                    os.remove(old)
-        if self.log_fn:
-            self.log_fn(f"Saved checkpoint: {fname}")
+        rng = getattr(model, "_rng", None)
+        if rng is not None and hasattr(rng, "state_dict"):
+            sidecar["rng"] = rng.state_dict()
+        if self.iterator is not None:
+            try:
+                sidecar["iterator"] = self.iterator.state_dict()
+            except NotImplementedError:
+                sidecar["iterator"] = None
+        return {"model": snap, "iteration": iteration, "epoch": epoch,
+                "sidecar": sidecar}
+
+    # ----- write (background thread in async mode) ---------------------
+    def _write(self, job: dict, mode: str) -> bool:
+        from ..core.resilience import get_fault_injector
+        from ..model.serializer import write_model
+
+        t0 = time.perf_counter()
+        iteration, epoch = job["iteration"], job["epoch"]
+        fname = os.path.join(
+            self.directory, f"checkpoint_iter{iteration}_epoch{epoch}.zip")
+        try:
+            get_fault_injector().fire(CHECKPOINT_WRITE_SITE)
+            snap: _ModelSnapshot = job["model"]
+            write_model(snap, fname,
+                        save_updater=snap._trainer is not None,
+                        class_name=snap.class_name)
+            state_name = fname[: -len(".zip")] + _STATE_SUFFIX
+            _atomic_write_json(state_name, job["sidecar"])
+            with self._ptr_lock:
+                # forward-only: a stale queued async write must never move
+                # the pointer back past a newer (e.g. preemption) save
+                key = (epoch, iteration)
+                if self._last_ptr is None or key >= self._last_ptr:
+                    _atomic_write_json(
+                        os.path.join(self.directory, _POINTER),
+                        {"iteration": iteration, "epoch": epoch,
+                         "time": time.time(),
+                         "file": os.path.basename(fname),
+                         "state": os.path.basename(state_name)})
+                    _fsync_dir(self.directory)
+                    self._last_ptr = key
+                self._saved.append((key, fname))
+                self._saved.sort()
+                if self.keep_last is not None:
+                    # evict lowest (epoch, iteration) first and NEVER the
+                    # pointer target — a stale async straggler completing
+                    # after a forced final save must not delete it
+                    keep = []
+                    excess = len(self._saved) - self.keep_last
+                    for k, old in self._saved:
+                        if excess > 0 and k != self._last_ptr:
+                            excess -= 1
+                            for victim in (old,
+                                           old[: -len(".zip")] + _STATE_SUFFIX):
+                                if os.path.exists(victim):
+                                    os.remove(victim)
+                        else:
+                            keep.append((k, old))
+                    self._saved = keep
+            self._c_saves.labels(mode).inc()
+            self._h_write.observe(time.perf_counter() - t0)
+            if self.log_fn:
+                self.log_fn(f"Saved checkpoint: {fname}")
+            return True
+        except BaseException as e:  # keep training: count, clean up, go on
+            self._c_failures.inc()
+            for debris in (fname,):
+                try:
+                    if os.path.exists(debris):
+                        os.remove(debris)
+                except OSError:
+                    pass
+            msg = f"checkpoint save failed ({fname}): {type(e).__name__}: {e}"
+            if self.log_fn:
+                self.log_fn(msg)
+            else:
+                warnings.warn(msg, stacklevel=2)
+            return False
+
+    def _enqueue(self, job: dict) -> None:
+        with self._q_cond:
+            if self._writer is None:
+                self._writer = threading.Thread(
+                    target=self._writer_loop, name="ckpt-writer", daemon=True)
+                self._writer.start()
+            while len(self._q) >= self.max_pending_writes:
+                self._q.popleft()  # superseded by the newer snapshot
+            self._q.append(job)
+            self._g_pending.set(len(self._q) + (1 if self._inflight else 0))
+            self._q_cond.notify_all()
+
+    def _writer_loop(self) -> None:
+        while True:
+            with self._q_cond:
+                while not self._q and not self._writer_stop:
+                    self._q_cond.wait(0.2)
+                if not self._q and self._writer_stop:
+                    return
+                job = self._q.popleft()
+                self._inflight = True
+                self._g_pending.set(len(self._q) + 1)
+            try:
+                self._write(job, "async")
+            finally:
+                with self._q_cond:
+                    self._inflight = False
+                    self._g_pending.set(len(self._q))
+                    self._q_cond.notify_all()
+
+    def flush(self, timeout: float = 60.0) -> bool:
+        """Wait until every queued async write has completed (or failed).
+        True when the queue drained inside ``timeout``."""
+        deadline = time.monotonic() + timeout
+        with self._q_cond:
+            while self._q or self._inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._q_cond.wait(min(0.2, remaining))
+        return True
+
+    def close(self, timeout: float = 60.0) -> None:
+        """Drain pending writes and stop the writer thread. Idempotent."""
+        self.flush(timeout)
+        with self._q_cond:
+            self._writer_stop = True
+            self._q_cond.notify_all()
+            t = self._writer
+        if t is not None:
+            t.join(timeout=timeout)
+        with self._q_cond:
+            self._writer = None
+            self._writer_stop = False
+
+    # ----- triggers -----------------------------------------------------
+    def _save(self, model, iteration: int, epoch: int,
+              score: float = float("nan")) -> None:
         self._last_save_time = time.time()
+        try:
+            job = self._snapshot(model, iteration, epoch, score)
+        except BaseException as e:  # snapshot failure must not kill fit
+            self._c_failures.inc()
+            msg = f"checkpoint snapshot failed: {type(e).__name__}: {e}"
+            if self.log_fn:
+                self.log_fn(msg)
+            else:
+                warnings.warn(msg, stacklevel=2)
+            return
+        if self.async_save:
+            self._enqueue(job)
+        else:
+            self._write(job, "sync")
+
+    def save_now(self, model, iteration: Optional[int] = None,
+                 epoch: Optional[int] = None,
+                 score: float = float("nan")) -> bool:
+        """Force a SYNCHRONOUS checkpoint of the current state (the
+        preemption path: the final save must be durable before exit).
+        Returns True when the write completed and the pointer names it.
+        The forward-only pointer rule makes this safe next to a still-
+        draining async writer."""
+        if iteration is None:
+            iteration = getattr(model, "iteration_count", 0)
+        if epoch is None:
+            epoch = getattr(model, "epoch_count", 0)
+        self._last_save_time = time.time()
+        try:
+            job = self._snapshot(model, iteration, epoch, score)
+        except BaseException:
+            self._c_failures.inc()
+            return False
+        ok = self._write(job, "sync")
+        self.flush(timeout=10.0)  # let stragglers lose to the pointer rule
+        return ok
 
     def iteration_done(self, model: Any, iteration: int, epoch: int, score: float) -> None:
-        if self.every_iter and iteration % self.every_iter == 0:
-            self._save(model, iteration, epoch)
-        elif self.every_seconds and (time.time() - self._last_save_time) >= self.every_seconds:
-            self._save(model, iteration, epoch)
+        # the two triggers are independent (an `elif` let a satisfied
+        # iteration trigger starve the time trigger); iteration 0 is the
+        # pre-step state and never saved
+        due = bool(self.every_iter and iteration > 0
+                   and iteration % self.every_iter == 0)
+        if (not due and self.every_seconds
+                and (time.time() - self._last_save_time) >= self.every_seconds):
+            due = True
+        if due:
+            self._save(model, iteration, epoch, score)
 
     def on_epoch_end(self, model: Any) -> None:
         if self.every_epoch and (model.epoch_count + 1) % self.every_epoch == 0:
@@ -91,13 +423,55 @@ class CheckpointListener(TrainingListener):
     @staticmethod
     def last_checkpoint(directory: str) -> Optional[str]:
         """Resume helper (reference: lastCheckpoint())."""
-        meta_path = os.path.join(directory, "lastCheckpoint.json")
+        meta_path = os.path.join(directory, _POINTER)
         if not os.path.exists(meta_path):
             return None
         with open(meta_path) as f:
             meta = json.load(f)
         path = os.path.join(directory, meta["file"])
         return path if os.path.exists(path) else None
+
+    @staticmethod
+    def last_checkpoint_state(directory: str) -> Optional[dict]:
+        """The ``.state.json`` sidecar of the pointed-at checkpoint
+        (iteration/epoch counters, rng stream, iterator cursor), or None
+        for pre-sidecar checkpoints / no checkpoint."""
+        meta_path = os.path.join(directory, _POINTER)
+        if not os.path.exists(meta_path):
+            return None
+        with open(meta_path) as f:
+            meta = json.load(f)
+        state_name = meta.get("state")
+        if state_name is None:
+            return None
+        state_path = os.path.join(directory, state_name)
+        if not os.path.exists(state_path):
+            return None
+        with open(state_path) as f:
+            return json.load(f)
+
+
+def restore_training_state(model, state: Optional[dict],
+                           iterator: Optional[Any] = None) -> None:
+    """Rehydrate the sidecar state onto a restored model (and optionally a
+    freshly built, identically configured data iterator): iteration/epoch
+    counters, the RNG stream position, and the iterator's consumer cursor.
+    After this, continuing training consumes exactly the batches the
+    killed run never did, with the killed run's key sequence — the
+    bit-exact mid-epoch resume contract (tier-1:
+    tools/check_training_resilience_contract.py)."""
+    if state is None:
+        return
+    model.iteration_count = int(state.get(
+        "model_iteration_count", state.get("iteration", 0)))
+    model.epoch_count = int(state.get(
+        "model_epoch_count", state.get("epoch", 0)))
+    rng_state = state.get("rng")
+    rng = getattr(model, "_rng", None)
+    if rng_state is not None and rng is not None:
+        rng.load_state_dict(rng_state)
+    if iterator is not None and state.get("iterator") is not None:
+        iterator.load_state_dict(state["iterator"])
 
 
 class EvaluativeListener(TrainingListener):

@@ -27,6 +27,7 @@ from __future__ import annotations
 import importlib
 import json
 import os
+import signal as _signal
 import subprocess
 import sys
 import threading
@@ -36,7 +37,11 @@ from typing import Callable, List, Optional
 from ..core.listeners import TrainingListener
 
 STALL_EXIT_CODE = 86  # distinct from crash codes: "alive but not progressing"
+# EX_TEMPFAIL: an EXPECTED eviction (pod preemption), not a crash — the
+# supervisor restarts immediately without burning crash budget
+PREEMPTED_EXIT_CODE = 75
 HEARTBEAT_FILE = "heartbeat.json"
+PREEMPTED_MARKER = "preempted"
 
 
 class HeartbeatListener(TrainingListener):
@@ -81,6 +86,8 @@ class Watchdog:
         self._started_at = None
 
     def _default_stall(self) -> None:
+        if self._stop.is_set():  # raced with stop(): the fit finished
+            return
         with open(os.path.join(self.directory, "stalled"), "w") as f:
             f.write(f"no heartbeat progress for {self.timeout}s\n")
         sys.stderr.write("Watchdog: training stalled — exiting for "
@@ -94,8 +101,24 @@ class Watchdog:
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop AND JOIN the checker thread: after stop() returns, no
+        stall can fire. (Setting the event alone left a race — a check
+        already past the wait could still hard-exit a process whose fit
+        had just finished cleanly; _fire re-checks, and the join closes
+        the window for the caller.)"""
         self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=timeout)
+        self._thread = None
+
+    def _fire(self) -> None:
+        """Stall detected: re-check stop() immediately before acting —
+        the only interleaving left is stop() arriving mid-on_stall."""
+        if self._stop.is_set():
+            return
+        self.on_stall()
 
     def _run(self) -> None:
         while not self._stop.wait(self.poll_interval):
@@ -105,8 +128,89 @@ class Watchdog:
             # full grace period to restore + compile before its first beat
             last = max(hb["ts"], self._started_at) if hb else self._started_at
             if time.time() - last > self.timeout:
-                self.on_stall()
+                self._fire()
                 return
+
+
+class PreemptionHandler(TrainingListener):
+    """Preemption-aware stop: SIGTERM/SIGINT (the pod scheduler's
+    eviction notice) becomes "finish the in-flight step, force a final
+    SYNCHRONOUS checkpoint, exit with :data:`PREEMPTED_EXIT_CODE`".
+
+    The signal handler only sets a flag — nothing JAX-unsafe happens in
+    signal context. The NEXT ``iteration_done`` (i.e. after the in-flight
+    step completed and the listener chain ran, so heartbeat/periodic
+    checkpoints for this iteration are already down) performs the final
+    save and exits. ``elastic_fit`` classifies the exit code as a
+    preemption: immediate restart, no backoff, no crash-loop budget.
+
+    Attach AFTER the CheckpointListener/HeartbeatListener and call
+    :meth:`install` from the main thread::
+
+        ckpt = CheckpointListener(dir_, ..., async_save=True, iterator=it)
+        model.add_listeners(ckpt, HeartbeatListener(dir_),
+                            PreemptionHandler(checkpoint=ckpt).install())
+    """
+
+    def __init__(self, checkpoint=None, *,
+                 signals: tuple = (_signal.SIGTERM, _signal.SIGINT),
+                 watchdog: Optional[Watchdog] = None,
+                 exit_fn: Optional[Callable[[int], None]] = None,
+                 log_fn: Callable[[str], None] = None) -> None:
+        self.checkpoint = checkpoint  # CheckpointListener (or None)
+        self.signals = tuple(signals)
+        self.watchdog = watchdog
+        self.directory = getattr(checkpoint, "directory", None)
+        self._exit = exit_fn or os._exit  # noqa: SLF001 — must exit through user code
+        self.log_fn = log_fn
+        self._requested = threading.Event()
+        self.signal_received: Optional[int] = None
+        self._prev_handlers: dict = {}
+
+    def install(self) -> "PreemptionHandler":
+        """Register the signal handlers (main thread only — a CPython
+        restriction on ``signal.signal``)."""
+        for s in self.signals:
+            self._prev_handlers[s] = _signal.signal(s, self._on_signal)
+        return self
+
+    def uninstall(self) -> None:
+        for s, prev in self._prev_handlers.items():
+            _signal.signal(s, prev)
+        self._prev_handlers.clear()
+
+    def _on_signal(self, signum, frame) -> None:
+        self.signal_received = signum
+        self._requested.set()
+
+    @property
+    def requested(self) -> bool:
+        return self._requested.is_set()
+
+    def iteration_done(self, model, iteration: int, epoch: int,
+                       score: float) -> None:
+        if not self._requested.is_set():
+            return
+        if self.watchdog is not None:  # a final sync save is not a stall
+            self.watchdog.stop()
+        ok = True
+        if self.checkpoint is not None:
+            ok = self.checkpoint.save_now(model, iteration, epoch, score)
+        if self.directory is not None:
+            try:
+                with open(os.path.join(self.directory, PREEMPTED_MARKER),
+                          "w") as f:
+                    f.write(f"signal {self.signal_received} at iteration "
+                            f"{iteration}\n")
+            except OSError:
+                pass
+        msg = (f"PreemptionHandler: signal {self.signal_received} — final "
+               f"checkpoint at iteration {iteration} "
+               f"{'saved' if ok else 'FAILED'}, exiting "
+               f"{PREEMPTED_EXIT_CODE}")
+        (self.log_fn or (lambda m: (sys.stderr.write(m + "\n"),
+                                    sys.stderr.flush())))(msg)
+        self._exit(PREEMPTED_EXIT_CODE)
 
 
 def _resolve(ref: str) -> Callable:
@@ -120,7 +224,10 @@ def _child_main() -> None:
     from .checkpoint import CheckpointListener
 
     resume = CheckpointListener.last_checkpoint(checkpoint_dir)
-    Watchdog(checkpoint_dir, timeout=timeout).start()
+    # sub-second stall timeouts (tests, chaos harness) need a matching
+    # poll cadence; production keeps the cheap 5s poll
+    Watchdog(checkpoint_dir, timeout=timeout,
+             poll_interval=min(5.0, max(0.05, timeout / 4.0))).start()
     _resolve(ref)(resume, checkpoint_dir)
 
 
@@ -146,6 +253,7 @@ def elastic_fit(entry_ref: str, checkpoint_dir: str, *,
                 spawn_fn: Optional[Callable[[], int]] = None,
                 sleep: Callable[[float], None] = time.sleep,
                 clock: Callable[[], float] = time.monotonic,
+                max_preemptions: Optional[int] = None,
                 registry=None) -> dict:
     """Supervised training: run ``entry_ref`` ("module:function") in a child
     process; restart from the latest checkpoint on crash or stall.
@@ -160,9 +268,19 @@ def elastic_fit(entry_ref: str, checkpoint_dir: str, *,
     ``elastic_fit.spawn`` FaultInjector site fires before every child
     launch, so the whole recovery path is testable without subprocesses.
 
-    Returns {"restarts": n, "events": [...], "ok": bool}. The entry function
-    must attach CheckpointListener(checkpoint_dir, ...) and
-    HeartbeatListener(checkpoint_dir) itself — it owns the model and data.
+    Exit-code classification: ``PREEMPTED_EXIT_CODE`` (a
+    :class:`PreemptionHandler` stop — the child already forced a final
+    sync checkpoint) restarts IMMEDIATELY: no backoff, and it consumes
+    neither ``max_restarts`` nor the crash-loop budget — preemption is
+    the pod's routine operation, not a failure of ours.
+    ``max_preemptions`` optionally bounds an eviction storm (None =
+    scheduler-driven, unbounded); ``STALL_EXIT_CODE`` and everything
+    else keep the crash discipline unchanged.
+
+    Returns {"restarts": n, "preemptions": p, "events": [...], "ok": bool}.
+    The entry function must attach CheckpointListener(checkpoint_dir, ...)
+    and HeartbeatListener(checkpoint_dir) itself — it owns the model and
+    data.
     """
     from ..core.resilience import RetryPolicy, get_fault_injector
     from ..obs.metrics import get_registry
@@ -187,6 +305,7 @@ def elastic_fit(entry_ref: str, checkpoint_dir: str, *,
     events: List[dict] = []
     restart_times: List[float] = []
     restarts = 0
+    preemptions = 0
     while True:
         get_fault_injector().fire("elastic_fit.spawn")
         rc = (spawn_fn or (lambda: _spawn_child(
@@ -194,17 +313,34 @@ def elastic_fit(entry_ref: str, checkpoint_dir: str, *,
         if rc == 0:
             events.append({"event": "completed", "restarts": restarts})
             record("completed", restarts=restarts)
-            return {"ok": True, "restarts": restarts, "events": events}
-        kind = "stall" if rc == STALL_EXIT_CODE else "crash"
+            return {"ok": True, "restarts": restarts,
+                    "preemptions": preemptions, "events": events}
+        kind = ("stall" if rc == STALL_EXIT_CODE
+                else "preempted" if rc == PREEMPTED_EXIT_CODE else "crash")
         hb = read_heartbeat(checkpoint_dir)
         events.append({"event": kind, "rc": rc, "last_heartbeat": hb})
         record(kind, rc=rc)
         log_fn(f"elastic_fit: child {kind} (rc={rc}), last iteration "
                f"{hb['iteration'] if hb else 'none'}")
+        if kind == "preempted":
+            # expected eviction: the child checkpointed and asked to be
+            # rescheduled — restart NOW, burn no crash budget of any kind
+            preemptions += 1
+            if max_preemptions is not None and preemptions > max_preemptions:
+                events.append({"event": "gave_up", "restarts": restarts,
+                               "preemptions": preemptions})
+                record("gave_up", restarts=restarts)
+                log_fn(f"elastic_fit: {preemptions} preemptions exceed "
+                       f"max_preemptions={max_preemptions}, giving up")
+                return {"ok": False, "restarts": restarts,
+                        "preemptions": preemptions, "events": events}
+            c_restarts.inc()
+            continue
         if restarts >= max_restarts:
             events.append({"event": "gave_up", "restarts": restarts})
             record("gave_up", restarts=restarts)
-            return {"ok": False, "restarts": restarts, "events": events}
+            return {"ok": False, "restarts": restarts,
+                    "preemptions": preemptions, "events": events}
         now = clock()
         restart_times = [t for t in restart_times
                          if now - t <= crash_loop_window]
@@ -214,7 +350,8 @@ def elastic_fit(entry_ref: str, checkpoint_dir: str, *,
             record("crash_loop", restarts=restarts)
             log_fn(f"elastic_fit: crash loop — {len(restart_times) + 1} "
                    f"failures within {crash_loop_window}s, giving up")
-            return {"ok": False, "restarts": restarts, "events": events}
+            return {"ok": False, "restarts": restarts,
+                    "preemptions": preemptions, "events": events}
         restart_times.append(now)
         delay = policy.backoff(restarts)
         events.append({"event": "backoff", "delay_s": delay})

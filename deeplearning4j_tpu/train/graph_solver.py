@@ -140,6 +140,40 @@ class GraphSolver:
             prof.end_step()
         return score
 
+    def fit_iterator(self, iterator, *, epochs: int = 1) -> float:
+        """DataSet/MultiDataSet iterator training with exact mid-epoch
+        resume semantics — see :meth:`Solver.fit_iterator` (solver.py):
+        consumption starts at the iterator's CURRENT position, reset()
+        only when exhausted."""
+        from ..data.dataset import MultiDataSet
+
+        model = self.model
+        sync = bool(model.listeners.listeners)
+        last = None
+        for _ in range(epochs):
+            if not iterator.has_next():
+                iterator.reset()
+            model.listeners.epoch_start(model)
+            while iterator.has_next():
+                ds = iterator.next()
+                if isinstance(ds, MultiDataSet):
+                    xs, ys = tuple(ds.features), tuple(ds.labels)
+                else:
+                    xs, ys = (ds.features,), (ds.labels,)
+                score = self.fit_batch(xs, ys)
+                last = score
+                model.iteration_count += 1
+                if sync:
+                    model.score_value = float(score)
+                    model.listeners.iteration_done(
+                        model, model.iteration_count, model.epoch_count,
+                        model.score_value)
+            model.listeners.epoch_end(model)
+            model.epoch_count += 1
+        if last is not None:
+            model.score_value = float(last)
+        return model.score_value
+
     def fit(self, data, labels=None, *, epochs: int = 1) -> None:
         model = self.model
         sync_every_iter = bool(model.listeners.listeners)

@@ -310,6 +310,39 @@ class Solver:
         model.last_batch_size = int(x.shape[1])
         return score
 
+    def fit_iterator(self, iterator, *, epochs: int = 1) -> float:
+        """Train from a ``DataSetIterator`` WITHOUT resetting away its
+        current position: consumption starts wherever the iterator
+        stands, so an iterator repositioned by ``load_state_dict()``
+        (train/checkpoint.py sidecar) resumes EXACTLY mid-epoch —
+        finishing the interrupted epoch counts as the first of
+        ``epochs``. An exhausted iterator is reset() at each epoch top
+        (the normal fresh-epoch path). Listeners fire per iteration and
+        per epoch exactly as in :meth:`fit`."""
+        model = self.model
+        sync = bool(model.listeners.listeners)
+        last = None
+        for _ in range(epochs):
+            if not iterator.has_next():
+                iterator.reset()
+            model.listeners.epoch_start(model)
+            while iterator.has_next():
+                ds = iterator.next()
+                score, _ = self.fit_batch(ds.features, ds.labels,
+                                          ds.features_mask, ds.labels_mask)
+                last = score
+                model.iteration_count += 1
+                if sync:
+                    model.score_value = float(score)
+                    model.listeners.iteration_done(
+                        model, model.iteration_count, model.epoch_count,
+                        model.score_value)
+            model.listeners.epoch_end(model)
+            model.epoch_count += 1
+        if last is not None:
+            model.score_value = float(last)
+        return model.score_value
+
     def fit(self, data, labels=None, *, epochs: int = 1, mask=None, label_mask=None) -> None:
         model = self.model
         from ..nn.sequential import _as_batches

@@ -32,6 +32,9 @@ def test_select_rows_filters_exactly():
     # ISSUE 14: the large-batch row is a standalone CI entry point
     sel = bench.select_rows("large_batch_scaling")
     assert sel == {"large_batch_scaling": "large_batch_scaling"}
+    # ISSUE 15: the checkpoint-stall row gates the async writer
+    sel = bench.select_rows("checkpoint_stall")
+    assert sel == {"checkpoint_stall": "checkpoint_stall"}
     # every selectable row maps to a registered measurement
     for row, meas in {**bench._EXTRA_ROWS, **bench._CHIP_ONLY_ROWS}.items():
         assert meas in bench._MEASUREMENTS, (row, meas)
@@ -67,6 +70,7 @@ def test_cli_list_rows_and_unknown_row_exit():
     assert "quantized_infer_speedup" in listing["rows"]
     assert "int8_kv_cache" in listing["rows"]
     assert "large_batch_scaling" in listing["rows"]
+    assert "checkpoint_stall" in listing["rows"]
     # an unknown row fails fast (exit 2, error names the row) BEFORE any
     # probe/measurement work
     bad = subprocess.run([sys.executable, _BENCH, "--rows", "nope"],

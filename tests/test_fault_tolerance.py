@@ -1,15 +1,23 @@
 """Failure detection + elastic restart (SURVEY.md §5.3): the supervisor
 must resume training from the latest checkpoint after a crash, and the
-watchdog must detect a stalled (wedged-device-shaped) child."""
+watchdog must detect a stalled (wedged-device-shaped) child. ISSUE 15
+adds: preemption-aware stop (PREEMPTED_EXIT_CODE classification — no
+backoff, no crash budget), async checkpointing semantics (bounded
+writer, crash-consistent pointer, fault tolerance, keep_last across
+restarts, decoupled triggers), and the Watchdog stop() race fix."""
 
 import os
 import textwrap
+import threading
 import time
 
+import numpy as np
 import pytest
 
 from deeplearning4j_tpu.train.fault_tolerance import (
+    PREEMPTED_EXIT_CODE,
     HeartbeatListener,
+    PreemptionHandler,
     Watchdog,
     elastic_fit,
     read_heartbeat,
@@ -221,6 +229,426 @@ class TestElasticRestartDiscipline:
         result = elastic_fit("unused:train", str(tmp_path),
                              spawn_fn=lambda: 0, log_fn=lambda m: None)
         assert result["ok"]
+
+
+class TestPreemptionClassification:
+    """elastic_fit exit-code semantics (ISSUE 15): PREEMPTED_EXIT_CODE
+    restarts immediately — no backoff sleep, no crash-loop budget, no
+    max_restarts consumption — while real crashes keep the old
+    discipline. All deterministic via spawn_fn/clock stubs."""
+
+    @staticmethod
+    def _clock_sleep():
+        t = [0.0]
+        slept = []
+
+        def clock():
+            return t[0]
+
+        def sleep(dt):
+            slept.append(dt)
+            t[0] += dt
+
+        return t, slept, clock, sleep
+
+    def test_preemption_restarts_without_backoff(self, tmp_path):
+        rcs = iter([PREEMPTED_EXIT_CODE, 0])
+        _, slept, clock, sleep = self._clock_sleep()
+        result = elastic_fit(
+            "unused:train", str(tmp_path), max_restarts=0,
+            spawn_fn=lambda: next(rcs), sleep=sleep, clock=clock,
+            log_fn=lambda m: None)
+        assert result["ok"]
+        assert result["preemptions"] == 1
+        assert result["restarts"] == 0  # no crash budget consumed
+        assert slept == []              # immediate restart
+        kinds = [e["event"] for e in result["events"]]
+        assert kinds == ["preempted", "completed"]
+
+    def test_preemptions_do_not_trip_crash_loop(self, tmp_path):
+        rcs = iter([PREEMPTED_EXIT_CODE] * 5 + [0])
+        _, slept, clock, sleep = self._clock_sleep()
+        result = elastic_fit(
+            "unused:train", str(tmp_path), max_restarts=2,
+            crash_loop_window=600.0, crash_loop_budget=2,
+            spawn_fn=lambda: next(rcs), sleep=sleep, clock=clock,
+            log_fn=lambda m: None)
+        # 5 back-to-back preemptions inside the window: still completes
+        assert result["ok"] and result["preemptions"] == 5
+        assert result["restarts"] == 0 and slept == []
+
+    def test_crash_semantics_unchanged_next_to_preemptions(self, tmp_path):
+        rcs = iter([PREEMPTED_EXIT_CODE, 1, 1, 1, 1])
+        _, slept, clock, sleep = self._clock_sleep()
+        result = elastic_fit(
+            "unused:train", str(tmp_path), max_restarts=3,
+            crash_loop_window=0.0,
+            spawn_fn=lambda: next(rcs), sleep=sleep, clock=clock,
+            log_fn=lambda m: None)
+        assert not result["ok"]
+        assert result["events"][-1]["event"] == "gave_up"
+        assert result["restarts"] == 3 and result["preemptions"] == 1
+        assert len(slept) == 3  # backoffs only for the crashes
+
+    def test_max_preemptions_bounds_eviction_storm(self, tmp_path):
+        result = elastic_fit(
+            "unused:train", str(tmp_path), max_restarts=5,
+            max_preemptions=2,
+            spawn_fn=lambda: PREEMPTED_EXIT_CODE,
+            sleep=lambda dt: None, clock=lambda: 0.0,
+            log_fn=lambda m: None)
+        assert not result["ok"]
+        assert result["preemptions"] == 3  # the one over budget included
+        assert result["events"][-1]["event"] == "gave_up"
+
+    def test_preempted_metric_label(self, tmp_path):
+        from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        rcs = iter([PREEMPTED_EXIT_CODE, 0])
+        elastic_fit("unused:train", str(tmp_path), registry=reg,
+                    spawn_fn=lambda: next(rcs), sleep=lambda dt: None,
+                    clock=lambda: 0.0, log_fn=lambda m: None)
+        c = reg.counter("dl4j_tpu_training_elastic_events_total", "",
+                        ("event",))
+        assert c.labels("preempted").value == 1
+        assert c.labels("completed").value == 1
+        r = reg.counter("dl4j_tpu_training_restarts_total", "")
+        assert r.value == 1  # the preemption restart IS a restart
+
+
+class TestPreemptionHandler:
+    def _model(self):
+        class FakeModel:
+            iteration_count = 7
+            epoch_count = 1
+
+        return FakeModel()
+
+    def test_signal_sets_flag_and_next_iteration_exits(self, tmp_path):
+        exits = []
+        saves = []
+
+        class FakeCkpt:
+            directory = str(tmp_path)
+
+            def save_now(self, model, iteration=None, epoch=None,
+                         score=float("nan")):
+                saves.append((iteration, epoch))
+                return True
+
+        h = PreemptionHandler(checkpoint=FakeCkpt(),
+                              exit_fn=exits.append, log_fn=lambda m: None)
+        assert not h.requested
+        h.iteration_done(self._model(), 7, 1, 0.5)
+        assert exits == [] and saves == []  # nothing requested yet
+        h._on_signal(15, None)
+        assert h.requested
+        h.iteration_done(self._model(), 8, 1, 0.4)
+        assert saves == [(8, 1)]
+        assert exits == [PREEMPTED_EXIT_CODE]
+        assert os.path.exists(os.path.join(str(tmp_path), "preempted"))
+
+    def test_install_uninstall_roundtrip(self):
+        import signal as _sig
+
+        h = PreemptionHandler(exit_fn=lambda c: None, log_fn=lambda m: None,
+                              signals=(_sig.SIGUSR1,))
+        prev = _sig.getsignal(_sig.SIGUSR1)
+        h.install()
+        assert _sig.getsignal(_sig.SIGUSR1) == h._on_signal
+        h.uninstall()
+        assert _sig.getsignal(_sig.SIGUSR1) == prev
+
+    def test_stops_watchdog_before_final_save(self, tmp_path):
+        order = []
+
+        class FakeWd:
+            def stop(self, timeout=5.0):
+                order.append("wd_stop")
+
+        class FakeCkpt:
+            directory = str(tmp_path)
+
+            def save_now(self, *a, **kw):
+                order.append("save")
+                return True
+
+        h = PreemptionHandler(checkpoint=FakeCkpt(), watchdog=FakeWd(),
+                              exit_fn=lambda c: order.append("exit"),
+                              log_fn=lambda m: None)
+        h._on_signal(15, None)
+        h.iteration_done(self._model(), 9, 1, 0.1)
+        assert order == ["wd_stop", "save", "exit"]
+
+
+class TestWatchdogStopRace:
+    def test_stop_joins_thread(self, tmp_path):
+        wd = Watchdog(str(tmp_path), timeout=30.0, poll_interval=0.05,
+                      on_stall=lambda: None)
+        wd.start()
+        t = wd._thread
+        wd.stop()
+        assert t is not None and not t.is_alive()
+        assert wd._thread is None
+
+    def test_fire_rechecks_stop(self, tmp_path):
+        """The race fix: a stall check that decided to fire re-checks
+        the stop event immediately before acting, so a stop() landing
+        after the timeout comparison cannot hard-exit a finished fit."""
+        fired = []
+        wd = Watchdog(str(tmp_path), timeout=0.0, poll_interval=0.01,
+                      on_stall=lambda: fired.append(True))
+        wd._stop.set()   # stop() won the race between check and fire
+        wd._fire()
+        assert not fired
+        wd._stop.clear()
+        wd._fire()
+        assert fired
+
+    def test_default_stall_noop_after_stop(self, tmp_path):
+        # _default_stall would os._exit: with stop set it must return
+        # (reaching os._exit here would kill the pytest process)
+        wd = Watchdog(str(tmp_path), timeout=0.1)
+        wd._stop.set()
+        wd._default_stall()
+        assert not os.path.exists(os.path.join(str(tmp_path), "stalled"))
+
+    def test_stop_from_on_stall_thread_does_not_deadlock(self, tmp_path):
+        done = threading.Event()
+
+        def stall():
+            wd.stop()  # stop() from the checker thread itself
+            done.set()
+
+        wd = Watchdog(str(tmp_path), timeout=0.0, poll_interval=0.01,
+                      on_stall=stall)
+        wd.start()
+        assert done.wait(timeout=5.0)
+
+
+def _tiny_model():
+    from deeplearning4j_tpu.nn import (
+        Activation, InputType, LossFunction, NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01))
+            .list()
+            .layer(DenseLayer(n_out=6, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=2, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _tiny_data(n=16):
+    rng = np.random.RandomState(0)
+    x = rng.rand(n, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, n)]
+    return x, y
+
+
+class TestAsyncCheckpointListener:
+    def _reg(self):
+        from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+
+        return MetricsRegistry()
+
+    def test_async_artifact_matches_sync(self, tmp_path):
+        from deeplearning4j_tpu.model.serializer import restore_model
+        from deeplearning4j_tpu.train.checkpoint import CheckpointListener
+
+        x, y = _tiny_data()
+        m = _tiny_model()
+        d_sync, d_async = str(tmp_path / "s"), str(tmp_path / "a")
+        cs = CheckpointListener(d_sync, save_every_n_iterations=1,
+                                registry=self._reg())
+        ca = CheckpointListener(d_async, save_every_n_iterations=1,
+                                async_save=True, registry=self._reg())
+        m.add_listeners(cs, ca)
+        m.fit(x, y, epochs=3)
+        ca.close()
+        p_s = CheckpointListener.last_checkpoint(d_sync)
+        p_a = CheckpointListener.last_checkpoint(d_async)
+        r_s = restore_model(p_s, load_updater=True)
+        r_a = restore_model(p_a, load_updater=True)
+        for ln in r_s.params:
+            for pn in r_s.params[ln]:
+                np.testing.assert_array_equal(
+                    np.asarray(r_s.params[ln][pn]),
+                    np.asarray(r_a.params[ln][pn]))
+        st_s = CheckpointListener.last_checkpoint_state(d_sync)
+        st_a = CheckpointListener.last_checkpoint_state(d_async)
+        assert st_s["iteration"] == st_a["iteration"] == 3
+        assert st_s["rng"] == st_a["rng"]
+
+    def test_bounded_queue_supersedes_oldest(self, tmp_path):
+        from deeplearning4j_tpu.train.checkpoint import CheckpointListener
+
+        ck = CheckpointListener(str(tmp_path), save_every_n_iterations=1,
+                                async_save=True, max_pending_writes=2,
+                                registry=self._reg())
+        # hold the writer hostage by filling the queue before it starts:
+        # enqueue without a started writer is impossible (started on
+        # first enqueue), so block it with a slow first job instead
+        ev = threading.Event()
+
+        class SlowSnap:
+            class_name = "MultiLayerNetwork"
+
+            @property
+            def conf(self):
+                ev.wait(5.0)
+                raise RuntimeError("slow job done")
+
+            params = {}
+            state = {}
+            _trainer = None
+
+        for i in range(5):
+            ck._enqueue({"model": SlowSnap(), "iteration": i, "epoch": 0,
+                         "sidecar": {}})
+        with ck._q_cond:
+            pending = len(ck._q)
+        assert pending <= 2
+        ev.set()
+        ck.close()
+        # all the "writes" failed (RuntimeError) but nothing raised and
+        # the failure counter moved — the keep-training contract
+        assert ck._c_failures.value >= 1
+
+    def test_write_fault_keeps_training_and_counts(self, tmp_path):
+        from deeplearning4j_tpu.core.resilience import (
+            FaultInjector, set_fault_injector)
+        from deeplearning4j_tpu.train.checkpoint import (
+            CHECKPOINT_WRITE_SITE, CheckpointListener)
+
+        x, y = _tiny_data()
+        m = _tiny_model()
+        reg = self._reg()
+        ck = CheckpointListener(str(tmp_path), save_every_n_iterations=1,
+                                registry=reg)
+        m.add_listeners(ck)
+        inj = FaultInjector()
+        inj.inject_error(CHECKPOINT_WRITE_SITE,
+                         lambda: OSError("disk full"), times=2)
+        prev = set_fault_injector(inj)
+        try:
+            with pytest.warns(UserWarning, match="checkpoint save failed"):
+                m.fit(x, y, epochs=2)  # both saves fail, fit survives
+            m.fit(x, y, epochs=1)      # injection exhausted: save lands
+        finally:
+            set_fault_injector(prev)
+        assert reg.counter(
+            "dl4j_tpu_training_checkpoint_failures_total", "").value == 2
+        assert CheckpointListener.last_checkpoint_state(
+            str(tmp_path))["iteration"] == 3
+
+    def test_pointer_only_moves_forward(self, tmp_path):
+        from deeplearning4j_tpu.train.checkpoint import CheckpointListener
+
+        x, y = _tiny_data()
+        m = _tiny_model()
+        ck = CheckpointListener(str(tmp_path), save_every_n_iterations=1,
+                                registry=self._reg())
+        m.add_listeners(ck)
+        m.fit(x, y, epochs=2)
+        newest = ck._snapshot(m, 2, 0)
+        stale = ck._snapshot(m, 1, 0)
+        assert ck._write(newest, "sync")
+        assert ck._write(stale, "sync")  # writes the zip, not the pointer
+        st = CheckpointListener.last_checkpoint_state(str(tmp_path))
+        assert st["iteration"] == 2
+
+    def test_keep_last_prunes_pre_restart_files(self, tmp_path):
+        """ISSUE 15 satellite: a fresh listener (a restarted run) must
+        enumerate existing checkpoints so keep_last holds ACROSS restart
+        cycles instead of growing the directory unboundedly."""
+        from deeplearning4j_tpu.train.checkpoint import CheckpointListener
+
+        x, y = _tiny_data()
+        m = _tiny_model()
+        ck1 = CheckpointListener(str(tmp_path), save_every_n_iterations=1,
+                                 keep_last=3, registry=self._reg())
+        m.add_listeners(ck1)
+        m.fit(x, y, epochs=3)
+        assert len([f for f in os.listdir(tmp_path)
+                    if f.endswith(".zip")]) == 3
+        # "restart": new listener, same dir
+        m2 = _tiny_model()
+        m2.iteration_count = 3
+        ck2 = CheckpointListener(str(tmp_path), save_every_n_iterations=1,
+                                 keep_last=3, registry=self._reg())
+        m2.add_listeners(ck2)
+        m2.fit(x, y, epochs=2)
+        zips = sorted(f for f in os.listdir(tmp_path) if f.endswith(".zip"))
+        assert len(zips) == 3, zips
+        assert "checkpoint_iter1_epoch0.zip" not in zips
+        # sidecars pruned alongside
+        states = [f for f in os.listdir(tmp_path)
+                  if f.endswith(".state.json")]
+        assert len(states) == 3
+
+    def test_triggers_decoupled_and_iteration_zero_skipped(self, tmp_path):
+        from deeplearning4j_tpu.train.checkpoint import CheckpointListener
+
+        saved = []
+        ck = CheckpointListener(str(tmp_path), save_every_n_iterations=4,
+                                save_every_n_seconds=0.05,
+                                registry=self._reg())
+        ck._save = lambda model, it, ep, score=float("nan"): saved.append(it)
+        m = object()
+        ck.iteration_done(m, 0, 0, 0.1)      # iteration 0 never saves
+        assert saved == []
+        ck.iteration_done(m, 4, 0, 0.1)      # iteration trigger
+        assert saved == [4]
+        ck._last_save_time = time.time() - 1.0
+        ck.iteration_done(m, 5, 0, 0.1)      # TIME trigger despite 5 % 4
+        assert saved == [4, 5]
+        ck._last_save_time = time.time()
+        ck.iteration_done(m, 6, 0, 0.1)      # neither trigger due
+        assert saved == [4, 5]
+
+    def test_prune_never_evicts_pointer_target(self, tmp_path):
+        """Regression (found driving the preemption path): keep_last
+        pruning evicted in COMPLETION order, so a forced final sync save
+        landing before stale async stragglers was deleted — the pointer
+        then named a missing file. Eviction must follow (epoch,
+        iteration) order and spare the pointer target."""
+        from deeplearning4j_tpu.train.checkpoint import CheckpointListener
+
+        x, y = _tiny_data()
+        m = _tiny_model()
+        ck = CheckpointListener(str(tmp_path), save_every_n_iterations=1,
+                                keep_last=2, registry=self._reg())
+        m.add_listeners(ck)
+        m.fit(x, y, epochs=2)
+        # forced final save first, then stale writes complete after it
+        newest = ck._snapshot(m, 9, 3)
+        assert ck._write(newest, "sync")
+        for it in (5, 6, 7):
+            assert ck._write(ck._snapshot(m, it, 2), "async")
+        path = CheckpointListener.last_checkpoint(str(tmp_path))
+        assert path is not None and path.endswith("iter9_epoch3.zip")
+        zips = sorted(f for f in os.listdir(tmp_path) if f.endswith(".zip"))
+        assert len(zips) == 2 and "checkpoint_iter9_epoch3.zip" in zips
+
+    def test_save_now_is_sync_and_durable(self, tmp_path):
+        from deeplearning4j_tpu.train.checkpoint import CheckpointListener
+
+        x, y = _tiny_data()
+        m = _tiny_model()
+        ck = CheckpointListener(str(tmp_path), save_every_n_iterations=100,
+                                async_save=True, registry=self._reg())
+        m.add_listeners(ck)
+        m.fit(x, y, epochs=1)
+        assert CheckpointListener.last_checkpoint(str(tmp_path)) is None
+        assert ck.save_now(m)
+        st = CheckpointListener.last_checkpoint_state(str(tmp_path))
+        assert st["iteration"] == m.iteration_count
+        ck.close()
 
 
 def test_watchdog_ignores_stale_heartbeat_on_restart(tmp_path):

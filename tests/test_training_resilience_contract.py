@@ -1,0 +1,22 @@
+"""Tier-1 wiring for tools/check_training_resilience_contract.py: the
+fault-tolerant-training chaos contract (README.md "Fault-tolerant
+training") — SIGKILL a real child trainer at a random mid-epoch
+iteration and resume bit-identically with a provably non-overlapping /
+non-skipping consumed-batch sequence, SIGTERM checkpoints and exits
+PREEMPTED_EXIT_CODE with zero lost iterations, and an injected stall
+takes the watchdog path — is enforced on every test run, not just when
+someone remembers to run the tool."""
+
+import os
+import sys
+
+_TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+
+
+def test_training_resilience_contract_smoke():
+    sys.path.insert(0, _TOOLS)
+    try:
+        import check_training_resilience_contract
+    finally:
+        sys.path.remove(_TOOLS)
+    assert check_training_resilience_contract.main(log=lambda m: None) == 0

@@ -1,0 +1,430 @@
+#!/usr/bin/env python
+"""Fault-tolerant-training chaos harness (README.md "Fault-tolerant
+training") — the training-side counterpart of the PR-12 serving fabric
+harness.
+
+Runs a REAL supervised trainer (``elastic_fit`` spawning child
+processes; async CheckpointListener every iteration with the iterator
+cursor + rng sidecar; heartbeat + watchdog; PreemptionHandler) through
+four legs and proves the resume story end to end:
+
+  1. **uninterrupted** — the reference run: final params, per-iteration
+     loss curve, and the consumed-batch sequence (logged at the consumer
+     with content hashes);
+  2. **SIGKILL** at a random mid-epoch iteration — the supervisor
+     classifies a crash, restarts from the last committed checkpoint,
+     and the finished run's params ARE BIT-IDENTICAL to leg 1. The
+     consumed-batch logs prove the resume consumed exactly the batches
+     whose updates the kill destroyed — no batch trained twice, none
+     skipped (committed prefix + resume sequence == uninterrupted
+     sequence). The pointer file named a fully-fsynced artifact even
+     though the writer was async and the kill was SIGKILL;
+  3. **SIGTERM** (pod preemption notice) — the child finishes the
+     in-flight step, forces a final SYNC checkpoint, and exits
+     ``PREEMPTED_EXIT_CODE`` with ZERO lost iterations (final
+     checkpoint iteration == last heartbeat iteration); ``elastic_fit``
+     restarts immediately without burning crash budget and the finished
+     params are again bit-identical;
+  4. **stall** (wedged-device shape: the step loop stops beating) — the
+     watchdog hard-exits ``STALL_EXIT_CODE``, the supervisor restarts,
+     bit-identical finish.
+
+Runs standalone (``python tools/check_training_resilience_contract.py``)
+and as a tier-1 pytest via tests/test_training_resilience_contract.py.
+``DL4J_CHAOS_SEED`` pins the kill points for reproduction.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.join(_TOOLS_DIR, os.pardir)
+sys.path.insert(0, _REPO_ROOT)
+
+ENTRY_REF = "check_training_resilience_contract:train_entry"
+TOTAL_ITERS = 24     # 3 epochs x 8 batches
+BATCH = 8
+N_ROWS = 64
+CONSUMED_LOG = "consumed.log"
+SCORES_LOG = "scores.log"
+FINAL_NPZ = "final.npz"
+
+
+# ---------------------------------------------------------------------------
+# child-side pieces (imported by the spawned trainer)
+# ---------------------------------------------------------------------------
+
+class _AppendLog:
+    """Crash-safe append log: one fsync'd line per event, plus a RUN
+    marker per process so the parent can split the runs apart."""
+
+    def __init__(self, path: str) -> None:
+        self._f = open(path, "a")
+        self.write(f"RUN {os.getpid()}")
+
+    def write(self, line: str) -> None:
+        self._f.write(line + "\n")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+
+class _LoggingIterator:
+    """Wraps the training iterator OUTSIDE the async prefetcher: each
+    batch is hashed as the consumer receives it — the ground truth for
+    the non-overlapping / non-skipping proof."""
+
+    def __init__(self, underlying, log: _AppendLog) -> None:
+        self.underlying = underlying
+        self.log = log
+
+    def has_next(self):
+        return self.underlying.has_next()
+
+    def next(self):
+        import numpy as np
+
+        ds = self.underlying.next()
+        digest = hashlib.sha1(
+            np.ascontiguousarray(np.asarray(ds.features)).tobytes()
+        ).hexdigest()[:12]
+        self.log.write(digest)
+        return ds
+
+    def reset(self):
+        self.underlying.reset()
+
+    def batch_size(self):
+        return self.underlying.batch_size()
+
+    def state_dict(self):
+        return self.underlying.state_dict()
+
+    def load_state_dict(self, state):
+        self.underlying.load_state_dict(state)
+
+    def close(self, *a, **kw):
+        c = getattr(self.underlying, "close", None)
+        if callable(c):
+            c(*a, **kw)
+
+
+def _build_model():
+    from deeplearning4j_tpu.nn import (
+        Activation, InputType, LossFunction, NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(17).updater(Adam(0.02))
+            .list()
+            .layer(DenseLayer(n_out=8, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=2, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _make_iterator(log: _AppendLog):
+    import numpy as np
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import (
+        AsyncDataSetIterator, ListDataSetIterator)
+
+    rng = np.random.RandomState(3)
+    x = rng.rand(N_ROWS, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, N_ROWS)]
+    base = ListDataSetIterator(DataSet(x, y), BATCH, shuffle=True, seed=11)
+    # async prefetch BETWEEN the cursor-owning base iterator and the
+    # logging consumer: the kill legs exercise the run-ahead-not-counted
+    # property of the async state protocol, not just the happy path
+    return _LoggingIterator(
+        AsyncDataSetIterator(base, queue_size=4), log)
+
+
+def train_entry(resume_path, checkpoint_dir):
+    """elastic_fit entry point — fresh or resumed, it trains to exactly
+    TOTAL_ITERS iterations with per-iteration async checkpoints."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.flatten_util import ravel_pytree
+
+    from deeplearning4j_tpu.core.listeners import TrainingListener
+    from deeplearning4j_tpu.model.serializer import restore_model
+    from deeplearning4j_tpu.train.checkpoint import (
+        CheckpointListener, restore_training_state)
+    from deeplearning4j_tpu.train.fault_tolerance import (
+        HeartbeatListener, PreemptionHandler)
+    from deeplearning4j_tpu.train.solver import Solver
+
+    consumed = _AppendLog(os.path.join(checkpoint_dir, CONSUMED_LOG))
+    scores = _AppendLog(os.path.join(checkpoint_dir, SCORES_LOG))
+    it = _make_iterator(consumed)
+    if resume_path:
+        model = restore_model(resume_path, load_updater=True)
+        state = CheckpointListener.last_checkpoint_state(checkpoint_dir)
+    else:
+        model = _build_model()
+        state = None
+    solver = model._trainer if model._trainer is not None else Solver(model)
+    model._trainer = solver
+    restore_training_state(model, state, iterator=it)
+
+    ckpt = CheckpointListener(
+        checkpoint_dir, save_every_n_iterations=1, async_save=True,
+        iterator=it, keep_last=5, log_fn=lambda m: None)
+
+    class _ScoreLog(TrainingListener):
+        def iteration_done(self, model, iteration, epoch, score):
+            scores.write(f"{iteration} {float(score)!r}")
+
+    class _Pacer(TrainingListener):
+        """A real model's step time (~tens of ms): without it the toy
+        MLP finishes all iterations faster than one zip write and the
+        parent's mid-epoch signals cannot land where they aim."""
+
+        def iteration_done(self, model, iteration, epoch, score):
+            time.sleep(0.05)
+
+    class _Staller(TrainingListener):
+        """Wedged-device simulation: the step loop stops beating AFTER
+        iteration ``at`` committed — the watchdog must hard-exit."""
+
+        def __init__(self, at: int) -> None:
+            self.at = at
+
+        def iteration_done(self, model, iteration, epoch, score):
+            if iteration == self.at:
+                while True:
+                    time.sleep(0.1)
+
+    listeners = [ckpt, HeartbeatListener(checkpoint_dir), _ScoreLog(),
+                 _Pacer()]
+    stall_at = int(os.environ.get("DL4J_TEST_STALL_AT_ITER", "0"))
+    if stall_at:
+        listeners.append(_Staller(stall_at))
+    listeners.append(PreemptionHandler(checkpoint=ckpt).install())
+    model.add_listeners(*listeners)
+
+    while model.iteration_count < TOTAL_ITERS:
+        solver.fit_iterator(it, epochs=1)
+    ckpt.close()
+    it.close()
+    flat, _ = ravel_pytree(model.params)
+    np.savez(os.path.join(checkpoint_dir, FINAL_NPZ),
+             params=np.asarray(flat),
+             iteration=model.iteration_count,
+             score=float(model.score_value))
+
+
+# ---------------------------------------------------------------------------
+# parent-side orchestration
+# ---------------------------------------------------------------------------
+
+def _child_env():
+    py_path = os.pathsep.join(
+        [_TOOLS_DIR, os.path.abspath(_REPO_ROOT),
+         os.environ.get("PYTHONPATH", "")])
+    return {"PYTHONPATH": py_path, "JAX_PLATFORMS": "cpu"}
+
+
+class _ChaosSpawner:
+    """elastic_fit spawn_fn that runs the real child trainer via Popen
+    and, on the FIRST run only, delivers ``sig`` once the heartbeat
+    reaches ``kill_at``. Records per-run exit codes and the committed
+    checkpoint state observed between child death and restart."""
+
+    def __init__(self, ckpt_dir: str, *, kill_at=None, sig=None,
+                 stall_timeout: float = 300.0, extra_env=None) -> None:
+        self.ckpt_dir = ckpt_dir
+        self.kill_at = kill_at
+        self.sig = sig
+        self.stall_timeout = stall_timeout
+        self.extra_env = extra_env or {}
+        self.rcs = []
+        self.committed_between = []
+
+    def __call__(self) -> int:
+        from deeplearning4j_tpu.train.checkpoint import CheckpointListener
+        from deeplearning4j_tpu.train.fault_tolerance import read_heartbeat
+
+        if self.rcs:  # what the killed run durably committed, pre-restart
+            self.committed_between.append(
+                CheckpointListener.last_checkpoint_state(self.ckpt_dir))
+        env = {**os.environ, **_child_env(), **self.extra_env}
+        err_path = os.path.join(self.ckpt_dir, f"child.{len(self.rcs)}.err")
+        with open(err_path, "wb") as err:
+            proc = subprocess.Popen(
+                [sys.executable, "-c",
+                 "from deeplearning4j_tpu.train.fault_tolerance import "
+                 "_child_main; _child_main()",
+                 "child", ENTRY_REF, self.ckpt_dir, str(self.stall_timeout)],
+                env=env, stderr=err)
+            if not self.rcs and self.kill_at is not None:
+                deadline = time.monotonic() + 180
+                while time.monotonic() < deadline:
+                    hb = read_heartbeat(self.ckpt_dir)
+                    # the leg under test is resume-from-a-committed-
+                    # checkpoint: fire only once the async writer has
+                    # flipped the pointer at least once (tiny steps can
+                    # outrun the first zip write)
+                    if (hb and hb["iteration"] >= self.kill_at
+                            and CheckpointListener.last_checkpoint(
+                                self.ckpt_dir) is not None):
+                        break
+                    if proc.poll() is not None:
+                        break
+                    time.sleep(0.02)
+                if proc.poll() is None:
+                    proc.send_signal(self.sig)
+            rc = proc.wait(timeout=300)
+        self.rcs.append(rc)
+        return rc
+
+
+def _parse_runs(path: str):
+    runs = []
+    if not os.path.exists(path):
+        return runs
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("RUN "):
+                runs.append([])
+            elif line and runs:
+                runs[-1].append(line)
+    return runs
+
+
+def _final(ckpt_dir: str):
+    import numpy as np
+
+    with np.load(os.path.join(ckpt_dir, FINAL_NPZ)) as z:
+        return np.array(z["params"]), int(z["iteration"])
+
+
+def _run_elastic(ckpt_dir, spawner, log, **kw):
+    from deeplearning4j_tpu.core.resilience import RetryPolicy
+    from deeplearning4j_tpu.train.fault_tolerance import elastic_fit
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    kw.setdefault("retry_policy",
+                  RetryPolicy(max_retries=5, initial_backoff=0.05,
+                              max_backoff=0.2))
+    return elastic_fit(ENTRY_REF, ckpt_dir, spawn_fn=spawner,
+                       log_fn=lambda m: log(f"  {m}"), **kw)
+
+
+def main(log=print) -> int:
+    import numpy as np
+
+    from deeplearning4j_tpu.train.fault_tolerance import (
+        PREEMPTED_EXIT_CODE, STALL_EXIT_CODE)
+
+    seed_env = os.environ.get("DL4J_CHAOS_SEED", "")
+    rnd = random.Random(int(seed_env)) if seed_env else random.Random()
+    mid_epoch = [i for i in range(9, TOTAL_ITERS - 2) if i % 8 != 0]
+    base = tempfile.mkdtemp(prefix="training_resilience_")
+
+    # -- leg 1: uninterrupted reference ---------------------------------
+    d1 = os.path.join(base, "uninterrupted")
+    sp1 = _ChaosSpawner(d1)
+    res1 = _run_elastic(d1, sp1, log, max_restarts=0)
+    assert res1["ok"] and res1["restarts"] == 0, res1
+    ref_params, ref_iter = _final(d1)
+    assert ref_iter == TOTAL_ITERS
+    ref_consumed = _parse_runs(os.path.join(d1, CONSUMED_LOG))
+    assert len(ref_consumed) == 1 and len(ref_consumed[0]) == TOTAL_ITERS, \
+        [len(r) for r in ref_consumed]
+    S = ref_consumed[0]
+    ref_scores = _parse_runs(os.path.join(d1, SCORES_LOG))[0]
+    log(f"[1/4] uninterrupted: {TOTAL_ITERS} iterations, "
+        f"{len(set(S))} distinct batches consumed")
+
+    # -- leg 2: SIGKILL at a random mid-epoch iteration -----------------
+    kill_at = rnd.choice(mid_epoch)
+    d2 = os.path.join(base, "sigkill")
+    sp2 = _ChaosSpawner(d2, kill_at=kill_at, sig=signal.SIGKILL)
+    res2 = _run_elastic(d2, sp2, log, max_restarts=3)
+    assert res2["ok"], res2
+    assert sp2.rcs[0] == -signal.SIGKILL, sp2.rcs
+    assert [e["event"] for e in res2["events"]][0] == "crash"
+    committed = sp2.committed_between[0]
+    assert committed is not None, "no committed checkpoint survived SIGKILL"
+    c = committed["iteration"]
+    assert 0 < c <= kill_at + 2, (c, kill_at)
+    params2, _ = _final(d2)
+    assert np.array_equal(ref_params, params2), \
+        "SIGKILL resume diverged from the uninterrupted run"
+    runs2 = _parse_runs(os.path.join(d2, CONSUMED_LOG))
+    assert len(runs2) == 2, [len(r) for r in runs2]
+    P, R = runs2
+    # non-overlapping, non-skipping: the committed prefix plus the
+    # resumed run's consumption is EXACTLY the uninterrupted sequence
+    assert len(P) >= c, (len(P), c)
+    assert P[:c] + R == S, (c, len(P), len(R))
+    sruns2 = _parse_runs(os.path.join(d2, SCORES_LOG))
+    eff_scores = sruns2[0][:c] + sruns2[1]
+    assert eff_scores == ref_scores, "loss curve diverged after SIGKILL"
+    log(f"[2/4] SIGKILL at iter {kill_at} (committed {c}): restart "
+        f"resumed batch {c + 1}, params + loss curve bit-identical "
+        f"({len(P) - c} uncommitted batch(es) re-consumed)")
+
+    # -- leg 3: SIGTERM preemption --------------------------------------
+    term_at = rnd.choice(mid_epoch)
+    d3 = os.path.join(base, "sigterm")
+    sp3 = _ChaosSpawner(d3, kill_at=term_at, sig=signal.SIGTERM)
+    res3 = _run_elastic(d3, sp3, log, max_restarts=0)
+    assert res3["ok"], res3
+    assert sp3.rcs[0] == PREEMPTED_EXIT_CODE, sp3.rcs
+    assert res3["preemptions"] == 1 and res3["restarts"] == 0, res3
+    assert [e["event"] for e in res3["events"]] == ["preempted", "completed"]
+    assert os.path.exists(os.path.join(d3, "preempted"))
+    committed3 = sp3.committed_between[0]
+    hb3 = res3["events"][0]["last_heartbeat"]
+    # zero lost iterations: the forced final sync save covered the last
+    # heartbeat-recorded step
+    assert committed3["iteration"] == hb3["iteration"], (committed3, hb3)
+    params3, _ = _final(d3)
+    assert np.array_equal(ref_params, params3), \
+        "preemption resume diverged from the uninterrupted run"
+    runs3 = _parse_runs(os.path.join(d3, CONSUMED_LOG))
+    c3 = committed3["iteration"]
+    assert runs3[0][:c3] + runs3[1] == S
+    log(f"[3/4] SIGTERM at iter {term_at}: exit {PREEMPTED_EXIT_CODE}, "
+        f"final sync checkpoint at iter {c3} == last heartbeat, "
+        f"immediate restart, params bit-identical")
+
+    # -- leg 4: injected stall (watchdog path) --------------------------
+    stall_at = rnd.choice(mid_epoch)
+    d4 = os.path.join(base, "stall")
+    sp4 = _ChaosSpawner(d4, stall_timeout=20.0,
+                        extra_env={"DL4J_TEST_STALL_AT_ITER": str(stall_at)})
+    res4 = _run_elastic(d4, sp4, log, max_restarts=3, stall_timeout=20.0)
+    assert res4["ok"], res4
+    assert sp4.rcs[0] == STALL_EXIT_CODE, sp4.rcs
+    assert [e["event"] for e in res4["events"]][0] == "stall"
+    params4, _ = _final(d4)
+    assert np.array_equal(ref_params, params4), \
+        "stall resume diverged from the uninterrupted run"
+    log(f"[4/4] stall at iter {stall_at}: watchdog exit {STALL_EXIT_CODE}, "
+        f"restart, params bit-identical")
+
+    log("training resilience contract OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
