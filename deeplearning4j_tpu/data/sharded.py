@@ -165,14 +165,64 @@ class ShardedDataSetIterator(DataSetIterator):
         return self.underlying.batch_size()
 
     def state_dict(self) -> dict:
-        """Delegates: the sharded assembly is stateless per batch, so the
-        consumer position IS the per-host underlying's position. Every
-        host checkpoints/restores its own shard's cursor — PR 7's
-        deterministic sharding makes the union exact."""
-        return self.underlying.state_dict()
+        """Delegates, plus records the GLOBAL batch size. The sharded
+        assembly is stateless per batch, so the consumer position IS the
+        per-host underlying's position. Every host checkpoints/restores
+        its own shard's cursor — PR 7's deterministic sharding makes the
+        union exact.
+
+        ``global_batch`` is the elastic-resize contract: the per-host
+        cursor counts *global steps* (one local batch per global step at
+        any width), so the state carries across a changed shard layout
+        exactly when the restoring pipeline keeps the same global batch
+        (per-replica batch recomputed as global/width). A mismatch would
+        silently bend the LAMB/warmup trajectory, so ``load_state_dict``
+        refuses it."""
+        state = dict(self.underlying.state_dict())
+        state["global_batch"] = int(self.batch_size())
+        return state
 
     def load_state_dict(self, state: dict) -> None:
+        state = dict(state)
+        saved_global = state.pop("global_batch", None)
+        if saved_global is not None and int(saved_global) != int(
+                self.batch_size()):
+            raise ValueError(
+                f"global batch mismatch on restore: checkpoint was taken "
+                f"at global batch {int(saved_global)}, this pipeline "
+                f"yields {int(self.batch_size())}; elastic resize is "
+                f"width-invariant in the GLOBAL batch — recompute the "
+                f"per-replica batch as global_batch / data-axis width")
         self.underlying.load_state_dict(state)
+
+    def reshard(self, underlying: DataSetIterator, sharding=None, *,
+                process_count: Optional[int] = None) -> None:
+        """Re-point this iterator at a new shard layout WITHOUT a cold
+        pipeline restart: carry the current global consumed-batch cursor
+        onto ``underlying`` (this host's iterator over its NEW
+        ``shard_paths(paths, index', count')`` partition, positioned by
+        ``load_state_dict``), swap in the new batch-dim ``sharding``
+        (e.g. the rebuilt trainer's ``data_sharding``) and
+        ``process_count``. The new layout must preserve the global batch
+        size — validated by the ``global_batch`` contract above."""
+        if process_count is not None and int(process_count) < 1:
+            raise ValueError("process_count must be >= 1")
+        state = self.state_dict()
+        old = (self.underlying, self.sharding, self.process_count)
+        self.underlying = underlying
+        if sharding is not None:
+            self.sharding = sharding
+        if process_count is not None:
+            self.process_count = int(process_count)
+        try:
+            self.load_state_dict(state)
+        except Exception:
+            self.underlying, self.sharding, self.process_count = old
+            raise
+        if old[0] is not underlying:
+            c = getattr(old[0], "close", None)
+            if callable(c):
+                c()
 
     def stats(self) -> dict:
         s = getattr(self.underlying, "stats", None)

@@ -742,6 +742,36 @@ class DistributedTrainer:
         self.model.params = jax.device_get(self.params)
         self.model.state = jax.device_get(self.state)
 
+    def load_updater_state(self, host_opt) -> None:
+        """Re-shard a restored updater (optimizer) state onto this
+        trainer's mesh. ``host_opt`` holds GLOBAL-shape leaves (what a
+        zip checkpoint written via ``jax.device_get`` or the orbax
+        global-shape path stores); under ZeRO-1 each leaf is re-split
+        into this mesh's ``data_axis``-width slices. Because the input is
+        global-shape, it is valid regardless of the data-parallel width
+        that wrote it — the elastic-resize restore path."""
+        live_leaves, treedef = jax.tree_util.tree_flatten(self.opt_state)
+        new_leaves = jax.tree_util.tree_leaves(host_opt)
+        if len(new_leaves) != len(live_leaves):
+            raise ValueError(
+                "updater state structure mismatch: checkpoint has "
+                f"{len(new_leaves)} leaves, trainer expects "
+                f"{len(live_leaves)} — was the model/updater "
+                "configuration changed between save and restore?")
+        host = []
+        for i, (new, live) in enumerate(zip(new_leaves, live_leaves)):
+            arr = np.asarray(jax.device_get(new))
+            want = tuple(live.shape)
+            if tuple(arr.shape) != want:
+                raise ValueError(
+                    f"updater state leaf {i} has global shape "
+                    f"{tuple(arr.shape)}, trainer expects {want} — "
+                    "checkpoint updater state must be saved at global "
+                    "shape to restore onto a resized mesh")
+            host.append(arr.astype(live.dtype))
+        host_tree = jax.tree_util.tree_unflatten(treedef, host)
+        self.opt_state = self._put_tree(host_tree, self._opt_shardings)
+
     # ----- observability ---------------------------------------------
     def _init_metrics(self, registry) -> None:
         from ..obs import get_registry

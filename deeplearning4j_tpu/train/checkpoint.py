@@ -92,14 +92,26 @@ class _ModelSnapshot:
     touches live device buffers (which the donated train step invalidates
     every iteration)."""
 
-    def __init__(self, model, *, save_updater: bool) -> None:
+    def __init__(self, model, *, save_updater: bool,
+                 dist_trainer: Any = None) -> None:
         import jax
 
         self.class_name = type(model).__name__
         self.conf = model.conf
         self.params, self.state = jax.device_get((model.params, model.state))
         trainer = getattr(model, "_trainer", None)
-        if save_updater and trainer is not None:
+        if (save_updater and dist_trainer is not None
+                and getattr(dist_trainer, "opt_state", None) is not None
+                and not getattr(dist_trainer, "_multiprocess", False)):
+            # DistributedTrainer updater state fetched at GLOBAL shape:
+            # device_get reassembles ZeRO-1 slices, so the zip artifact
+            # follows the orbax global-shape rule (PR 8) and
+            # restore_training_state(trainer=...) can re-shard it onto a
+            # RESIZED data axis (elastic resize). Multi-process meshes
+            # hold non-addressable shards — they keep the orbax path.
+            self._trainer = _TrainerShim(
+                jax.device_get(dist_trainer.opt_state))
+        elif save_updater and trainer is not None:
             self._trainer = _TrainerShim(jax.device_get(trainer.opt_state))
         else:
             self._trainer = None
@@ -129,10 +141,15 @@ class CheckpointListener(TrainingListener):
         this is where the sharded/diverged replicas are reassembled into
         the single replicated view the zip artifact holds). Without it, a
         DistributedTrainer fit would checkpoint the model's STALE pre-fit
-        params, because the trainer only syncs back at fit() end. Note the
-        zip artifact never carries the trainer's sharded opt_state — use
+        params, because the trainer only syncs back at fit() end. With a
+        single-process trainer attached, the zip artifact also carries the
+        updater (optimizer) state at GLOBAL shape — ``jax.device_get``
+        reassembles the ZeRO-1 slices — so
+        ``restore_training_state(trainer=...)`` can re-shard it onto a
+        different data-axis width (elastic resize). Multi-process meshes
+        hold non-addressable shards; use
         :class:`~deeplearning4j_tpu.train.orbax_checkpoint.OrbaxCheckpointer`
-        for resumable sharded training state.
+        there.
 
         ``async_save=True`` moves serialization + fsync off the step
         thread: the step pays one device fetch, a bounded daemon writer
@@ -220,7 +237,8 @@ class CheckpointListener(TrainingListener):
         if self.trainer is not None:
             self.trainer.sync_to_model()
             model = self.trainer.model
-        snap = _ModelSnapshot(model, save_updater=self.save_updater)
+        snap = _ModelSnapshot(model, save_updater=self.save_updater,
+                              dist_trainer=self.trainer)
         sidecar = {
             "iteration": iteration,
             "epoch": epoch,
@@ -452,14 +470,25 @@ class CheckpointListener(TrainingListener):
 
 
 def restore_training_state(model, state: Optional[dict],
-                           iterator: Optional[Any] = None) -> None:
+                           iterator: Optional[Any] = None,
+                           trainer: Optional[Any] = None) -> None:
     """Rehydrate the sidecar state onto a restored model (and optionally a
     freshly built, identically configured data iterator): iteration/epoch
     counters, the RNG stream position, and the iterator's consumer cursor.
     After this, continuing training consumes exactly the batches the
     killed run never did, with the killed run's key sequence — the
     bit-exact mid-epoch resume contract (tier-1:
-    tools/check_training_resilience_contract.py)."""
+    tools/check_training_resilience_contract.py).
+
+    Pass ``trainer=`` (a :class:`~..parallel.trainer.DistributedTrainer`)
+    to additionally re-shard the checkpoint's updater state onto the
+    trainer's *current* mesh. The zip artifact stores updater leaves at
+    global shape (see :class:`_ModelSnapshot`), so this works even when
+    the data axis is a different width than the one that wrote the
+    checkpoint — the elastic-resize path (tier-1:
+    tools/check_elastic_resize_contract.py). The trainer's own step
+    counter is re-pinned to the model's iteration count so LR
+    warmup/schedules (LAMB trajectory) stay width-invariant."""
     if state is None:
         return
     model.iteration_count = int(state.get(
@@ -472,6 +501,13 @@ def restore_training_state(model, state: Optional[dict],
         rng.load_state_dict(rng_state)
     if iterator is not None and state.get("iterator") is not None:
         iterator.load_state_dict(state["iterator"])
+    if trainer is not None:
+        host_opt = getattr(getattr(model, "_trainer", None),
+                           "opt_state", None)
+        if host_opt is not None and hasattr(trainer, "load_updater_state"):
+            trainer.load_updater_state(host_opt)
+        if hasattr(trainer, "iteration"):
+            trainer.iteration = model.iteration_count
 
 
 class EvaluativeListener(TrainingListener):

@@ -35,6 +35,9 @@ def test_select_rows_filters_exactly():
     # ISSUE 15: the checkpoint-stall row gates the async writer
     sel = bench.select_rows("checkpoint_stall")
     assert sel == {"checkpoint_stall": "checkpoint_stall"}
+    # ISSUE 16: the elastic-goodput row gates the >0.90 churn ratio
+    sel = bench.select_rows("elastic_goodput")
+    assert sel == {"elastic_goodput": "elastic_goodput"}
     # every selectable row maps to a registered measurement
     for row, meas in {**bench._EXTRA_ROWS, **bench._CHIP_ONLY_ROWS}.items():
         assert meas in bench._MEASUREMENTS, (row, meas)
@@ -71,6 +74,7 @@ def test_cli_list_rows_and_unknown_row_exit():
     assert "int8_kv_cache" in listing["rows"]
     assert "large_batch_scaling" in listing["rows"]
     assert "checkpoint_stall" in listing["rows"]
+    assert "elastic_goodput" in listing["rows"]
     # an unknown row fails fast (exit 2, error names the row) BEFORE any
     # probe/measurement work
     bad = subprocess.run([sys.executable, _BENCH, "--rows", "nope"],

@@ -1,0 +1,23 @@
+"""Tier-1 wiring for tools/check_elastic_resize_contract.py: the elastic
+mesh-resize chaos contract (README.md "Elastic resize") — SIGKILL a real
+ZeRO-1 child trainer twice while shrinking then growing the device count
+between boots (N -> N/2 -> N), and prove the run comes back each time
+with re-sharded updater state on the new width, a provably
+non-overlapping / non-skipping global consumed-batch sequence, a final
+eval loss inside the quality gate vs the fixed-width reference, and a
+goodput ledger that itemizes the outage — enforced on every test run,
+not just when someone remembers to run the tool."""
+
+import os
+import sys
+
+_TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+
+
+def test_elastic_resize_contract_smoke():
+    sys.path.insert(0, _TOOLS)
+    try:
+        import check_elastic_resize_contract
+    finally:
+        sys.path.remove(_TOOLS)
+    assert check_elastic_resize_contract.main(log=lambda m: None) == 0

@@ -313,8 +313,9 @@ class TestPreemptionClassification:
                         ("event",))
         assert c.labels("preempted").value == 1
         assert c.labels("completed").value == 1
-        r = reg.counter("dl4j_tpu_training_restarts_total", "")
-        assert r.value == 1  # the preemption restart IS a restart
+        r = reg.counter("dl4j_tpu_training_restarts_total", "", ("reason",))
+        # the preemption restart IS a restart — under its own reason label
+        assert r.labels("preempted").value == 1
 
 
 class TestPreemptionHandler:
@@ -674,3 +675,264 @@ def test_watchdog_ignores_stale_heartbeat_on_restart(tmp_path):
     time.sleep(0.6)
     wd.stop()
     assert fired  # and it still fires once the REAL grace period lapses
+
+
+class TestElasticResize:
+    """ISSUE 16: mesh_size_fn width resolution, reason-labeled restarts,
+    reshard events, and the supervisor plumbing that carries the width to
+    the child. Deterministic — spawn_fn stubs, fake clock."""
+
+    @staticmethod
+    def _clock_sleep():
+        t = [0.0]
+        slept = []
+
+        def clock():
+            return t[0]
+
+        def sleep(dt):
+            slept.append(dt)
+            t[0] += dt
+
+        return t, slept, clock, sleep
+
+    def test_width_reaches_spawn_fn_and_resize_is_labeled(self, tmp_path):
+        from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        widths = iter([8, 4])
+        rcs = iter([1, 0])
+        seen = []
+
+        def spawn(mesh_size):
+            seen.append(mesh_size)
+            return next(rcs)
+
+        _, slept, clock, sleep = self._clock_sleep()
+        result = elastic_fit(
+            "unused:train", str(tmp_path), max_restarts=3,
+            spawn_fn=spawn, sleep=sleep, clock=clock,
+            mesh_size_fn=lambda: next(widths),
+            registry=reg, log_fn=lambda m: None)
+        assert result["ok"] and seen == [8, 4]
+        kinds = [e["event"] for e in result["events"]]
+        assert kinds == ["crash", "backoff", "reshard", "completed"]
+        resh = next(e for e in result["events"] if e["event"] == "reshard")
+        assert resh["from_width"] == 8 and resh["to_width"] == 4
+        r = reg.counter("dl4j_tpu_training_restarts_total", "", ("reason",))
+        assert r.labels("resize").value == 1
+        assert r.labels("crash").value == 0
+
+    def test_same_width_restart_keeps_failure_reason(self, tmp_path):
+        from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        rcs = iter([1, 86, 0])
+        _, slept, clock, sleep = self._clock_sleep()
+        result = elastic_fit(
+            "unused:train", str(tmp_path), max_restarts=5,
+            spawn_fn=lambda w: next(rcs), sleep=sleep, clock=clock,
+            mesh_size_fn=lambda: 8,
+            registry=reg, log_fn=lambda m: None)
+        assert result["ok"]
+        kinds = [e["event"] for e in result["events"]]
+        assert kinds == ["crash", "backoff", "stall", "backoff", "completed"]
+        r = reg.counter("dl4j_tpu_training_restarts_total", "", ("reason",))
+        assert r.labels("crash").value == 1
+        assert r.labels("stall").value == 1
+        assert r.labels("resize").value == 0
+
+    def test_legacy_zero_arg_spawn_fn_still_works(self, tmp_path):
+        rcs = iter([1, 0])
+        _, slept, clock, sleep = self._clock_sleep()
+        result = elastic_fit(
+            "unused:train", str(tmp_path), max_restarts=2,
+            spawn_fn=lambda: next(rcs), sleep=sleep, clock=clock,
+            mesh_size_fn=lambda: 4, log_fn=lambda m: None)
+        assert result["ok"] and result["restarts"] == 1
+
+    def test_mesh_child_env_rewrites_cpu_device_count(self):
+        from deeplearning4j_tpu.train.fault_tolerance import _mesh_child_env
+
+        env = {"JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=8 "
+                            "--xla_dump_to=/tmp/d"}
+        out = _mesh_child_env(env, 4)
+        assert out["DL4J_ELASTIC_MESH_SIZE"] == "4"
+        assert "--xla_force_host_platform_device_count=4" in out["XLA_FLAGS"]
+        assert "device_count=8" not in out["XLA_FLAGS"]
+        assert "--xla_dump_to=/tmp/d" in out["XLA_FLAGS"]  # preserved
+        # no width -> env untouched
+        assert "DL4J_ELASTIC_MESH_SIZE" not in _mesh_child_env(env, None)
+
+    def test_mesh_child_env_leaves_tpu_platform_flags_alone(self):
+        from deeplearning4j_tpu.train.fault_tolerance import _mesh_child_env
+
+        env = {"JAX_PLATFORMS": "tpu", "XLA_FLAGS": "--xla_foo=1"}
+        out = _mesh_child_env(env, 16)
+        # advisory env var only: a real fleet's device count is the
+        # scheduler's business, not a host-platform flag
+        assert out["DL4J_ELASTIC_MESH_SIZE"] == "16"
+        assert out["XLA_FLAGS"] == "--xla_foo=1"
+
+    def test_accepts_mesh_size_arities(self):
+        from deeplearning4j_tpu.train.fault_tolerance import _accepts_mesh_size
+
+        assert _accepts_mesh_size(lambda a, b, mesh_size=None: None)
+        assert _accepts_mesh_size(lambda a, b, c: None)
+        assert _accepts_mesh_size(lambda *args: None)
+        assert not _accepts_mesh_size(lambda a, b: None)
+
+
+class TestGoodputLedger:
+    """ISSUE 16: the supervisor's downtime itemization and goodput ratio,
+    deterministic via fake clock/sleep (no heartbeat files -> the
+    boot-time and heartbeat-age terms are absent by construction)."""
+
+    def test_result_carries_ledger_and_backoff_downtime(self, tmp_path):
+        from deeplearning4j_tpu.core.resilience import RetryPolicy
+        from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        t = [0.0]
+        slept = []
+
+        def clock():
+            return t[0]
+
+        def sleep(dt):
+            slept.append(dt)
+            t[0] += dt
+
+        rcs = iter([1, 1, 0])
+        result = elastic_fit(
+            "unused:train", str(tmp_path), max_restarts=5,
+            retry_policy=RetryPolicy(max_retries=5, initial_backoff=1.0,
+                                     multiplier=2.0, jitter=0.0),
+            spawn_fn=lambda: next(rcs), sleep=sleep, clock=clock,
+            registry=reg, log_fn=lambda m: None)
+        assert result["ok"]
+        gp = result["goodput"]
+        # the fake clock only advances inside sleep(): wall == backoff
+        # downtime, so every second was downtime and the ratio is 0
+        assert gp["downtime_seconds"]["backoff"] == sum(slept) == 3.0
+        assert gp["wall_seconds"] == 3.0
+        assert gp["useful_seconds"] == 0.0 and gp["ratio"] == 0.0
+        c = reg.counter("dl4j_tpu_training_downtime_seconds_total", "",
+                        ("reason",))
+        assert c.labels("backoff").value == 3.0
+        g = reg.gauge("dl4j_tpu_training_goodput_ratio", "")
+        assert g.value == 0.0
+
+    def test_clean_run_has_full_goodput(self, tmp_path):
+        t = [0.0]
+
+        def clock():
+            t[0] += 5.0  # every clock() read advances: wall > 0
+            return t[0]
+
+        result = elastic_fit(
+            "unused:train", str(tmp_path), spawn_fn=lambda: 0,
+            sleep=lambda dt: None, clock=clock, log_fn=lambda m: None)
+        gp = result["goodput"]
+        assert gp["ratio"] == 1.0
+        assert gp["useful_seconds"] == gp["wall_seconds"] > 0
+        assert all(v == 0.0 for v in gp["downtime_seconds"].values())
+
+    def test_stall_downtime_uses_heartbeat_age(self, tmp_path):
+        import json as _json
+
+        # a heartbeat 5 "wall" seconds stale at failure time
+        with open(os.path.join(str(tmp_path), "heartbeat.json"), "w") as f:
+            _json.dump({"iteration": 3, "ts": time.time() - 5.0}, f)
+        rcs = iter([86, 0])
+        result = elastic_fit(
+            "unused:train", str(tmp_path), max_restarts=2,
+            stall_timeout=300.0,
+            spawn_fn=lambda: next(rcs), sleep=lambda dt: None,
+            clock=lambda: 0.0, log_fn=lambda m: None)
+        assert result["ok"]
+        stall_ev = result["events"][0]
+        assert stall_ev["event"] == "stall"
+        assert stall_ev["heartbeat_age_s"] == pytest.approx(5.0, abs=1.0)
+        # the itemized stall seconds are the measured age, NOT the
+        # configured 300s timeout
+        assert result["goodput"]["downtime_seconds"]["stall"] == \
+            pytest.approx(5.0, abs=1.0)
+
+    def test_stall_without_heartbeat_charges_full_timeout(self, tmp_path):
+        rcs = iter([86, 0])
+        result = elastic_fit(
+            "unused:train", str(tmp_path), max_restarts=2,
+            stall_timeout=42.0,
+            spawn_fn=lambda: next(rcs), sleep=lambda dt: None,
+            clock=lambda: 0.0, log_fn=lambda m: None)
+        assert result["events"][0]["heartbeat_age_s"] is None
+        assert result["goodput"]["downtime_seconds"]["stall"] == 42.0
+
+
+class TestHeartbeatHardening:
+    """ISSUE 16 satellites: crash-consistent heartbeat writes and a
+    read path that tolerates torn/empty/garbage files."""
+
+    def test_read_heartbeat_tolerates_missing_empty_torn(self, tmp_path):
+        d = str(tmp_path)
+        assert read_heartbeat(d) is None  # missing
+        path = os.path.join(d, "heartbeat.json")
+        open(path, "w").close()
+        assert read_heartbeat(d) is None  # empty
+        with open(path, "w") as f:
+            f.write('{"iteration": 3, "ts"')  # torn mid-write
+        assert read_heartbeat(d) is None
+        with open(path, "w") as f:
+            f.write("[1, 2, 3]")  # parseable but not a beat
+        assert read_heartbeat(d) is None
+
+    def test_heartbeat_write_is_atomic_and_keeps_first_ts(self, tmp_path):
+        import glob
+
+        hb = HeartbeatListener(str(tmp_path))
+        hb.iteration_done(None, 1, 0, 0.5)
+        first = read_heartbeat(str(tmp_path))
+        time.sleep(0.02)
+        hb.iteration_done(None, 2, 0, 0.4)
+        second = read_heartbeat(str(tmp_path))
+        assert second["iteration"] == 2
+        assert second["pid"] == os.getpid()
+        # first_ts survives across beats (boot-time pricing anchor) while
+        # ts advances
+        assert second["first_ts"] == first["first_ts"] == first["ts"]
+        assert second["ts"] > first["ts"]
+        # tmp + os.replace discipline leaves no debris behind
+        assert glob.glob(os.path.join(str(tmp_path), "*.tmp*")) == []
+        assert glob.glob(os.path.join(str(tmp_path), ".tmp*")) == []
+
+    def test_watchdog_tolerates_ts_less_heartbeat(self, tmp_path):
+        import json as _json
+
+        with open(os.path.join(str(tmp_path), "heartbeat.json"), "w") as f:
+            _json.dump({"iteration": 1}, f)  # dict, but no ts field
+        fired = []
+        wd = Watchdog(str(tmp_path), timeout=0.2, poll_interval=0.05,
+                      on_stall=lambda: fired.append(True))
+        wd.start()
+        time.sleep(0.5)
+        wd.stop()
+        assert fired  # treated as "no beat yet", aged from start()
+
+    def test_crash_event_heartbeat_age(self, tmp_path):
+        import json as _json
+
+        with open(os.path.join(str(tmp_path), "heartbeat.json"), "w") as f:
+            _json.dump({"iteration": 9, "ts": time.time() - 7.0}, f)
+        rcs = iter([1])
+        result = elastic_fit(
+            "unused:train", str(tmp_path), max_restarts=0,
+            spawn_fn=lambda: next(rcs), sleep=lambda dt: None,
+            clock=lambda: 0.0, log_fn=lambda m: None)
+        ev = result["events"][0]
+        assert ev["event"] == "crash"
+        # died-mid-step vs stale-since-boot is now readable off the event
+        assert ev["heartbeat_age_s"] == pytest.approx(7.0, abs=1.0)
+        assert result["goodput"]["downtime_seconds"]["crash"] == \
+            pytest.approx(7.0, abs=1.0)

@@ -177,6 +177,32 @@ class TestWrapperDelegation:
         rest = [np.asarray(it2.next().features) for _ in range(3)]
         _assert_streams_equal(full[1:], rest)
 
+    def test_sharded_state_protocol_pins_global_batch(self):
+        """ISSUE 16: the sharded wrapper's sidecar names the GLOBAL batch
+        — the width-invariance contract of elastic resize — and a restore
+        into a pipeline with a different global batch is refused rather
+        than silently bending the trajectory."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from deeplearning4j_tpu.data.sharded import ShardedDataSetIterator
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(data=len(jax.devices()))
+        sh = NamedSharding(mesh._mesh if hasattr(mesh, "_mesh") else mesh,
+                           PartitionSpec("data"))
+        x, y = _data(32, 4)
+        it = ShardedDataSetIterator(
+            ListDataSetIterator(DataSet(x, y), 8, shuffle=True, seed=2),
+            sh, process_count=1)
+        state = it.state_dict()
+        assert state["global_batch"] == it.batch_size() == 8
+        other = ShardedDataSetIterator(
+            ListDataSetIterator(DataSet(x, y), 8, shuffle=True, seed=2),
+            sh, process_count=2)  # 8 local x 2 hosts -> global 16
+        with pytest.raises(ValueError, match="global batch"):
+            other.load_state_dict(state)
+
     def test_base_raises_clearly(self):
         class Bare(DataSetIterator):
             pass

@@ -362,3 +362,178 @@ class TestDataWorkersEnv:
         monkeypatch.setenv("DL4J_TPU_DATA_WORKERS", "2")
         reader = ImageRecordReader(12, 12, 3, root=root)
         assert reader.workers == 2
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 16: iterator resume across a CHANGED shard layout (elastic resize)
+# ---------------------------------------------------------------------------
+
+def _make_indexed_tree(tmp_path, n=32, size=8):
+    """n constant-valued images (image i is all-i): a decoded row's mean
+    names its source file, so consumed-set proofs read off the batches."""
+    os.makedirs(tmp_path / "c0", exist_ok=True)
+    paths = []
+    for i in range(n):
+        p = str(tmp_path / "c0" / f"{i:03d}.ppm")
+        _write_ppm(p, np.full((size, size, 3), i, np.uint8))
+        paths.append(p)
+    return paths
+
+
+def _host_iter(paths, index, count, local_batch):
+    reader = ImageRecordReader(8, 8, 3, paths=shard_paths(paths, index, count),
+                               output_dtype="uint8")
+    return RecordReaderDataSetIterator(reader, batch_size=local_batch,
+                                       label_index=1, num_classes=1)
+
+
+def _ids(ds):
+    feats = np.asarray(ds.features)
+    return [int(round(float(r.mean()))) for r in feats]
+
+
+class TestResumeAcrossShardLayout:
+    """The tentpole's data half: a cursor saved at shard=(i, N) restores
+    at (j, N/2) with the GLOBAL consumed-batch sequence non-overlapping
+    and non-skipping. Rides two invariants: shard_paths is round-robin
+    (equal per-host consumption == a global file prefix), and the
+    per-host cursor counts GLOBAL steps — 'batches' is the same number
+    on every host at every width, so per-host skip = batches × the NEW
+    local batch repositions exactly."""
+
+    def test_round_robin_equal_consumption_is_global_prefix(self):
+        paths = list(range(40))
+        for count in (2, 4, 8):
+            for k in (1, 3):  # k files consumed per host
+                consumed = set()
+                for i in range(count):
+                    consumed.update(shard_paths(paths, i, count)[:k])
+                assert consumed == set(range(k * count))
+
+    def test_state_saved_at_width4_restores_at_width2(self, tmp_path):
+        paths = _make_indexed_tree(tmp_path)  # 32 files
+        global_batch, steps = 8, 2
+
+        # width 4: local batch 2; every host consumes `steps` global steps
+        consumed = []
+        states = []
+        for i in range(4):
+            it = _host_iter(paths, i, 4, global_batch // 4)
+            for _ in range(steps):
+                consumed += _ids(it.next())
+            states.append(it.state_dict())
+        # equal per-host consumption == the global prefix, and the cursor
+        # is host-independent (it counts global steps, not host rows)
+        assert sorted(consumed) == list(range(steps * global_batch))
+        assert all(s == states[0] for s in states)
+
+        # width 2: local batch 4; ANY old host's state repositions host j
+        remaining = []
+        for j in range(2):
+            it = _host_iter(paths, j, 2, global_batch // 2)
+            it.load_state_dict(states[j % 4])
+            while it.has_next():
+                remaining += _ids(it.next())
+        # non-overlapping, non-skipping: the union is exactly the files
+        # the width-4 run never consumed
+        assert sorted(remaining) == list(range(steps * global_batch, 32))
+        assert not set(consumed) & set(remaining)
+
+    def test_grow_path_width2_to_width4(self, tmp_path):
+        paths = _make_indexed_tree(tmp_path)
+        global_batch, steps = 8, 3
+        it0 = _host_iter(paths, 0, 2, global_batch // 2)
+        consumed = []
+        for _ in range(steps):
+            consumed += _ids(it0.next())
+        state = it0.state_dict()
+
+        remaining = []
+        for j in range(4):
+            it = _host_iter(paths, j, 4, global_batch // 4)
+            it.load_state_dict(state)
+            while it.has_next():
+                remaining += _ids(it.next())
+        all_consumed = set()
+        for i in range(2):
+            all_consumed.update(
+                [int(p.split(os.sep)[-1].split(".")[0]) for p in
+                 shard_paths(paths, i, 2)[:steps * global_batch // 2]])
+        assert sorted(remaining) == sorted(set(range(32)) - all_consumed)
+
+
+class TestShardedIteratorGlobalBatchContract:
+    """ISSUE 16: ShardedDataSetIterator's state carries the GLOBAL batch
+    and refuses a restore that would change it (width-invariant global
+    batch keeps the LAMB/warmup trajectory intact), plus reshard() —
+    carrying the live cursor onto a new shard layout without a cold
+    pipeline restart."""
+
+    def _rows(self, n=32):
+        x = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+        y = np.eye(2, dtype=np.float32)[np.arange(n) % 2]
+        return x, y
+
+    def test_state_dict_carries_global_batch(self):
+        sh = _data_sharding(8)
+        x, y = self._rows()
+        it = ShardedDataSetIterator(
+            ListDataSetIterator(DataSet(x, y), 8), sh, process_count=1)
+        st = it.state_dict()
+        assert st["global_batch"] == 8 == it.batch_size()
+        it.load_state_dict(st)  # round-trips through the validation
+
+    def test_load_refuses_changed_global_batch(self):
+        sh = _data_sharding(8)
+        x, y = self._rows()
+        it8 = ShardedDataSetIterator(
+            ListDataSetIterator(DataSet(x, y), 8), sh, process_count=1)
+        st = it8.state_dict()
+        it4 = ShardedDataSetIterator(
+            ListDataSetIterator(DataSet(x, y), 4), sh, process_count=1)
+        with pytest.raises(ValueError, match="global batch"):
+            it4.load_state_dict(st)
+
+    def test_legacy_state_without_global_batch_still_loads(self):
+        sh = _data_sharding(8)
+        x, y = self._rows()
+        it = ShardedDataSetIterator(
+            ListDataSetIterator(DataSet(x, y), 8), sh, process_count=1)
+        it.load_state_dict(it.underlying.state_dict())  # pre-16 sidecar
+
+    def test_reshard_carries_cursor(self):
+        sh = _data_sharding(8)
+        x, y = self._rows()
+        it = ShardedDataSetIterator(
+            ListDataSetIterator(DataSet(x, y), 8, shuffle=False), sh,
+            process_count=1)
+        first = [np.asarray(it.next().features) for _ in range(2)]
+        closed = []
+        it.underlying.close = lambda *a, **kw: closed.append(True)
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+        half = NamedSharding(  # the shrunk fleet's 4-device data axis
+            make_mesh(devices=jax.devices()[:4], data=4), P("data"))
+        new_under = ListDataSetIterator(DataSet(x, y), 8, shuffle=False)
+        it.reshard(new_under, half)
+        assert it.underlying is new_under and closed == [True]
+        rest = []
+        while it.has_next():
+            rest.append(np.asarray(it.next().features))
+        got = np.concatenate(first + rest)
+        np.testing.assert_array_equal(got, x)  # nothing twice, none skipped
+        assert rest[0].shape[0] == 8  # global batch preserved
+
+    def test_reshard_refuses_global_batch_change_and_rolls_back(self):
+        sh = _data_sharding(8)
+        x, y = self._rows()
+        it = ShardedDataSetIterator(
+            ListDataSetIterator(DataSet(x, y), 8), sh, process_count=1)
+        old = it.underlying
+        with pytest.raises(ValueError, match="global batch"):
+            it.reshard(ListDataSetIterator(DataSet(x, y), 4))
+        assert it.underlying is old  # swap rolled back, pipeline intact
+        assert it.next().features.shape[0] == 8

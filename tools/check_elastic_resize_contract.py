@@ -1,0 +1,487 @@
+#!/usr/bin/env python
+"""Elastic mesh-resize chaos harness (README.md "Elastic resize") — the
+shrink/grow counterpart of tools/check_training_resilience_contract.py.
+
+Drives a REAL ZeRO-1 ``DistributedTrainer`` run through an N -> N/2 -> N
+device-count resize by SIGKILLing the child twice and changing the
+resolved mesh width between boots (``elastic_fit(mesh_size_fn=...)`` +
+``--xla_force_host_platform_device_count`` on the child's CPU mesh), and
+proves the elastic contract end to end:
+
+  * **reference leg** — fixed width N, no churn: the consumed-batch
+    sequence (content hashes at the host-side consumer) and the final
+    eval loss;
+  * **resize leg** — SIGKILL mid-run at width N, reboot at N/2, SIGKILL
+    again, reboot at N. Asserts:
+
+    - both restarts are recorded as ``reshard`` events and the run
+      completes (``restarts == 2``, both child deaths ``-SIGKILL``);
+    - ZeRO-1 updater state was restored onto BOTH widths: every resumed
+      boot logs a nonzero optimizer-moment norm (fresh Adam moments are
+      zero) and a per-device updater-slice dim of ``DIM0 / width``;
+    - nothing trained twice, nothing skipped: committed prefix of boot 1
+      + committed prefix of boot 2 + boot 3's consumption == the
+      reference sequence, batch for batch (the global cursor is
+      width-invariant because the GLOBAL batch is);
+    - the resumed trajectory's final eval loss lands inside a quality
+      gate vs the fixed-width reference (widths only change the
+      reduction order of the same global-batch gradient, so the
+      trajectories agree to float tolerance);
+    - the goodput ledger itemized the outage: ``reshard`` downtime
+      seconds > 0 and ``ratio`` in (0, 1].
+
+Also exposes :func:`run_goodput_churn` — the ``elastic_goodput`` bench
+row's measurement: a longer paced run under scripted churn (one SIGKILL
+at the same width + one SIGTERM preemption that comes back resized),
+returning the supervisor's goodput ledger.
+
+Runs standalone (``python tools/check_elastic_resize_contract.py``) and
+as a tier-1 pytest via tests/test_elastic_resize_contract.py.
+``DL4J_CHAOS_SEED`` pins the kill points for reproduction.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.join(_TOOLS_DIR, os.pardir)
+sys.path.insert(0, _REPO_ROOT)
+
+ENTRY_REF = "check_elastic_resize_contract:train_entry"
+GLOBAL_BATCH = 8     # width-invariant: per-device rows = GLOBAL_BATCH / width
+DIM0 = 8             # first-layer fan-in: ZeRO-1 shards updater dim 0
+WIDTH_FULL = 4
+WIDTH_HALF = 2
+# env-overridable so the elastic_goodput bench row can stretch the same
+# harness to a longer, paced run without a second child implementation
+TOTAL_ITERS = int(os.environ.get("DL4J_ELASTIC_TOTAL_ITERS", "18"))
+PACE_S = float(os.environ.get("DL4J_ELASTIC_PACE_S", "0.05"))
+N_ROWS = TOTAL_ITERS * GLOBAL_BATCH  # single epoch: iters == batches
+CONSUMED_LOG = "consumed.log"
+BOOTS_LOG = "boots.log"
+FINAL_JSON = "final.json"
+
+
+# ---------------------------------------------------------------------------
+# child-side pieces (imported by the spawned trainer)
+# ---------------------------------------------------------------------------
+
+class _AppendLog:
+    """Crash-safe append log: one fsync'd line per event, plus a RUN
+    marker per process so the parent can split the runs apart."""
+
+    def __init__(self, path: str) -> None:
+        self._f = open(path, "a")
+        self.write(f"RUN {os.getpid()}")
+
+    def write(self, line: str) -> None:
+        self._f.write(line + "\n")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+
+class _LoggingIterator:
+    """Hashes each HOST batch as the consumer pulls it — placed UNDER the
+    sharded assembly, so the hash is width-independent (device layout
+    changes; the global rows do not)."""
+
+    def __init__(self, underlying, log: _AppendLog) -> None:
+        self.underlying = underlying
+        self.log = log
+
+    def has_next(self):
+        return self.underlying.has_next()
+
+    def next(self):
+        import numpy as np
+
+        ds = self.underlying.next()
+        digest = hashlib.sha1(
+            np.ascontiguousarray(np.asarray(ds.features)).tobytes()
+        ).hexdigest()[:12]
+        self.log.write(digest)
+        return ds
+
+    def reset(self):
+        self.underlying.reset()
+
+    def batch_size(self):
+        return self.underlying.batch_size()
+
+    def state_dict(self):
+        return self.underlying.state_dict()
+
+    def load_state_dict(self, state):
+        self.underlying.load_state_dict(state)
+
+    def close(self, *a, **kw):
+        c = getattr(self.underlying, "close", None)
+        if callable(c):
+            c(*a, **kw)
+
+
+def _build_model():
+    from deeplearning4j_tpu.nn import (
+        Activation, InputType, LossFunction, NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    # DIM0 input features: every kernel/bias has dim0 divisible by both
+    # WIDTH_FULL and WIDTH_HALF, so ZeRO-1 shards the updater state at
+    # both widths (the re-shard actually changes slice sizes)
+    conf = (NeuralNetConfiguration.builder().seed(17).updater(Adam(0.02))
+            .list()
+            .layer(DenseLayer(n_out=DIM0, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=2, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(DIM0)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _dataset_rows():
+    import numpy as np
+
+    rng = np.random.RandomState(3)
+    x = rng.rand(N_ROWS, DIM0).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, N_ROWS)]
+    return x, y
+
+
+def _eval_rows():
+    import numpy as np
+
+    rng = np.random.RandomState(29)
+    x = rng.rand(64, DIM0).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 64)]
+    return x, y
+
+
+def _opt_stats(trainer):
+    """(sum |leaf| over updater state, per-device dim0 of a ZeRO-1
+    sharded leaf). A fresh Adam init has norm exactly 0 — a nonzero norm
+    on a resumed boot proves the checkpoint's moments were restored; the
+    slice dim proves they were restored SHARDED onto this width."""
+    import jax
+    import numpy as np
+
+    norm = 0.0
+    shard_dim0 = None
+    for leaf in jax.tree_util.tree_leaves(trainer.opt_state):
+        norm += float(np.sum(np.abs(np.asarray(jax.device_get(leaf)))))
+        if (shard_dim0 is None and getattr(leaf, "ndim", 0) >= 1
+                and not leaf.sharding.is_fully_replicated):
+            shard_dim0 = int(leaf.addressable_shards[0].data.shape[0])
+    return norm, shard_dim0
+
+
+def train_entry(resume_path, checkpoint_dir, mesh_size=None):
+    """Resize-aware elastic_fit entry point: rebuilds the ZeRO-1
+    DistributedTrainer on whatever mesh width this boot resolved, restores
+    params + re-sharded updater state + the global iterator cursor, and
+    trains to exactly TOTAL_ITERS global steps."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from deeplearning4j_tpu.core.listeners import TrainingListener
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.data.sharded import ShardedDataSetIterator
+    from deeplearning4j_tpu.model.serializer import restore_model
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    from deeplearning4j_tpu.parallel.trainer import DistributedTrainer
+    from deeplearning4j_tpu.train.checkpoint import (
+        CheckpointListener, restore_training_state)
+    from deeplearning4j_tpu.train.fault_tolerance import (
+        HeartbeatListener, PreemptionHandler)
+
+    width = int(mesh_size) if mesh_size else jax.device_count()
+    assert jax.device_count() == width, (jax.device_count(), width)
+
+    if resume_path:
+        model = restore_model(resume_path, load_updater=True)
+        state = CheckpointListener.last_checkpoint_state(checkpoint_dir)
+    else:
+        model = _build_model()
+        state = None
+    trainer = DistributedTrainer(model, mesh=make_mesh(data=width),
+                                 zero1=True)
+
+    consumed = _AppendLog(os.path.join(checkpoint_dir, CONSUMED_LOG))
+    x, y = _dataset_rows()
+    base = ListDataSetIterator(DataSet(x, y), GLOBAL_BATCH, shuffle=True,
+                               seed=11)
+    it = ShardedDataSetIterator(_LoggingIterator(base, consumed),
+                                trainer.data_sharding, process_count=1)
+    # re-shards updater state onto THIS width + repositions the global
+    # cursor (validating the width-invariant global batch) + re-pins the
+    # schedule step
+    restore_training_state(model, state, iterator=it, trainer=trainer)
+    opt_norm, shard_dim0 = _opt_stats(trainer)
+    _AppendLog(os.path.join(checkpoint_dir, BOOTS_LOG)).write(json.dumps({
+        "width": width, "resumed": bool(resume_path),
+        "start_iter": model.iteration_count,
+        "opt_norm": opt_norm, "shard_dim0": shard_dim0}))
+
+    ckpt = CheckpointListener(
+        checkpoint_dir, save_every_n_iterations=1, async_save=True,
+        trainer=trainer, iterator=it, keep_last=5, log_fn=lambda m: None)
+
+    class _Pacer(TrainingListener):
+        def iteration_done(self, model, iteration, epoch, score):
+            time.sleep(PACE_S)
+
+    model.add_listeners(ckpt, HeartbeatListener(checkpoint_dir), _Pacer(),
+                        PreemptionHandler(checkpoint=ckpt).install())
+
+    while model.iteration_count < TOTAL_ITERS:
+        trainer.fit_iterator(it, epochs=1)
+    ckpt.close()
+    it.close()
+    ex, ey = _eval_rows()
+    with open(os.path.join(checkpoint_dir, FINAL_JSON), "w") as f:
+        json.dump({"iteration": model.iteration_count,
+                   "eval_loss": float(model.score(ex, ey)),
+                   "width": width}, f)
+
+
+# ---------------------------------------------------------------------------
+# parent-side orchestration
+# ---------------------------------------------------------------------------
+
+def _child_env():
+    py_path = os.pathsep.join(
+        [_TOOLS_DIR, os.path.abspath(_REPO_ROOT),
+         os.environ.get("PYTHONPATH", "")])
+    return {"PYTHONPATH": py_path, "JAX_PLATFORMS": "cpu"}
+
+
+class _ResizeSpawner:
+    """elastic_fit spawn_fn that runs the real child via Popen at the
+    width elastic_fit resolved for this boot, and delivers ``kills[i]``
+    (``(kill_at_iteration, signal)`` or None) to boot ``i`` once THIS
+    child's heartbeat passes the mark with a committed checkpoint to
+    resume from. Records per-boot exit codes, widths, and the committed
+    state observed between death and restart."""
+
+    def __init__(self, ckpt_dir: str, *, kills=(), stall_timeout=300.0,
+                 extra_env=None) -> None:
+        self.ckpt_dir = ckpt_dir
+        self.kills = list(kills)
+        self.stall_timeout = stall_timeout
+        self.extra_env = extra_env or {}
+        self.rcs = []
+        self.widths = []
+        self.committed_between = []
+
+    def __call__(self, mesh_size=None) -> int:
+        from deeplearning4j_tpu.train.checkpoint import CheckpointListener
+        from deeplearning4j_tpu.train.fault_tolerance import (
+            _mesh_child_env, read_heartbeat)
+
+        boot = len(self.rcs)
+        if boot:  # what the killed run durably committed, pre-restart
+            self.committed_between.append(
+                CheckpointListener.last_checkpoint_state(self.ckpt_dir))
+        self.widths.append(mesh_size)
+        kill = self.kills[boot] if boot < len(self.kills) else None
+        env = _mesh_child_env(
+            {**os.environ, **_child_env(), **self.extra_env}, mesh_size)
+        err_path = os.path.join(self.ckpt_dir, f"child.{boot}.err")
+        with open(err_path, "wb") as err:
+            proc = subprocess.Popen(
+                [sys.executable, "-c",
+                 "from deeplearning4j_tpu.train.fault_tolerance import "
+                 "_child_main; _child_main()",
+                 "child", ENTRY_REF, self.ckpt_dir, str(self.stall_timeout)],
+                env=env, stderr=err)
+            if kill is not None:
+                kill_at, sig = kill
+                deadline = time.monotonic() + 600
+                while time.monotonic() < deadline:
+                    hb = read_heartbeat(self.ckpt_dir)
+                    # pid-gate: a restarted boot inherits the dead run's
+                    # heartbeat file; only THIS child's beats count. And
+                    # only fire with a committed checkpoint to resume from.
+                    if (hb and hb.get("pid") == proc.pid
+                            and hb["iteration"] >= kill_at
+                            and CheckpointListener.last_checkpoint(
+                                self.ckpt_dir) is not None):
+                        break
+                    if proc.poll() is not None:
+                        break
+                    time.sleep(0.02)
+                if proc.poll() is None:
+                    proc.send_signal(sig)
+            rc = proc.wait(timeout=900)
+        self.rcs.append(rc)
+        return rc
+
+
+def _parse_runs(path: str):
+    runs = []
+    if not os.path.exists(path):
+        return runs
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("RUN "):
+                runs.append([])
+            elif line and runs:
+                runs[-1].append(line)
+    return runs
+
+
+def _final(ckpt_dir: str) -> dict:
+    with open(os.path.join(ckpt_dir, FINAL_JSON)) as f:
+        return json.load(f)
+
+
+def _run_elastic(ckpt_dir, spawner, log, *, widths, **kw):
+    """elastic_fit over a scripted width schedule: boot i resolves
+    widths[i] (clamped to the last entry)."""
+    from deeplearning4j_tpu.core.resilience import RetryPolicy
+    from deeplearning4j_tpu.train.fault_tolerance import elastic_fit
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    kw.setdefault("retry_policy",
+                  RetryPolicy(max_retries=5, initial_backoff=0.05,
+                              max_backoff=0.2))
+    return elastic_fit(
+        ENTRY_REF, ckpt_dir, spawn_fn=spawner,
+        mesh_size_fn=lambda: widths[min(len(spawner.rcs), len(widths) - 1)],
+        log_fn=lambda m: log(f"  {m}"), **kw)
+
+
+def run_goodput_churn(log=print, *, total_iters=320, pace_s=0.25,
+                      kill_at=None, term_at=None):
+    """The ``elastic_goodput`` bench measurement: a paced run under
+    scripted churn — one SIGKILL at full width, then a SIGTERM preemption
+    whose reboot comes back at half width — returning the supervisor's
+    result (goodput ledger included) plus the churn script."""
+    seed_env = os.environ.get("DL4J_CHAOS_SEED", "")
+    rnd = random.Random(int(seed_env)) if seed_env else random.Random()
+    kill_at = kill_at or rnd.randint(total_iters // 4, total_iters // 3)
+    term_at = term_at or rnd.randint(total_iters // 2,
+                                     2 * total_iters // 3)
+    base = tempfile.mkdtemp(prefix="elastic_goodput_")
+    d = os.path.join(base, "churn")
+    extra = {"DL4J_ELASTIC_TOTAL_ITERS": str(total_iters),
+             "DL4J_ELASTIC_PACE_S": str(pace_s)}
+    sp = _ResizeSpawner(d, kills=[(kill_at, signal.SIGKILL),
+                                  (term_at, signal.SIGTERM)],
+                        extra_env=extra)
+    res = _run_elastic(d, sp, log,
+                       widths=[WIDTH_FULL, WIDTH_FULL, WIDTH_HALF],
+                       max_restarts=3)
+    res["churn"] = {"kill_at": kill_at, "term_at": term_at,
+                    "total_iters": total_iters, "pace_s": pace_s,
+                    "rcs": sp.rcs, "widths": sp.widths}
+    return res
+
+
+def main(log=print) -> int:
+    from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+
+    seed_env = os.environ.get("DL4J_CHAOS_SEED", "")
+    rnd = random.Random(int(seed_env)) if seed_env else random.Random()
+    base = tempfile.mkdtemp(prefix="elastic_resize_")
+
+    # -- leg 1: fixed-width reference -----------------------------------
+    d1 = os.path.join(base, "reference")
+    sp1 = _ResizeSpawner(d1)
+    res1 = _run_elastic(d1, sp1, log, widths=[WIDTH_FULL], max_restarts=0)
+    assert res1["ok"] and res1["restarts"] == 0, res1
+    fin1 = _final(d1)
+    assert fin1["iteration"] == TOTAL_ITERS and fin1["width"] == WIDTH_FULL
+    S = _parse_runs(os.path.join(d1, CONSUMED_LOG))[0]
+    assert len(S) == TOTAL_ITERS, len(S)
+    log(f"[1/2] reference @ width {WIDTH_FULL}: {TOTAL_ITERS} iterations, "
+        f"eval loss {fin1['eval_loss']:.4f}")
+
+    # -- leg 2: N -> N/2 -> N under SIGKILL -----------------------------
+    kill1 = rnd.randint(4, TOTAL_ITERS // 2 - 1)
+    kill2 = rnd.randint(TOTAL_ITERS // 2 + 2, TOTAL_ITERS - 4)
+    d2 = os.path.join(base, "resize")
+    reg = MetricsRegistry()
+    sp2 = _ResizeSpawner(d2, kills=[(kill1, signal.SIGKILL),
+                                    (kill2, signal.SIGKILL)])
+    res2 = _run_elastic(d2, sp2, log,
+                        widths=[WIDTH_FULL, WIDTH_HALF, WIDTH_FULL],
+                        max_restarts=3, registry=reg)
+    assert res2["ok"] and res2["restarts"] == 2, res2
+    assert sp2.rcs == [-signal.SIGKILL, -signal.SIGKILL, 0], sp2.rcs
+    assert sp2.widths == [WIDTH_FULL, WIDTH_HALF, WIDTH_FULL], sp2.widths
+
+    # both restarts were resizes: reshard events with the right widths,
+    # restart counter under reason="resize"
+    reshards = [e for e in res2["events"] if e["event"] == "reshard"]
+    assert [(e["from_width"], e["to_width"]) for e in reshards] == \
+        [(WIDTH_FULL, WIDTH_HALF), (WIDTH_HALF, WIDTH_FULL)], reshards
+    r = reg.counter("dl4j_tpu_training_restarts_total", "", ("reason",))
+    assert r.labels("resize").value == 2, r.labels("resize").value
+
+    # ZeRO-1 state restored SHARDED onto both widths: nonzero moments on
+    # every resumed boot, per-device slice dim == DIM0 / width
+    boots = [json.loads(ln) for run in
+             _parse_runs(os.path.join(d2, BOOTS_LOG)) for ln in run]
+    assert [b["width"] for b in boots] == \
+        [WIDTH_FULL, WIDTH_HALF, WIDTH_FULL], boots
+    assert [b["resumed"] for b in boots] == [False, True, True], boots
+    assert boots[0]["opt_norm"] == 0.0, boots[0]
+    for b in boots[1:]:
+        assert b["opt_norm"] > 0.0, b
+    for b in boots:
+        assert b["shard_dim0"] == DIM0 // b["width"], b
+
+    # nothing trained twice, nothing skipped: committed prefixes + final
+    # run == the reference sequence exactly, across BOTH width changes
+    c1 = sp2.committed_between[0]["iteration"]
+    c2 = sp2.committed_between[1]["iteration"]
+    assert 0 < c1 <= kill1 + 2, (c1, kill1)
+    assert c1 < c2 <= kill2 + 2, (c1, c2, kill2)
+    assert [b["start_iter"] for b in boots] == [0, c1, c2], (boots, c1, c2)
+    P1, P2, R = _parse_runs(os.path.join(d2, CONSUMED_LOG))
+    assert len(P1) >= c1 and len(P2) >= c2 - c1, (len(P1), len(P2), c1, c2)
+    assert P1[:c1] + P2[:c2 - c1] + R == S, (c1, c2, len(P1), len(P2),
+                                             len(R))
+
+    # trajectory quality gate vs the fixed-width reference: the widths
+    # only reorder the same global-batch reduction, so the final eval
+    # loss must agree to float tolerance
+    fin2 = _final(d2)
+    assert fin2["iteration"] == TOTAL_ITERS, fin2
+    gate = max(0.02, 0.05 * abs(fin1["eval_loss"]))
+    assert abs(fin2["eval_loss"] - fin1["eval_loss"]) <= gate, \
+        (fin2["eval_loss"], fin1["eval_loss"], gate)
+
+    # goodput ledger: outage itemized, resize boot time priced as reshard
+    gp = res2["goodput"]
+    assert 0.0 < gp["ratio"] <= 1.0, gp
+    assert gp["downtime_seconds"]["reshard"] > 0.0, gp
+    assert gp["wall_seconds"] >= gp["useful_seconds"], gp
+
+    log(f"[2/2] resize {WIDTH_FULL}->{WIDTH_HALF}->{WIDTH_FULL}: SIGKILL "
+        f"at {kill1} (committed {c1}) and {kill2} (committed {c2}), "
+        f"re-sharded resume on both widths, eval loss "
+        f"{fin2['eval_loss']:.4f} vs {fin1['eval_loss']:.4f} (gate "
+        f"{gate:.3f}), goodput {gp['ratio']:.3f} with "
+        f"{gp['downtime_seconds']['reshard']:.2f}s reshard downtime")
+    log("elastic resize contract OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
