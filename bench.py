@@ -2646,6 +2646,185 @@ def measure_elastic_goodput(total_iters: int = 320,
     }
 
 
+def measure_paged_kv_occupancy(vocab: int = 23, hidden: int = 32,
+                               layers: int = 2, heads: int = 4,
+                               max_len: int = 32, block_size: int = 4,
+                               static_slots: int = 4,
+                               paged_slots: int = 16,
+                               n_requests: int = 12,
+                               prompt_len: int = 5, gen_tokens: int = 6,
+                               ratio_gate: float = 1.5,
+                               match_gate: float = 1.0) -> dict:
+    """Paged-KV occupancy row (ISSUE 17 acceptance): peak RESIDENT
+    sequences under a short-sequence burst at a fixed KV HBM budget —
+    a static slot x max_len DecodeEngine vs the paged engine whose block
+    pool holds the SAME bytes (static_slots * max_len / block_size
+    blocks, + the reserved trash block). Short rows only pin the blocks
+    they touch, so the paged engine packs more concurrent streams into
+    the same cache memory (the vLLM capacity claim); the gate is >= 1.5x
+    measured peak residency, with greedy streams token-identical to the
+    static engine (paging must not change what the model says)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.model.zoo import TransformerLM
+    from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+    from deeplearning4j_tpu.parallel.decode import DecodeEngine
+
+    lm = TransformerLM(vocab_size=vocab, hidden=hidden, n_layers=layers,
+                       n_heads=heads, max_len=max_len).init()
+    rng = np.random.RandomState(0)
+    prompts = [[int(t) for t in rng.randint(1, vocab, size=prompt_len)]
+               for _ in range(n_requests)]
+    # equal-HBM pool: exactly the static engine's block count (+1 for
+    # the reserved trash block, which holds no sequence data)
+    pool_blocks = static_slots * (max_len // block_size) + 1
+
+    def burst(eng):
+        peak = {"rows": 0, "blocks": 0}
+
+        def hook():
+            st = eng.stats()
+            peak["rows"] = max(peak["rows"], int(eng._active.sum()))
+            if st["kv_blocks_total"] is not None:
+                peak["blocks"] = max(
+                    peak["blocks"],
+                    st["kv_blocks_total"] - st["kv_blocks_free"])
+        eng._step_hook = hook
+        try:
+            hs = [eng.submit(p, max_tokens=gen_tokens) for p in prompts]
+            return [h.result(timeout=300) for h in hs], peak
+        finally:
+            eng.shutdown()
+
+    static_tokens, static_peak = burst(
+        DecodeEngine(lm, max_len=max_len, slots=static_slots,
+                     registry=MetricsRegistry(), name="kv-bench-static"))
+    paged_tokens, paged_peak = burst(
+        DecodeEngine(lm, max_len=max_len, slots=paged_slots,
+                     block_size=block_size, num_kv_blocks=pool_blocks,
+                     registry=MetricsRegistry(), name="kv-bench-paged"))
+
+    pairs = [(a, b) for ra, rb in zip(static_tokens, paged_tokens)
+             for a, b in zip(ra, rb)]
+    match_rate = float(np.mean([a == b for a, b in pairs]))
+    ratio = paged_peak["rows"] / max(static_peak["rows"], 1)
+    return {
+        "kv_pool_blocks": pool_blocks - 1,
+        "block_size": block_size,
+        "static_peak_resident_seqs": static_peak["rows"],
+        "paged_peak_resident_seqs": paged_peak["rows"],
+        "paged_peak_blocks_used": paged_peak["blocks"],
+        "paged_occupancy_ratio": round(ratio, 3),
+        "occupancy_ratio_gate": {"min": ratio_gate,
+                                 "ok": bool(ratio >= ratio_gate)},
+        "greedy_token_match_rate": round(match_rate, 4),
+        "token_match_gate": {"min": match_gate,
+                             "ok": bool(match_rate >= match_gate)},
+        "note": (f"{n_requests} short requests (prompt {prompt_len} + "
+                 f"{gen_tokens} generated) against the KV bytes of "
+                 f"{static_slots} static slots x max_len {max_len}; "
+                 "paged rows pin only the blocks they touch"),
+    }
+
+
+def measure_disagg_handoff(vocab: int = 23, hidden: int = 32,
+                           layers: int = 2, heads: int = 4,
+                           max_len: int = 32, prompt_len: int = 6,
+                           gen_tokens: int = 8,
+                           match_gate: float = 1.0) -> dict:
+    """Disaggregated prefill/decode handoff row (ISSUE 17 acceptance):
+    the wire cost of splitting the two serving phases — serialized
+    handoff bytes for one request's cache state, and prefill-to-first-
+    token latency through the full hop (prefill on a PrefillEngine,
+    serialize, deserialize, resume on a paged DecodeEngine) vs the same
+    model decoding unified. The resumed stream must be token-identical
+    to unbroken local generation (gate: match rate >= 1.0); latency and
+    bytes are the numbers a deployment sizes its fabric against."""
+    import numpy as np
+
+    from deeplearning4j_tpu.model.zoo import TransformerLM
+    from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+    from deeplearning4j_tpu.parallel.decode import DecodeEngine
+    from deeplearning4j_tpu.serving.disagg import (PrefillEngine,
+                                                   deserialize_handoff,
+                                                   serialize_handoff)
+
+    lm = TransformerLM(vocab_size=vocab, hidden=hidden, n_layers=layers,
+                       n_heads=heads, max_len=max_len).init()
+    rng = np.random.RandomState(0)
+    prompt = [int(t) for t in rng.randint(1, vocab, size=prompt_len)]
+
+    pe = PrefillEngine(lm, max_len=max_len, registry=MetricsRegistry(),
+                       name="disagg-bench-pre")
+    eng = DecodeEngine(lm, max_len=max_len, slots=4, block_size=4,
+                       registry=MetricsRegistry(),
+                       name="disagg-bench-dec")
+
+    def first_token_latency(start_fn):
+        start = time.perf_counter()
+        handle = start_fn()
+        for ev in handle.events(timeout=120):
+            if "token" in ev:
+                break
+        latency = time.perf_counter() - start
+        handle.result(timeout=120)  # drain the stream before reuse
+        return latency
+
+    try:
+        # unified baseline: prefill + decode on one engine
+        first_token_latency(lambda: eng.submit(prompt,
+                                               max_tokens=gen_tokens))
+        unified = statistics.median(
+            first_token_latency(
+                lambda: eng.submit(prompt, max_tokens=gen_tokens))
+            for _ in range(REPEATS))
+
+        # disaggregated hop: prefill -> bytes -> resume
+        wire = serialize_handoff(pe.prefill(prompt,
+                                            max_tokens=gen_tokens))
+        handoff_bytes = len(wire)
+        first_token_latency(
+            lambda: eng.submit_prefilled(deserialize_handoff(wire)))
+
+        def two_hop():
+            start = time.perf_counter()
+            w = serialize_handoff(pe.prefill(prompt,
+                                             max_tokens=gen_tokens))
+            handle = eng.submit_prefilled(deserialize_handoff(w))
+            for ev in handle.events(timeout=120):
+                if "token" in ev:
+                    break
+            latency = time.perf_counter() - start
+            return latency, handle.result(timeout=120)
+
+        latencies, resumed = [], None
+        for _ in range(REPEATS):
+            latency, resumed = two_hop()
+            latencies.append(latency)
+        disagg = statistics.median(latencies)
+        local = eng.submit(prompt, max_tokens=gen_tokens).result(
+            timeout=120)
+    finally:
+        eng.shutdown()
+
+    match_rate = float(np.mean([a == b
+                                for a, b in zip(local, resumed)]))
+    return {
+        "handoff_bytes": handoff_bytes,
+        "handoff_bytes_per_prompt_token": round(
+            handoff_bytes / prompt_len, 1),
+        "prefill_to_first_token_s_disagg": round(disagg, 4),
+        "prefill_to_first_token_s_unified": round(unified, 4),
+        "handoff_overhead_s": round(disagg - unified, 4),
+        "resumed_token_match_rate": round(match_rate, 4),
+        "token_match_gate": {"min": match_gate,
+                             "ok": bool(match_rate >= match_gate)},
+        "note": ("in-process hop: serialize + deserialize are on the "
+                 "timed path, the network is not — wire time adds "
+                 "handoff_bytes / fabric bandwidth"),
+    }
+
+
 _MEASUREMENTS = {
     "lenet": measure_lenet,
     "resnet50": measure_resnet50,
@@ -2674,6 +2853,8 @@ _MEASUREMENTS = {
     "int8_kv_cache": measure_int8_kv_cache,
     "checkpoint_stall": measure_checkpoint_stall,
     "elastic_goodput": measure_elastic_goodput,
+    "paged_kv_occupancy": measure_paged_kv_occupancy,
+    "disagg_handoff": measure_disagg_handoff,
 }
 
 # extras row name -> measurement name (the artifact's "extras" keys, in
@@ -2702,6 +2883,8 @@ _EXTRA_ROWS = {
     "int8_kv_cache": "int8_kv_cache",
     "checkpoint_stall": "checkpoint_stall",
     "elastic_goodput": "elastic_goodput",
+    "paged_kv_occupancy": "paged_kv_occupancy",
+    "disagg_handoff": "disagg_handoff",
 }
 # rows that only produce meaningful numbers on the chip (skipped with a
 # note under --rows on a cpu-fallback host)

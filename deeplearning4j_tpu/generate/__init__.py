@@ -20,6 +20,16 @@ from .sampling import (
     top_k,
     top_p,
 )
+from .paged import (
+    BlockAllocator,
+    OutOfBlocksError,
+    block_bytes,
+    blocks_needed,
+    freeze_rows,
+    is_paged,
+    paged_decode_state,
+    redirect_inactive_writes,
+)
 from .session import (
     CACHE_DTYPES,
     GenerationSession,
@@ -42,15 +52,23 @@ def __getattr__(name):
 
 
 __all__ = [
+    "BlockAllocator",
     "CACHE_DTYPES",
     "DecodeEngine",
     "GenerationHandle",
     "GenerationSession",
+    "OutOfBlocksError",
     "SpeculativeGenerationSession",
+    "block_bytes",
+    "blocks_needed",
     "bucket_length",
+    "freeze_rows",
     "greedy",
+    "is_paged",
     "make_sampler",
+    "paged_decode_state",
     "quantize_decode_state",
+    "redirect_inactive_writes",
     "rewind_carry",
     "sample_tokens",
     "speculative_accept",

@@ -201,16 +201,13 @@ class GenerationSession:
 
     def _freeze_fn(self):
         """jit: keep carry rows where ``active`` is False unchanged (an
-        idle slot must not advance its cache/positions)."""
+        idle slot must not advance its cache/positions). Paged-aware:
+        shared block pools are kept wholesale (inactive writes went to
+        the trash block) and block tables restored (paged.freeze_rows)."""
         if "freeze" not in self._fns:
-            def fn(new, old, active):
-                def sel(n, o):
-                    a = active.reshape((-1,) + (1,) * (n.ndim - 1))
-                    return jnp.where(a, n, o)
+            from .paged import freeze_rows
 
-                return jax.tree_util.tree_map(sel, new, old)
-
-            self._fns["freeze"] = jax.jit(fn)
+            self._fns["freeze"] = jax.jit(freeze_rows)
         return self._fns["freeze"]
 
     # ----- host API ----------------------------------------------------
@@ -295,7 +292,8 @@ class GenerationSession:
 # ---------------------------------------------------------------------------
 
 _REWINDABLE_KEYS = frozenset({"cache_k", "cache_v", "pos",
-                              "cache_k_scale", "cache_v_scale"})
+                              "cache_k_scale", "cache_v_scale",
+                              "block_table"})
 
 
 def _check_rewindable(session: GenerationSession, role: str) -> None:
@@ -385,8 +383,14 @@ class SpeculativeGenerationSession:
 
             def fn(tparams, tstate, dparams, dstate, tcarry, dcarry, last,
                    steps, active, seeds, gmask, temps, ks, ps, spec_ks):
+                from .paged import freeze_rows, redirect_inactive_writes
+
+                # paged carries: inactive rows' writes go to the trash
+                # block instead of their own live blocks (the fused step
+                # writes every row; freeze_rows restores their tables)
+                tfwd = redirect_inactive_writes(tcarry, active)
                 # ---- propose: k draft tokens, draft cache kept aligned
-                cur, feed = dcarry, last
+                cur, feed = redirect_inactive_writes(dcarry, active), last
                 toks, logits_list = [], []
                 for i in range(k + 1):
                     out, _, cur = dsess.model.forward_pure(
@@ -406,19 +410,15 @@ class SpeculativeGenerationSession:
                 tokens_in = jnp.concatenate([last[:, None], d_toks], axis=1)
                 out, _, tnew = tsess.model.forward_pure(
                     tparams, tstate, tsess._prep(tokens_in), train=False,
-                    rng=None, mask=None, rnn_state=tcarry)
+                    rng=None, mask=None, rnn_state=tfwd)
                 t_logits = tsess._logits(out).transpose(0, 2, 1)  # [b,t,V]
                 # ---- accept (exact), freeze idle rows, rewind both
                 otoks, n_acc, n_emit = speculative_accept(
                     d_toks, d_logits, t_logits, seeds, steps, spec_ks,
                     gmask, temps, ks, ps)
 
-                def sel(n, o):
-                    a = active.reshape((-1,) + (1,) * (n.ndim - 1))
-                    return jnp.where(a, n, o)
-
-                tnew = jax.tree_util.tree_map(sel, tnew, tcarry)
-                dnew = jax.tree_util.tree_map(sel, cur, dcarry)
+                tnew = freeze_rows(tnew, tcarry, active)
+                dnew = freeze_rows(cur, dcarry, active)
                 delta = jnp.where(active, (k + 1) - n_emit, 0)
                 return (rewind_carry(tnew, delta),
                         rewind_carry(dnew, delta), otoks, n_acc, n_emit)

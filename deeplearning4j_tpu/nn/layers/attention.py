@@ -102,6 +102,31 @@ def _cached_attention(q, k_new, v_new, state, mask):
     pos = state["pos"].astype(jnp.int32)
     valid = (jnp.asarray(t, jnp.int32) if mask is None
              else jnp.sum(mask > 0, axis=1).astype(jnp.int32))
+    if "block_table" in state:  # paged KV cache (shared block pools)
+        from ...ops import paged_cache_write, paged_decode_attention
+
+        table = state["block_table"]
+        if "cache_k_scale" in state:  # int8 blocks + f32 scale pools
+            kq, ks = quantize_kv_rows(k_new)
+            vq, vs = quantize_kv_rows(v_new)
+            cache_k = paged_cache_write(state["cache_k"], kq, table, pos)
+            cache_v = paged_cache_write(state["cache_v"], vq, table, pos)
+            k_scale = paged_cache_write(state["cache_k_scale"], ks,
+                                        table, pos)
+            v_scale = paged_cache_write(state["cache_v_scale"], vs,
+                                        table, pos)
+            o = paged_decode_attention(q, cache_k, cache_v, table, pos,
+                                       k_scale=k_scale, v_scale=v_scale)
+            new_state = {"cache_k": cache_k, "cache_v": cache_v,
+                         "cache_k_scale": k_scale, "cache_v_scale": v_scale,
+                         "block_table": table, "pos": pos + valid}
+            return o, new_state
+        cache_k = paged_cache_write(state["cache_k"], k_new, table, pos)
+        cache_v = paged_cache_write(state["cache_v"], v_new, table, pos)
+        o = paged_decode_attention(q, cache_k, cache_v, table, pos)
+        new_state = {"cache_k": cache_k, "cache_v": cache_v,
+                     "block_table": table, "pos": pos + valid}
+        return o, new_state
     if "cache_k_scale" in state:  # int8 KV cache
         kq, ks = quantize_kv_rows(k_new)
         vq, vs = quantize_kv_rows(v_new)

@@ -33,6 +33,12 @@ from .moe_dispatch import (
     scatter_combine,
     top_k_routing,
 )
+from .paged_attention import (
+    pack_row_blocks,
+    paged_cache_write,
+    paged_decode_attention,
+    paged_gather,
+)
 
 __all__ = [
     "attention_impl",
@@ -52,6 +58,10 @@ __all__ = [
     "DispatchPlan",
     "gather_dispatch",
     "make_dispatch_plan",
+    "pack_row_blocks",
+    "paged_cache_write",
+    "paged_decode_attention",
+    "paged_gather",
     "scatter_combine",
     "top_k_routing",
 ]

@@ -67,8 +67,18 @@ from ..core.resilience import (
     CircuitState,
     Deadline,
 )
+from ..generate.paged import (
+    BlockAllocator,
+    OutOfBlocksError,
+    block_bytes,
+    blocks_needed,
+    freeze_rows,
+    paged_decode_state,
+    redirect_inactive_writes,
+)
 from ..generate.sampling import sample_tokens
 from ..generate.session import GenerationSession, SpeculativeGenerationSession
+from ..ops.paged_attention import pack_row_blocks
 from ..obs.metrics import MetricsRegistry, get_registry
 from ..obs.tracing import Tracer, current_context, get_tracer, trace_now
 
@@ -162,10 +172,11 @@ class GenerationHandle:
 class _Request:
     __slots__ = ("prompt", "max_tokens", "eos_id", "handle", "seed",
                  "greedy", "temp", "top_k", "top_p", "spec_k", "trace_ctx",
-                 "t_submit", "t_decode_start")
+                 "t_submit", "t_decode_start", "prefilled")
 
     def __init__(self, prompt, max_tokens, eos_id, handle, seed, greedy,
-                 temp, top_k, top_p, spec_k, trace_ctx) -> None:
+                 temp, top_k, top_p, spec_k, trace_ctx,
+                 prefilled=None) -> None:
         self.prompt = prompt
         self.max_tokens = max_tokens
         self.eos_id = eos_id
@@ -176,6 +187,7 @@ class _Request:
         self.top_k = top_k
         self.top_p = top_p
         self.spec_k = spec_k  # None = follow the engine's adaptive k
+        self.prefilled = prefilled  # disagg handoff payload (or None)
         self.trace_ctx = trace_ctx
         self.t_submit = trace_now() if trace_ctx is not None else 0.0
         self.t_decode_start = 0.0
@@ -204,6 +216,8 @@ class DecodeEngine:
         target_p95_s: float = 0.05,
         adjust_interval: float = 0.5,
         cache_dtype: Optional[str] = None,
+        block_size: Optional[int] = None,
+        num_kv_blocks: Optional[int] = None,
     ) -> None:
         """``draft_model=`` turns on speculative decoding: the draft
         proposes up to ``speculative_k`` tokens per step, one tq=k+1
@@ -218,7 +232,17 @@ class DecodeEngine:
         (per-slot/per-head scales on the carry; dequant inside the decode
         attention) — the same cache HBM budget holds ~2× the concurrent
         sequences of an fp16 cache, at a bounded logit error the greedy
-        token-match bench row gates (``int8_kv_cache``)."""
+        token-match bench row gates (``int8_kv_cache``).
+        ``block_size=`` switches the cache to the PAGED layout (ISSUE
+        17): fixed-size blocks in a shared per-layer pool of
+        ``num_kv_blocks`` (default: the static layout's capacity,
+        ``slots * max_len / block_size`` plus the trash block) with
+        per-row block tables, allocated at admit, grown as rows advance
+        and freed at retire/cancel — a resident sequence costs blocks
+        for its USED tokens, not ``max_len``, so short sequences stop
+        paying for headroom they never touch. Greedy streams are
+        token-identical to the static layout; composes with
+        ``cache_dtype="int8"`` (per-block scale planes)."""
         if draft_model is not None:
             self._spec = SpeculativeGenerationSession(
                 model, draft_model, max_len=max_len,
@@ -231,6 +255,20 @@ class DecodeEngine:
         self.cache_dtype = cache_dtype
         self.max_len = int(max_len)
         self.slots = int(slots)
+        # paged KV cache config (None = static slot×max_len layout)
+        self.block_size = None if block_size is None else int(block_size)
+        if self.block_size is not None:
+            if self.block_size < 1:
+                raise ValueError("block_size must be >= 1")
+            if self.max_len % self.block_size:
+                raise ValueError(
+                    f"max_len {self.max_len} not divisible by block_size "
+                    f"{self.block_size}")
+            self.num_kv_blocks = (
+                self.slots * (self.max_len // self.block_size) + 1
+                if num_kv_blocks is None else int(num_kv_blocks))
+        else:
+            self.num_kv_blocks = None
         self.default_timeout = default_timeout
         self.default_max_tokens = int(default_max_tokens)
         self._clock = clock
@@ -249,16 +287,39 @@ class DecodeEngine:
         self._init_metrics(registry if registry is not None else get_registry())
 
         # device-side batch state: one preallocated carry, per-row specs
-        self._carry = self.session.decode_state(self.slots)
+        if self.block_size is not None:
+            self._carry = paged_decode_state(
+                self.session, self.slots, block_size=self.block_size,
+                num_blocks=self.num_kv_blocks)
+            self._allocator = BlockAllocator(self.num_kv_blocks)
+            # host image of every row's block list (pushed to the device
+            # carry as one shared [slots, max_len/bs] leaf on change)
+            self._block_tables = np.zeros(
+                (self.slots, self.max_len // self.block_size), np.int32)
+            self._nblocks = np.zeros((self.slots,), np.int32)
+            self._block_bytes = block_bytes(self.session, self.block_size)
+            self._push_tables()
+        else:
+            self._carry = self.session.decode_state(self.slots)
+            self._allocator = None
         self._row_template = self.session.decode_state(1)
+        # the draft cache stays static (slot×max_len): proposals run every
+        # slot each turn, and the draft rows rewind with the target's
         self._draft_carry = (None if self._spec is None
                              else self._spec.draft.decode_state(self.slots))
         self._draft_row = (None if self._spec is None
                            else self._spec.draft.decode_state(1))
-        self._kv_cache_bytes = int(sum(
-            l.size * l.dtype.itemsize for l in jax.tree_util.tree_leaves(
-                (self._carry, self._draft_carry))))
-        self._g_kv_bytes.set(self._kv_cache_bytes)
+        self._aux_kv_bytes = int(sum(
+            l.size * l.dtype.itemsize
+            for l in jax.tree_util.tree_leaves(self._draft_carry)))
+        if self._allocator is None:
+            # static layout: resident bytes are the preallocated carry
+            self._kv_cache_bytes = self._aux_kv_bytes + int(sum(
+                l.size * l.dtype.itemsize
+                for l in jax.tree_util.tree_leaves(self._carry)))
+            self._g_kv_bytes.set(self._kv_cache_bytes)
+        else:
+            self._update_kv_bytes()
         self._active = np.zeros((self.slots,), bool)
         self._last = np.zeros((self.slots,), np.int32)
         self._steps = np.zeros((self.slots,), np.int32)
@@ -343,13 +404,83 @@ class DecodeEngine:
         self._g_slot_target.set(self._slot_target)
         self._g_kv_bytes = reg.gauge(
             "dl4j_tpu_generate_kv_cache_bytes",
-            "Resident bytes of the preallocated decode carry (target + "
-            "draft KV caches across all slots; int8 caches hold ~1/2 the "
-            "fp16 bytes per sequence)", ("instance",)).labels(inst)
+            "Live resident bytes of the decode KV cache: the full "
+            "preallocated carry for the static layout, allocated blocks "
+            "x block bytes (+ the static draft cache) for the paged one "
+            "— updated on admit/grow/retire, so the gauge tracks what "
+            "resident sequences actually hold", ("instance",)).labels(inst)
 
     @property
     def tracer(self) -> Tracer:
         return self._tracer if self._tracer is not None else get_tracer()
+
+    # ----- paged block accounting (engine-loop thread only) ------------
+    def _update_kv_bytes(self) -> None:
+        used = self._allocator.total_blocks - self._allocator.free_blocks
+        self._kv_cache_bytes = used * self._block_bytes + self._aux_kv_bytes
+        self._g_kv_bytes.set(self._kv_cache_bytes)
+
+    def _push_tables(self) -> None:
+        """Mirror the host block tables into the device carry as ONE
+        shared ``[slots, max_len/bs]`` leaf (same shape/dtype every push
+        — no recompiles)."""
+        tbl = jnp.asarray(self._block_tables)
+        self._carry = {
+            name: ({**st, "block_table": tbl} if "block_table" in st
+                   else st)
+            for name, st in self._carry.items()}
+
+    def _ensure_blocks(self, slot: int, upto: int) -> None:
+        """Grow ``slot``'s block list to cover positions ``[0, upto)``.
+        All-or-nothing: raises :class:`OutOfBlocksError` without touching
+        any state when the pool cannot satisfy it."""
+        need = blocks_needed(upto, self.block_size)
+        held = int(self._nblocks[slot])
+        if need <= held:
+            return
+        ids = self._allocator.alloc(need - held)
+        self._block_tables[slot, held:need] = ids
+        self._nblocks[slot] = need
+        self._push_tables()
+        self._update_kv_bytes()
+
+    def _release_blocks(self, slot: int) -> None:
+        if self._allocator is None:
+            return
+        held = int(self._nblocks[slot])
+        if held:
+            self._allocator.free(self._block_tables[slot, :held].tolist())
+            self._block_tables[slot, :held] = 0
+            self._nblocks[slot] = 0
+            self._push_tables()
+            self._update_kv_bytes()
+
+    def _preempt_row(self, slot: int, why: str) -> None:
+        """A mid-stream allocation failed and nothing retires this turn:
+        fail the row cleanly (partial tokens already streamed) and return
+        its blocks to the pool."""
+        req = self._requests[slot]
+        self._requests[slot] = None
+        self._active[slot] = False
+        self._release_blocks(slot)
+        self._g_active.set(int(self._active.sum()))
+        if req is not None:
+            self._finish(req, "failed", error=why)
+
+    def _reserve_rows(self, rows: np.ndarray, ahead: int) -> np.ndarray:
+        """Reserve ``ahead`` positions past each row's frontier before a
+        fused step. Rows the pool cannot back are preempted (their freed
+        blocks may rescue later rows in the same sweep); returns the
+        surviving row mask."""
+        rows = rows.copy()
+        for slot in np.nonzero(rows)[0]:
+            try:
+                self._ensure_blocks(int(slot), int(self._pos[slot]) + ahead)
+            except OutOfBlocksError as e:
+                rows[slot] = False
+                self._preempt_row(int(slot),
+                                  f"kv block pool exhausted: {e}")
+        return rows
 
     # ----- jitted steps -----------------------------------------------
     def _prefill_fn(self, tb: int):
@@ -403,18 +534,17 @@ class DecodeEngine:
 
             def fn(params, state, carry, tokens, active, seeds, steps,
                    gmask, temps, ks, ps):
+                # paged carries: inactive rows write the trash block, not
+                # their own live blocks (the fused step writes every row)
+                fwd = redirect_inactive_writes(carry, active)
                 out, _, new_rnn = model.forward_pure(
                     params, state, sess._prep(tokens[:, None]), train=False,
-                    rng=None, mask=None, rnn_state=carry)
+                    rng=None, mask=None, rnn_state=fwd)
                 logits = sess._logits(out)[:, :, 0]
                 toks = sample_tokens(logits, seeds, steps, gmask, temps, ks,
                                      ps)
                 # idle/finished slots must not advance their cache or (h, c)
-                def sel(n, o):
-                    a = active.reshape((-1,) + (1,) * (n.ndim - 1))
-                    return jnp.where(a, n, o)
-
-                new_rnn = jax.tree_util.tree_map(sel, new_rnn, carry)
+                new_rnn = freeze_rows(new_rnn, carry, active)
                 return new_rnn, jnp.where(active, toks, 0)
 
             self._fns["decode"] = jax.jit(fn)
@@ -433,6 +563,48 @@ class DecodeEngine:
 
             self._fns["write"] = jax.jit(fn)
         return self._fns["write"]
+
+    def _paged_install_fn(self):
+        """jit: install a 1-row STATIC prefill carry into the paged batch
+        carry — pack each cache plane into block units and scatter them
+        at the slot's block ids (``dest``, static length max_len/bs: the
+        unallocated tail is id 0, so pad blocks land in trash). One
+        compiled program total, regardless of prompt length."""
+        if "paged_install" not in self._fns:
+            bs = self.block_size
+
+            def fn(carry, row, dest, slot):
+                out = {}
+                for name, st in carry.items():
+                    r = row[name]
+                    new_st = dict(st)
+                    for key, pool in st.items():
+                        if key == "pos":
+                            new_st[key] = jax.lax.dynamic_update_slice(
+                                pool, r["pos"].astype(pool.dtype), (slot,))
+                        elif key != "block_table":
+                            packed = pack_row_blocks(r[key][0], bs)
+                            new_st[key] = pool.at[dest].set(
+                                packed.astype(pool.dtype))
+                    out[name] = new_st
+                return out
+
+            self._fns["paged_install"] = jax.jit(fn)
+        return self._fns["paged_install"]
+
+    def _install_row(self, slot: int, row) -> None:
+        """Scatter a fresh 1-row target carry into the batch carry (the
+        static dynamic-update-slice, or the paged block scatter)."""
+        if self._allocator is None:
+            self._carry = self._write_row_fn()(
+                self._carry, row, jnp.asarray(slot, jnp.int32))
+            return
+        dest = np.zeros((self._block_tables.shape[1],), np.int32)
+        held = int(self._nblocks[slot])
+        dest[:held] = self._block_tables[slot, :held]
+        self._carry = self._paged_install_fn()(
+            self._carry, row, jnp.asarray(dest),
+            jnp.asarray(slot, jnp.int32))
 
     # ----- client side ------------------------------------------------
     def submit(
@@ -506,6 +678,74 @@ class DecodeEngine:
         """Blocking convenience: submit + wait for the full token list."""
         return self.submit(prompt, **kw).result()
 
+    def submit_prefilled(
+        self,
+        handoff: dict,
+        *,
+        timeout: Optional[float] = None,
+        deadline: Optional[Deadline] = None,
+        request_id: Optional[str] = None,
+        priority: Optional[str] = None,
+    ) -> GenerationHandle:
+        """Admit a request whose prefill already ran on another host (the
+        disaggregated-serving resume path). ``handoff`` is the dict built
+        by :class:`~deeplearning4j_tpu.serving.disagg.PrefillEngine` —
+        prompt, sampled first token, per-layer cache slices and the
+        sampling law. The decode stream continues token-identically to a
+        local :meth:`submit` of the same prompt/sampling."""
+        prompt = [int(t) for t in handoff.get("prompt", ())]
+        if not prompt:
+            raise ValueError("empty prompt in handoff")
+        if len(prompt) >= self.max_len:
+            raise ValueError(
+                f"handoff prompt length {len(prompt)} >= max_len "
+                f"{self.max_len} — no room to generate")
+        hd = handoff.get("cache_dtype")
+        if hd != self.cache_dtype:
+            raise ValueError(
+                f"handoff cache_dtype {hd!r} != engine cache_dtype "
+                f"{self.cache_dtype!r}")
+        if int(handoff.get("pos", -1)) != len(prompt):
+            raise ValueError("handoff pos != prompt length")
+        s = dict(handoff.get("sampling", {}))
+        spec_k = s.get("speculative_k")
+        if spec_k is not None and int(spec_k) < 0:
+            raise ValueError("speculative_k must be >= 0")
+        if deadline is None:
+            deadline = Deadline.after(
+                timeout if timeout is not None else self.default_timeout,
+                clock=self._clock)
+        mt = int(s.get("max_tokens") or self.default_max_tokens)
+        mt = max(1, min(mt, self.max_len - len(prompt)))
+        handle = GenerationHandle(request_id or f"{self.name}-req", deadline)
+        tracer = self.tracer
+        ctx = current_context() if tracer.enabled else None
+        eos = s.get("eos_id")
+        req = _Request(prompt, mt, None if eos is None else int(eos), handle,
+                       int(s.get("seed", 0)) & 0xFFFFFFFF,
+                       bool(s.get("greedy", True)),
+                       float(s.get("temperature", 1.0)),
+                       int(s.get("top_k", 0)), float(s.get("top_p", 1.0)),
+                       None if spec_k is None else int(spec_k), ctx,
+                       prefilled=handoff)
+        with self._lock:
+            if self._shutdown or self._draining:
+                raise RuntimeError("DecodeEngine is shut down" if
+                                   self._shutdown else
+                                   "DecodeEngine is draining")
+            if self._breaker.state is CircuitState.OPEN:
+                self._c["circuit_rejected"].inc()
+                raise CircuitOpenError(retry_after=self._breaker.retry_after())
+            try:
+                self._admission.admit(priority)
+            except Exception:
+                self._c["shed"].inc()
+                raise
+            self._g_inflight.inc()
+            self._pending.append(req)
+        self._wake.set()
+        return handle
+
     # ----- engine loop ------------------------------------------------
     def _finish(self, req: _Request, reason: str,
                 error: Optional[str] = None) -> None:
@@ -550,11 +790,32 @@ class DecodeEngine:
                 continue
             try:
                 self._prefill_into(slot, req)
+            except OutOfBlocksError as e:
+                # transient when rows are mid-flight (their blocks free at
+                # retire): requeue and retry next wake. Terminal when the
+                # batch is idle — the prompt can never fit this pool.
+                if self._active.any():
+                    with self._lock:
+                        self._pending.appendleft(req)
+                    return
+                self._finish(req, "failed", error=str(e))
             except Exception as e:  # noqa: BLE001 — fail the request, not the loop
                 self._breaker.record_failure()
                 self._finish(req, "failed", error=str(e))
 
     def _prefill_into(self, slot: int, req: _Request) -> None:
+        sess = self.session
+        if self._allocator is not None:
+            # reserve blocks for the committed prompt BEFORE any compute:
+            # OutOfBlocksError here is cheap and leaves nothing to undo
+            self._ensure_blocks(slot, len(req.prompt))
+        try:
+            self._prefill_into_reserved(slot, req)
+        except Exception:
+            self._release_blocks(slot)
+            raise
+
+    def _prefill_into_reserved(self, slot: int, req: _Request) -> None:
         sess = self.session
         tb = min(
             next(s for s in sess.bucket_sizes() if s >= len(req.prompt)),
@@ -563,28 +824,35 @@ class DecodeEngine:
         ids[0, : len(req.prompt)] = req.prompt
         t0 = time.perf_counter()
         tt0 = trace_now() if req.trace_ctx is not None else 0.0
-        row, tok = self._prefill_fn(tb)(
-            sess.model.params, sess.model.state, self._row_template,
-            jnp.asarray(ids), jnp.asarray([len(req.prompt)], jnp.int32),
-            jnp.asarray([req.seed], jnp.uint32),
-            jnp.asarray([req.greedy], bool),
-            jnp.asarray([req.temp], jnp.float32),
-            jnp.asarray([req.top_k], jnp.int32),
-            jnp.asarray([req.top_p], jnp.float32))
-        self._carry = self._write_row_fn()(
-            self._carry, row, jnp.asarray(slot, jnp.int32))
+        if req.prefilled is not None:
+            # disaggregated handoff: the prefill tier already ran the
+            # bucketed prefill and sampled the first token — install its
+            # shipped cache slice instead of recomputing
+            row, first = self._handoff_row(req.prefilled)
+        else:
+            row, tok = self._prefill_fn(tb)(
+                sess.model.params, sess.model.state, self._row_template,
+                jnp.asarray(ids), jnp.asarray([len(req.prompt)], jnp.int32),
+                jnp.asarray([req.seed], jnp.uint32),
+                jnp.asarray([req.greedy], bool),
+                jnp.asarray([req.temp], jnp.float32),
+                jnp.asarray([req.top_k], jnp.int32),
+                jnp.asarray([req.top_p], jnp.float32))
+            first = int(tok)
+        self._install_row(slot, row)
         cap = -1 if req.spec_k is None else min(req.spec_k,
                                                 self.max_speculative_k)
         if self._spec is not None and cap != 0:
             # paired draft cache: same prompt, same slot — proposals must
-            # condition on the same committed prefix the target verifies
+            # condition on the same committed prefix the target verifies.
+            # For handoffs this re-runs the (cheap) draft prefill locally:
+            # the draft cache never crosses the wire.
             drow = self._draft_prefill_fn(tb)(
                 self._spec.draft.model.params, self._spec.draft.model.state,
                 self._draft_row, jnp.asarray(ids),
                 jnp.asarray([len(req.prompt)], jnp.int32))
             self._draft_carry = self._write_row_fn()(
                 self._draft_carry, drow, jnp.asarray(slot, jnp.int32))
-        first = int(tok)
         self._h_prefill.observe(time.perf_counter() - t0)
         self._breaker.record_success()
         if req.trace_ctx is not None:
@@ -611,6 +879,41 @@ class DecodeEngine:
         req.handle._emit(0, first)
         self._retire_if_done(slot, first, emitted=1)
 
+    def _handoff_row(self, h: dict):
+        """Rebuild a 1-row target carry from a serialized prefill handoff
+        (shape/dtype-checked against this engine's row template). Cache
+        planes arrive trimmed to the used positions ``[0, pos)``; the tail
+        is zero-filled exactly like a fresh bucketed prefill leaves it."""
+        pos = int(h["pos"])
+        layers = h.get("layers", {})
+        row = {}
+        for name, st in self._row_template.items():
+            layer = layers.get(name)
+            if layer is None and set(st.keys()) - {"pos"}:
+                # pos-only carries (position counters) ship nothing; a
+                # layer WITH cache planes must be on the wire
+                raise ValueError(f"handoff missing cache for layer {name!r}")
+            new_st = {}
+            for key, t in st.items():
+                if key == "pos":
+                    new_st[key] = jnp.asarray([pos], t.dtype)
+                    continue
+                arr = layer.get(key)
+                if arr is None:
+                    raise ValueError(
+                        f"handoff layer {name!r} missing {key!r} — "
+                        "prefill/decode cache_dtype mismatch?")
+                want = t.shape[:2] + (pos,) + t.shape[3:]
+                if tuple(arr.shape) != want:
+                    raise ValueError(
+                        f"handoff {name}.{key} shape {tuple(arr.shape)} != "
+                        f"expected {want}")
+                full = np.zeros(t.shape, t.dtype)
+                full[:, :, :pos] = arr
+                new_st[key] = jnp.asarray(full, t.dtype)
+            row[name] = new_st
+        return row, int(h["first_token"])
+
     def _retire_if_done(self, slot: int, last_token: int, emitted: int) -> None:
         req = self._requests[slot]
         if req is None:
@@ -629,6 +932,7 @@ class DecodeEngine:
         if reason is not None:
             self._requests[slot] = None
             self._active[slot] = False
+            self._release_blocks(slot)
             self._g_active.set(int(self._active.sum()))
             self._finish(req, reason)
 
@@ -641,6 +945,7 @@ class DecodeEngine:
             if req is not None:
                 self._requests[slot] = None
                 self._active[slot] = False
+                self._release_blocks(slot)
                 self._finish(req, "failed", error=str(e))
         self._g_active.set(0)
 
@@ -650,6 +955,10 @@ class DecodeEngine:
         for rows whose remaining cache room cannot hold a k+1 window)."""
         sess = self.session
         rows = self._active if rows is None else rows
+        if self._allocator is not None:
+            rows = self._reserve_rows(rows, 1)
+            if not rows.any():
+                return
         t0 = time.perf_counter()
         try:
             self._carry, toks = self._decode_step_fn()(
@@ -692,6 +1001,17 @@ class DecodeEngine:
                         np.minimum(self._spec_caps, k)).astype(np.int32)
         spec_rows = (self._active & (caps > 0)
                      & (self._pos + k + 1 <= self.max_len))
+        if self._allocator is not None and spec_rows.any():
+            # a speculative window may write up to k+1 positions past the
+            # frontier; rows that can't reserve that many blocks degrade
+            # to the plain path (which reserves just 1, preempting only
+            # when even that fails)
+            spec_rows = spec_rows.copy()
+            for slot in np.nonzero(spec_rows)[0]:
+                try:
+                    self._ensure_blocks(slot, int(self._pos[slot]) + k + 1)
+                except OutOfBlocksError:
+                    spec_rows[slot] = False
         plain_rows = self._active & ~spec_rows
         if spec_rows.any():
             t0 = time.perf_counter()
@@ -854,6 +1174,11 @@ class DecodeEngine:
             "max_len": self.max_len,
             "cache_dtype": self.cache_dtype or str(self.session.model.dtype),
             "kv_cache_bytes": self._kv_cache_bytes,
+            "kv_block_size": self.block_size,
+            "kv_blocks_total": (None if self._allocator is None
+                                else self._allocator.total_blocks),
+            "kv_blocks_free": (None if self._allocator is None
+                               else self._allocator.free_blocks),
             "circuit_state": self._breaker.state.value,
             "draining": self._draining,
             # zero-guarded (PR-7 convention): derived ratios are None, not

@@ -902,6 +902,18 @@ class EnginePool:
                 prop += int(sp.get("proposed") or 0)
                 acc += int(sp.get("accepted") or 0)
                 steps += int(sp.get("steps") or 0)
+            # per-replica serving roles (disaggregated tier: prefill vs
+            # decode hosts; "unified" = classic single-host serving) and
+            # the per-role circuit aggregate — closed while ANY replica
+            # of the role can take traffic
+            roles = {e.name: getattr(e, "role", "unified")
+                     for e in self.decode_replicas}
+            role_circuits: dict = {}
+            for e in self.decode_replicas:
+                role_circuits.setdefault(roles[e.name], []).append(
+                    e.circuit_state)
+            rank = {CircuitState.CLOSED: 0, CircuitState.HALF_OPEN: 1,
+                    CircuitState.OPEN: 2}
             out["generate"] = {
                 "replicas": ([e.name for e in self.decode_replicas]
                              + sorted(remote_spec)),
@@ -909,6 +921,10 @@ class EnginePool:
                                for e in self.decode_replicas},
                 "circuits": {e.name: e.circuit_state.value
                              for e in self.decode_replicas},
+                "roles": roles,
+                "role_circuits": {
+                    r: min(states, key=rank.__getitem__).value
+                    for r, states in role_circuits.items()},
                 "proposed": prop,
                 "accepted": acc,
                 "steps": steps,

@@ -136,6 +136,7 @@ class RemoteReplica:
         fault_injector=None,
         registry: Optional[MetricsRegistry] = None,
         start_prober: bool = True,
+        role: str = "unified",
     ) -> None:
         u = urlparse(endpoint)
         if not u.scheme or not u.netloc:
@@ -148,6 +149,8 @@ class RemoteReplica:
         # collide in the pool's per-name failover bookkeeping
         self.name = name or f"remote-{u.netloc}-{next(_replica_seq)}"
         self.model_name = model_name
+        # serving role in a disaggregated tier (prefill | decode | unified)
+        self.role = str(role)
         self.connect_timeout = float(connect_timeout)
         self.read_timeout = float(read_timeout)
         self.deploy_timeout = float(deploy_timeout)
@@ -607,6 +610,7 @@ class RemoteReplica:
         out = {
             "name": self.name,
             "endpoint": self.endpoint,
+            "role": self.role,
             "remote": ident,
             "circuit_state": self._breaker.state.value,
             "queue_depth": inflight,

@@ -170,7 +170,8 @@ class JsonModelServer:
                  tracer: Optional[Tracer] = None,
                  generator=None,
                  generate_path: str = "/v1/generate",
-                 pool=None) -> None:
+                 pool=None,
+                 prefill=None) -> None:
         if model is not None and pool is not None:
             raise ValueError("pass model= (server-owned engine) or pool= "
                              "(caller-owned EnginePool), not both")
@@ -185,6 +186,11 @@ class JsonModelServer:
         # like managers= — the server routes to it and drains it on stop)
         self._generator = generator
         self.generate_path = generate_path
+        # PrefillEngine for POST /v1/disagg/prefill — makes this host a
+        # prefill-tier replica in a disaggregated pipeline (caller-owned
+        # lifecycle). A host with a generator= whose engine supports
+        # submit_prefilled() additionally serves /v1/disagg/resume.
+        self._prefill = prefill
         self.default_deadline = float(default_deadline)
         self._clock = clock
         self._draining = False
@@ -488,10 +494,146 @@ class JsonModelServer:
                     handle.cancel()
                     raise
 
+            def _handle_disagg_prefill(self):
+                """Prefill-tier hop: run the bucketed prefill + first-token
+                sample and answer with the serialized handoff bytes."""
+                from ..serving.disagg import serialize_handoff
+
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length))
+                    prompt = [int(t) for t in payload["prompt"]]
+                    deadline = self._deadline(payload)
+                    spec_k = payload.get("speculative_k")
+                    kw = dict(
+                        max_tokens=payload.get("max_tokens"),
+                        greedy=bool(payload.get("greedy", True)),
+                        temperature=float(payload.get("temperature", 1.0)),
+                        top_k=int(payload.get("top_k", 0)),
+                        top_p=float(payload.get("top_p", 1.0)),
+                        seed=int(payload.get("seed", 0)),
+                        eos_id=payload.get("eos_id"),
+                        speculative_k=(None if spec_k is None
+                                       else int(spec_k)),
+                    )
+                except Exception as e:
+                    self._send(400, {"error": f"malformed request: {e}"})
+                    return
+                try:
+                    if outer._draining:
+                        raise RuntimeError("draining")
+                    if deadline.expired():
+                        raise DeadlineExceededError("deadline exceeded")
+                    handoff = outer._prefill.prefill(prompt, **kw)
+                    body = serialize_handoff(handoff)
+                except ValueError as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                except AdmissionRejectedError as e:
+                    self._send_unavailable(f"overloaded: {e}", e.retry_after)
+                    return
+                except CircuitOpenError as e:
+                    self._send_unavailable(f"circuit open: {e}",
+                                           e.retry_after)
+                    return
+                except DeadlineExceededError:
+                    self._send(504, {"error": "deadline exceeded"})
+                    return
+                except RuntimeError as e:
+                    if "drain" in str(e) or "shut down" in str(e):
+                        self._send_unavailable("draining", 1.0)
+                    else:
+                        self._send(500, {"error": f"internal error: {e}"})
+                    return
+                except Exception as e:
+                    self._send(500, {"error": f"internal error: {e}"})
+                    return
+                self._sent_code = 200
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("X-Request-Id", self._request_id)
+                try:
+                    self.send_header("X-Load-Score",
+                                     f"{outer.load_score():.3f}")
+                except Exception:
+                    pass
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _handle_disagg_resume(self):
+                """Decode-tier hop: deserialize a shipped prefill handoff,
+                admit it into the local engine and stream tokens back
+                (same NDJSON contract as /v1/generate)."""
+                from ..serving.disagg import deserialize_handoff
+
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    handoff = deserialize_handoff(self.rfile.read(length))
+                    deadline = self._deadline({})
+                except Exception as e:
+                    self._send(400, {"error": f"malformed handoff: {e}"})
+                    return
+                try:
+                    if outer._draining:
+                        raise RuntimeError("draining")
+                    handle = outer._generator.submit_prefilled(
+                        handoff, deadline=deadline,
+                        request_id=self._request_id,
+                        priority=self._priority())
+                except ValueError as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                except AdmissionRejectedError as e:
+                    self._send_unavailable(f"overloaded: {e}", e.retry_after)
+                    return
+                except CircuitOpenError as e:
+                    self._send_unavailable(f"circuit open: {e}",
+                                           e.retry_after)
+                    return
+                except RuntimeError as e:
+                    if "drain" in str(e) or "shut down" in str(e):
+                        self._send_unavailable("draining", 1.0)
+                    else:
+                        self._send(500, {"error": f"internal error: {e}"})
+                    return
+                except Exception as e:
+                    self._send(500, {"error": f"internal error: {e}"})
+                    return
+                self._sent_code = 200
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("X-Request-Id", self._request_id)
+                try:
+                    self.send_header("X-Load-Score",
+                                     f"{outer.load_score():.3f}")
+                except Exception:
+                    pass
+                self.end_headers()
+                try:
+                    for ev in handle.events(
+                            timeout=(deadline.remaining() or 30.0) + 30.0):
+                        self.wfile.write(json.dumps(ev).encode() + b"\n")
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionError, OSError):
+                    handle.cancel()
+                except Exception:
+                    handle.cancel()
+                    raise
+
             def _handle_post(self):
                 if (self.path.startswith(_MODELS_PREFIX + "/")
                         and self.path.rsplit("/", 1)[-1] in _ADMIN_ACTIONS):
                     self._handle_admin()
+                    return
+                if (self.path == "/v1/disagg/prefill"
+                        and outer._prefill is not None):
+                    self._handle_disagg_prefill()
+                    return
+                if (self.path == "/v1/disagg/resume"
+                        and outer._generator is not None
+                        and hasattr(outer._generator, "submit_prefilled")):
+                    self._handle_disagg_resume()
                     return
                 if self.path == outer.generate_path and (
                         outer._generator is not None
@@ -575,7 +717,7 @@ class JsonModelServer:
         # a registered manager's engine — dedupe by identity so it is
         # counted once (double-counting inflates X-Load-Score and skews
         # the front pool's dispatch away from this host)
-        targets = [self._pi, self._pool, self._generator]
+        targets = [self._pi, self._pool, self._generator, self._prefill]
         targets.extend(m.engine for m in self._managers.values())
         score, seen = 0.0, set()
         for e in targets:
@@ -637,17 +779,46 @@ class JsonModelServer:
         if self._pool is not None:
             circuits.append(self._pool.circuit_state)
             queue_depth += self._pool._admission.pending
+            pool_reps = self._pool.replicas + self._pool.decode_replicas
+            # per-replica serving roles + per-role circuit aggregate
+            # (closed while ANY replica of that role can take traffic) —
+            # a disaggregated front host reads this to see which TIER is
+            # down, not just which endpoint
+            roles = {e.name: getattr(e, "role", "unified")
+                     for e in pool_reps}
+            by_role: dict = {}
+            for e in pool_reps:
+                by_role.setdefault(roles[e.name], []).append(
+                    e.circuit_state)
+            rank = {CircuitState.CLOSED: 0, CircuitState.HALF_OPEN: 1,
+                    CircuitState.OPEN: 2}
             payload["pool"] = {
                 "replicas": {e.name: e.circuit_state.value
-                             for e in (self._pool.replicas
-                                       + self._pool.decode_replicas)},
+                             for e in pool_reps},
+                "roles": roles,
+                "role_circuits": {
+                    r: min(states, key=rank.__getitem__).value
+                    for r, states in by_role.items()},
                 "circuit": self._pool.circuit_state.value,
             }
         if self._generator is not None:
             gen_circuit = self._generator.circuit_state
             circuits.append(gen_circuit)
             queue_depth += self._generator.stats()["queue_depth"]
-            payload["generate"] = {"circuit": gen_circuit.value}
+            payload["generate"] = {
+                "circuit": gen_circuit.value,
+                "role": getattr(self._generator, "role", "decode"
+                                if self._prefill is None else "unified"),
+            }
+            gen_roles = self._generator.stats().get("roles")
+            if gen_roles:  # a DisaggCoordinator itemizes its targets
+                payload["generate"]["roles"] = gen_roles
+        if self._prefill is not None:
+            pre_circuit = self._prefill.circuit_state
+            circuits.append(pre_circuit)
+            queue_depth += self._prefill.stats()["queue_depth"]
+            payload["prefill"] = {"circuit": pre_circuit.value,
+                                  "role": "prefill"}
         if self._draining:
             status = "draining"
         elif any(c is not CircuitState.CLOSED for c in circuits):
@@ -675,6 +846,8 @@ class JsonModelServer:
                            for n, m in sorted(self._managers.items())}
         if self._generator is not None:
             s["generate"] = self._generator.stats()
+        if self._prefill is not None:
+            s["prefill"] = self._prefill.stats()
         s["draining"] = self._draining
         s["replica"] = self.identity()
         return s

@@ -19,6 +19,13 @@ pinning); ``tools/check_registry_contract.py`` enforces the
 publish → resolve → swap → rollback contract every test run.
 """
 
+from .disagg import (
+    DisaggCoordinator,
+    PartialHandoffError,
+    PrefillEngine,
+    deserialize_handoff,
+    serialize_handoff,
+)
 from .manager import LOAD_SITE, WARMUP_SITE, ModelManager, SwapError
 from .router import ModelRouter
 from .store import (
@@ -35,11 +42,16 @@ __all__ = [
     "LOAD_SITE",
     "WARMUP_SITE",
     "ChecksumMismatchError",
+    "DisaggCoordinator",
     "ModelManager",
     "ModelRouter",
     "ModelStore",
     "ModelStoreError",
     "ModelVersion",
+    "PartialHandoffError",
+    "PrefillEngine",
     "SwapError",
     "VersionNotFoundError",
+    "deserialize_handoff",
+    "serialize_handoff",
 ]

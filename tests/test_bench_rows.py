@@ -38,6 +38,11 @@ def test_select_rows_filters_exactly():
     # ISSUE 16: the elastic-goodput row gates the >0.90 churn ratio
     sel = bench.select_rows("elastic_goodput")
     assert sel == {"elastic_goodput": "elastic_goodput"}
+    # ISSUE 17: the paged-KV and disagg rows run standalone in CI
+    sel = bench.select_rows("paged_kv_occupancy,disagg_handoff")
+    assert list(sel) == ["paged_kv_occupancy", "disagg_handoff"]
+    assert sel["paged_kv_occupancy"] == "paged_kv_occupancy"
+    assert sel["disagg_handoff"] == "disagg_handoff"
     # every selectable row maps to a registered measurement
     for row, meas in {**bench._EXTRA_ROWS, **bench._CHIP_ONLY_ROWS}.items():
         assert meas in bench._MEASUREMENTS, (row, meas)
@@ -75,6 +80,8 @@ def test_cli_list_rows_and_unknown_row_exit():
     assert "large_batch_scaling" in listing["rows"]
     assert "checkpoint_stall" in listing["rows"]
     assert "elastic_goodput" in listing["rows"]
+    assert "paged_kv_occupancy" in listing["rows"]
+    assert "disagg_handoff" in listing["rows"]
     # an unknown row fails fast (exit 2, error names the row) BEFORE any
     # probe/measurement work
     bad = subprocess.run([sys.executable, _BENCH, "--rows", "nope"],

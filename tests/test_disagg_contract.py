@@ -1,0 +1,21 @@
+"""Tier-1 wiring for tools/check_disagg_contract.py: the disaggregated
+prefill/decode pipeline chaos contract (README.md "Disaggregated
+serving") — a 2-host prefill→decode pipeline over real HTTP, prefill
+host killed mid-burst, zero high-priority loss via queued decodes +
+unified fallback, breaker-open within one window, role itemization and
+disagg metric series — is enforced on every test run, not just when
+someone remembers to run the tool."""
+
+import os
+import sys
+
+_TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+
+
+def test_disagg_contract_smoke():
+    sys.path.insert(0, _TOOLS)
+    try:
+        import check_disagg_contract
+    finally:
+        sys.path.remove(_TOOLS)
+    assert check_disagg_contract.main(log=lambda m: None) == 0

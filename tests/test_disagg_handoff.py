@@ -1,0 +1,182 @@
+"""Decode-state handoff tests (ISSUE 17 satellite): the serialized
+per-request cache slice round-trips through bytes exactly, and a decode
+stream restored from a shipped handoff is token-identical to unbroken
+local generation — fp and int8 (scale planes on the wire), plain and
+speculative (including the speculative-rewind path over paged blocks).
+
+PrefillEngines and the fp decode engines are module-scoped: every
+engine pays real jit compiles, and the handoff path exercises the same
+compiled programs whichever test runs it."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.model.zoo import TransformerLM
+from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+from deeplearning4j_tpu.parallel.decode import DecodeEngine
+from deeplearning4j_tpu.serving.disagg import (PrefillEngine,
+                                               deserialize_handoff,
+                                               serialize_handoff)
+
+MAX_LEN = 24
+PROMPTS = [[1, 2, 3], [4, 5, 6, 7, 8]]
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return TransformerLM(vocab_size=23, hidden=32, n_layers=2,
+                         n_heads=4, max_len=MAX_LEN).init()
+
+
+@pytest.fixture(scope="module")
+def draft():
+    return TransformerLM(vocab_size=23, hidden=16, n_layers=1,
+                         n_heads=2, max_len=MAX_LEN).init()
+
+
+def _engine(lm, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    return DecodeEngine(lm, max_len=MAX_LEN, **kw)
+
+
+@pytest.fixture(scope="module")
+def pe(lm):
+    return PrefillEngine(lm, max_len=MAX_LEN, registry=MetricsRegistry())
+
+
+@pytest.fixture(scope="module")
+def pe8(lm):
+    return PrefillEngine(lm, max_len=MAX_LEN, cache_dtype="int8",
+                         registry=MetricsRegistry())
+
+
+@pytest.fixture(scope="module")
+def paged_eng(lm):
+    eng = _engine(lm, slots=4, block_size=4)
+    yield eng
+    eng.shutdown()
+
+
+@pytest.fixture(scope="module")
+def static_eng(lm):
+    eng = _engine(lm, slots=4)
+    yield eng
+    eng.shutdown()
+
+
+def _run_local(eng, prompts, **kw):
+    hs = [eng.submit(p, max_tokens=6, **kw) for p in prompts]
+    return [h.result(timeout=120) for h in hs]
+
+
+def _run_handoff(pe, eng, prompts, **kw):
+    out = []
+    for p in prompts:
+        wire = serialize_handoff(pe.prefill(p, max_tokens=6, **kw))
+        assert isinstance(wire, bytes)
+        h = eng.submit_prefilled(deserialize_handoff(wire))
+        out.append(h.result(timeout=120))
+    return out
+
+
+class TestWireFormat:
+    def test_round_trip_exact(self, pe):
+        ho = pe.prefill([3, 1, 4, 1, 5], max_tokens=6, seed=9,
+                        greedy=False, temperature=0.8, top_k=4)
+        back = deserialize_handoff(serialize_handoff(ho))
+        assert back["prompt"] == ho["prompt"]
+        assert back["first_token"] == ho["first_token"]
+        assert back["pos"] == 5
+        assert back["cache_dtype"] == ho["cache_dtype"]
+        assert back["sampling"]["seed"] == 9
+        assert back["sampling"]["greedy"] is False
+        assert set(back["layers"]) == set(ho["layers"])
+        for name, planes in ho["layers"].items():
+            for key, arr in planes.items():
+                got = back["layers"][name][key]
+                assert got.dtype == np.asarray(arr).dtype
+                # trimmed to used positions only
+                assert got.shape[2] == 5
+                np.testing.assert_array_equal(got, np.asarray(arr))
+
+    def test_round_trip_int8_scale_planes(self, pe8):
+        ho = pe8.prefill([1, 2, 3, 4], max_tokens=4)
+        back = deserialize_handoff(serialize_handoff(ho))
+        planes = next(iter(back["layers"].values()))
+        assert planes["cache_k"].dtype == np.int8
+        assert "cache_k_scale" in planes and "cache_v_scale" in planes
+        assert planes["cache_k_scale"].dtype == np.float32
+        np.testing.assert_array_equal(
+            planes["cache_k"],
+            np.asarray(next(iter(ho["layers"].values()))["cache_k"]))
+
+    def test_truncated_payload_rejected(self, pe):
+        wire = serialize_handoff(pe.prefill([1, 2], max_tokens=2))
+        with pytest.raises(Exception):
+            deserialize_handoff(wire[:-10])
+
+    def test_version_gate(self):
+        import json
+
+        bad = json.dumps({"version": 99, "tensors": []}).encode() + b"\n"
+        with pytest.raises(ValueError, match="version"):
+            deserialize_handoff(bad)
+
+
+class TestHandoffIdentity:
+    def test_fp_paged(self, pe, paged_eng):
+        exp = _run_local(paged_eng, PROMPTS, seed=7)
+        assert _run_handoff(pe, paged_eng, PROMPTS, seed=7) == exp
+
+    def test_fp_static(self, pe, static_eng):
+        """Handoffs also restore into a STATIC-layout decode engine."""
+        exp = _run_local(static_eng, PROMPTS)
+        assert _run_handoff(pe, static_eng, PROMPTS) == exp
+
+    def test_int8_paged(self, lm, pe8):
+        eng = _engine(lm, slots=4, cache_dtype="int8", block_size=4)
+        try:
+            exp = _run_local(eng, PROMPTS)
+            assert _run_handoff(pe8, eng, PROMPTS) == exp
+        finally:
+            eng.shutdown()
+
+    def test_sampled_stream_identity(self, pe, paged_eng):
+        kw = dict(greedy=False, temperature=0.9, top_k=5, seed=21)
+        exp = _run_local(paged_eng, PROMPTS, **kw)
+        assert _run_handoff(pe, paged_eng, PROMPTS, **kw) == exp
+
+    def test_speculative_rewind_over_paged_blocks(self, lm, draft, pe):
+        """A speculative decode engine receiving the handoff re-runs the
+        draft prefill locally and its rewind path (rejected proposals)
+        stays token-identical over paged blocks."""
+        eng = _engine(lm, slots=4, draft_model=draft, speculative_k=3,
+                      block_size=4)
+        try:
+            exp = _run_local(eng, PROMPTS, speculative_k=3)
+            assert _run_handoff(pe, eng, PROMPTS,
+                                speculative_k=3) == exp
+        finally:
+            eng.shutdown()
+
+
+class TestHandoffValidation:
+    def test_cache_dtype_mismatch_rejected(self, pe8, paged_eng):
+        ho = pe8.prefill([1, 2, 3], max_tokens=4)
+        with pytest.raises(ValueError, match="cache_dtype"):
+            paged_eng.submit_prefilled(ho)  # fp engine
+
+    def test_pos_prompt_mismatch_rejected(self, pe, paged_eng):
+        ho = dict(pe.prefill([1, 2, 3], max_tokens=4), pos=2)
+        with pytest.raises(ValueError, match="pos"):
+            paged_eng.submit_prefilled(ho)
+
+    def test_missing_layer_fails_request(self, pe, paged_eng):
+        ho = pe.prefill([1, 2, 3], max_tokens=4)
+        name = next(iter(ho["layers"]))
+        broken = dict(ho, layers={k: v for k, v in ho["layers"].items()
+                                  if k != name})
+        term = list(paged_eng.submit_prefilled(broken)
+                    .events(timeout=60))[-1]
+        assert term["reason"] == "failed"
+        assert name in term.get("error", "")

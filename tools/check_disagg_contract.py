@@ -1,0 +1,271 @@
+#!/usr/bin/env python
+"""Disaggregated-serving chaos harness (README.md "Disaggregated
+serving", ISSUE 17).
+
+Boots a TWO-HOST prefill→decode pipeline over real HTTP — host P is a
+prefill-tier replica (JsonModelServer with ``prefill=PrefillEngine``),
+host D a decode-tier replica (``generator=`` a paged DecodeEngine
+serving ``/v1/disagg/resume``) — fronted by a DisaggCoordinator served
+on a third HTTP edge, and proves the failure story end to end:
+
+  1. requests through the front's /v1/generate run the two-hop pipeline
+     (prefill on P, handoff bytes over the wire, decode stream from D)
+     and the streams are token-identical to a local engine;
+  2. under sustained mixed-priority load, host P is KILLED mid-burst.
+     Assert: ZERO high-priority loss — queued decode streams on D run
+     to completion and new requests fall back to D's unified
+     /v1/generate (degraded first-token latency, identical tokens) —
+     and P's circuit opens within one breaker window;
+  3. the decode host's /health itemizes serving roles
+     (prefill|decode|unified) and the disagg metric series
+     (handoffs/handoff bytes/prefill latency/fallbacks) are visible on
+     the front /metrics.
+
+Low-priority requests MAY shed (503); high-priority streams must all
+complete. Honors ``DL4J_CHAOS_SEED`` for the load mix. Runs standalone
+(``python tools/check_disagg_contract.py``) and as a tier-1 pytest via
+tests/test_disagg_contract.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from urllib import request as urllib_request
+from urllib.error import HTTPError
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from contract_common import start_http_server  # noqa: E402
+
+PROBE_INTERVAL = 0.1
+BREAKER_MIN_CALLS = 2
+BREAKER_OPEN_TIMEOUT = 0.6
+BREAKER_WINDOW_S = BREAKER_MIN_CALLS * PROBE_INTERVAL + 4.0  # + sched slack
+
+MAX_LEN = 24
+VOCAB = 23
+
+
+def _get(port, path, timeout=15):
+    with urllib_request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        body = r.read()
+        ctype = r.headers.get("Content-Type", "")
+        return r.status, (json.loads(body) if "json" in ctype
+                          else body.decode())
+
+
+def _wait_for(cond, timeout, what):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _stream(port, prompt, priority="high", max_tokens=5, seed=0,
+            timeout=60):
+    """POST /v1/generate and consume the NDJSON stream; returns
+    (tokens, terminal_event)."""
+    body = json.dumps({"prompt": prompt, "max_tokens": max_tokens,
+                       "seed": seed, "stream": True}).encode()
+    req = urllib_request.Request(
+        f"http://127.0.0.1:{port}/v1/generate", data=body,
+        headers={"Content-Type": "application/json",
+                 "X-Priority": priority})
+    toks, term = [], None
+    with urllib_request.urlopen(req, timeout=timeout) as r:
+        for line in r:
+            line = line.strip()
+            if not line:
+                continue
+            ev = json.loads(line)
+            if "token" in ev:
+                toks.append(ev["token"])
+            if ev.get("done"):
+                term = ev
+                break
+    return toks, term
+
+
+def main(log=print) -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from deeplearning4j_tpu.core.resilience import CircuitBreaker, \
+        CircuitState
+    from deeplearning4j_tpu.model.zoo import TransformerLM
+    from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+    from deeplearning4j_tpu.parallel.decode import DecodeEngine
+    from deeplearning4j_tpu.remote import JsonModelServer
+    from deeplearning4j_tpu.serving.disagg import (DisaggCoordinator,
+                                                   PrefillEngine)
+
+    seed = int(os.environ.get("DL4J_CHAOS_SEED", "0"))
+    lm = TransformerLM(vocab_size=VOCAB, hidden=32, n_layers=2,
+                       n_heads=4, max_len=MAX_LEN).init()
+
+    reg = MetricsRegistry()
+    pre = PrefillEngine(lm, max_len=MAX_LEN, registry=reg, name="pre-P")
+    host_p = start_http_server(
+        lambda: JsonModelServer(prefill=pre, port=0,
+                                registry=MetricsRegistry(),
+                                name="host-P").start())
+    dec = DecodeEngine(lm, max_len=MAX_LEN, slots=4, block_size=4,
+                       registry=MetricsRegistry(), name="dec-D",
+                       queue_limit=16)
+    host_d = start_http_server(
+        lambda: JsonModelServer(generator=dec, port=0,
+                                registry=MetricsRegistry(),
+                                name="host-D").start())
+    coord = DisaggCoordinator(
+        [f"http://127.0.0.1:{host_p.port}"],
+        [f"http://127.0.0.1:{host_d.port}"],
+        registry=reg, name="coord", timeout=60.0,
+        breaker_factory=lambda: CircuitBreaker(
+            min_calls=BREAKER_MIN_CALLS, window=4,
+            open_timeout=BREAKER_OPEN_TIMEOUT))
+    front = start_http_server(
+        lambda: JsonModelServer(generator=coord, port=0, registry=reg,
+                                name="disagg-front").start())
+    fport = front.port
+
+    def prompt_of(r):
+        n = int(r.randint(2, 8))
+        return [int(t) for t in r.randint(1, VOCAB, size=n)]
+
+    stop_load = threading.Event()
+    results = {"high": [], "low": []}
+    res_lock = threading.Lock()
+
+    def load_worker(priority, wseed):
+        local = np.random.RandomState(wseed)
+        while not stop_load.is_set():
+            try:
+                toks, term = _stream(fport, prompt_of(local),
+                                     priority=priority, max_tokens=4,
+                                     seed=int(local.randint(1 << 16)))
+                outcome = (term or {}).get("reason", "no-terminal")
+            except HTTPError as e:
+                outcome = e.code
+            except Exception as e:  # noqa: BLE001 — connection-level loss
+                outcome = f"{type(e).__name__}: {e}"
+            with res_lock:
+                results[priority].append(outcome)
+            time.sleep(0.01)
+
+    try:
+        # ---- 1. two-hop pipeline, token-identical to a local engine --
+        local = DecodeEngine(lm, max_len=MAX_LEN, slots=4,
+                             registry=MetricsRegistry(), name="oracle")
+        probe_prompt = [1, 2, 3]
+        exp = local.submit(probe_prompt, max_tokens=5, seed=3).result(
+            timeout=120)
+        local.shutdown()
+        toks, term = _stream(fport, probe_prompt, max_tokens=5, seed=3,
+                             timeout=120)
+        assert toks == exp, f"pipeline tokens {toks} != local {exp}"
+        assert term["reason"] == "completed"
+        # the stream's terminal line can beat the coordinator's own
+        # bookkeeping thread by a beat — settle before asserting
+        _wait_for(lambda: coord.stats()["handoffs"]["completed"] >= 1,
+                  10, "coordinator to record the completed handoff")
+        st = coord.stats()
+        assert st["handoffs"]["fallback"] == 0, st
+        log(f"PASS two-hop pipeline token-identical to local ({toks})")
+
+        # decode host itemizes its serving role
+        dh = _get(host_d.port, "/health")[1]
+        assert dh["generate"]["role"] == "decode", dh["generate"]
+        ph = _get(host_p.port, "/health")[1]
+        assert ph["prefill"]["role"] == "prefill", ph
+        log("PASS /health itemizes prefill/decode roles per host")
+
+        # ---- 2. kill the prefill host mid-burst ----------------------
+        threads = [threading.Thread(target=load_worker,
+                                    args=(p, seed * 97 + i), daemon=True)
+                   for i, p in enumerate(("high", "high", "low"))]
+        for t in threads:
+            t.start()
+        _wait_for(lambda: len(results["high"]) >= 6, 60, "load warmup")
+
+        killed_at = time.monotonic()
+        host_p._httpd.shutdown()   # listener gone: connections refused
+        host_p._httpd.server_close()
+
+        ptarget = coord.prefill_targets[0]
+        _wait_for(lambda: ptarget.breaker.state is CircuitState.OPEN,
+                  BREAKER_WINDOW_S,
+                  "dead prefill host's breaker to open")
+        opened_in = time.monotonic() - killed_at
+
+        with res_lock:
+            mark = len(results["high"])
+        _wait_for(lambda: len(results["high"]) >= mark + 6, 60,
+                  "post-kill high-priority streams")
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=60)
+
+        with res_lock:
+            high, low = list(results["high"]), list(results["low"])
+        bad_high = [o for o in high if o != "completed"]
+        assert not bad_high, \
+            f"high-priority loss during prefill-host kill: " \
+            f"{bad_high[:5]} ({len(bad_high)}/{len(high)})"
+        low_lost = [o for o in low if o not in ("completed", 503)]
+        assert not low_lost, \
+            f"low-priority may shed (503) but not vanish: {low_lost[:5]}"
+        st = coord.stats()
+        assert st["handoffs"]["fallback"] >= 1, \
+            f"kill must be witnessed as unified fallback: {st['handoffs']}"
+        assert st["handoffs"]["failed"] == 0, st["handoffs"]
+        log(f"PASS prefill-host kill: breaker open in {opened_in:.2f}s, "
+            f"{len(high)} high-priority streams all completed "
+            f"({st['handoffs']['fallback']} via unified fallback), "
+            f"decode queue drained clean")
+
+        # ---- 3. roles + disagg series on the front -------------------
+        fh = _get(fport, "/health")[1]
+        roles = fh["generate"]["roles"]
+        assert any(k.startswith("prefill:") for k in roles), roles
+        assert any(k.startswith("decode:") for k in roles), roles
+        pstate = next(v for k, v in roles.items()
+                      if k.startswith("prefill:"))
+        assert pstate == "open", f"dead prefill target not open: {roles}"
+        code, text = _get(fport, "/metrics")
+        assert code == 200
+        for series in ("dl4j_tpu_disagg_handoffs_total",
+                       "dl4j_tpu_disagg_handoff_bytes",
+                       "dl4j_tpu_disagg_prefill_latency_seconds",
+                       "dl4j_tpu_disagg_fallback_total",
+                       "dl4j_tpu_disagg_prefills_total"):
+            assert series in text, f"/metrics missing {series}"
+        log("PASS front /health itemizes tier roles, disagg series on "
+            "/metrics")
+    finally:
+        stop_load.set()
+        for closer in (lambda: front.stop(drain=False),
+                       lambda: coord.shutdown(drain=False),
+                       lambda: host_d.stop(drain=False),
+                       lambda: dec.shutdown(drain=False),
+                       lambda: host_p.stop(drain=False)):
+            try:
+                closer()
+            except Exception:
+                pass
+    log("disagg contract OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
