@@ -993,13 +993,20 @@ def measure_flash_attention_8k(b: int = 1, h: int = 8, t: int = 8192,
 def measure_moe_dispatch(tokens: int = 8192, d: int = 768, experts: int = 8,
                          top_k: int = 2, hidden: int = 1536,
                          iters: int = 10) -> dict:
-    """MoE dispatch overhead (VERDICT r4 ask 10; ISSUE 3): one
+    """MoE dispatch overhead (VERDICT r4 ask 10; ISSUE 3 + 18): one
     MixtureOfExperts train step (fwd+bwd) vs a dense 2-layer FFN doing the
     SAME per-token matmul FLOPs (dense hidden = top_k * expert hidden).
-    Measures BOTH dispatch modes — "sort" (gather/scatter, the default)
-    and "einsum" (legacy dense one-hot) — so the
-    ``dispatch_overhead_ratio`` trajectory records the sort-dispatch win;
-    the headline ratio follows the default mode."""
+    Measures ALL THREE dispatch modes — "sort" (gather/scatter, the
+    default), "einsum" (legacy dense one-hot) and "grouped" (sorted
+    grouped expert matmul, ops.grouped_matmul) — so the
+    ``dispatch_overhead_ratio`` trajectory records the dispatch wins; the
+    headline ratio follows the default mode. Gates:
+    ``grouped_no_regression_vs_sort`` (grouped must stay within the
+    headroom of sort — holds on any platform, this is the CI smoke) and
+    the ≤ 1.5 ``grouped_dispatch_overhead_ratio`` target, which is
+    CHIP-ONLY (on a CPU host the XLA-reference grouped spelling pays
+    gather/scatter without an MXU to amortize it; recorded, not
+    asserted)."""
     import jax
     import jax.numpy as jnp
 
@@ -1009,7 +1016,7 @@ def measure_moe_dispatch(tokens: int = 8192, d: int = 768, experts: int = 8,
     params = None
     mode_ms = {}
     mode_sp = {}
-    for mode in ("sort", "einsum"):
+    for mode in ("sort", "einsum", "grouped"):
         lay = MixtureOfExpertsLayer(
             n_in=d, n_out=d, num_experts=experts, hidden=hidden, top_k=top_k,
             capacity_factor=1.25, dispatch_mode=mode)
@@ -1040,6 +1047,13 @@ def measure_moe_dispatch(tokens: int = 8192, d: int = 768, experts: int = 8,
 
     moe_ms = mode_ms["sort"]  # the default dispatch_mode is the headline
     dense_ms, dense_sp = _timed_calls_ms(dense_g, ((w1, w2), x), iters)
+    grouped_ratio = mode_ms["grouped"] / dense_ms
+    # sort already does the heavy lifting (static [E, C] buffers); grouped
+    # swaps the buffer matmuls for frontier-skipping grouped kernels. On
+    # CPU both lower to the same XLA gather/einsum shapes, so "no
+    # regression" with modest headroom is the honest portable gate; the
+    # grouped WIN (skipped tiles) only materializes on the chip.
+    no_reg_headroom = 1.3
     return {
         "tokens": tokens, "d_model": d, "experts": experts, "top_k": top_k,
         "expert_hidden": hidden,
@@ -1048,15 +1062,27 @@ def measure_moe_dispatch(tokens: int = 8192, d: int = 768, experts: int = 8,
         "moe_sort_grad_step_ms": round(mode_ms["sort"], 2),
         "moe_einsum_grad_step_ms": round(mode_ms["einsum"], 2),
         "moe_einsum_spread_ms": mode_sp["einsum"],
+        "moe_grouped_grad_step_ms": round(mode_ms["grouped"], 2),
+        "moe_grouped_spread_ms": mode_sp["grouped"],
         "dense_equal_flops_grad_step_ms": round(dense_ms, 2),
         "dense_spread_ms": dense_sp,
         "dispatch_overhead_ratio": round(moe_ms / dense_ms, 2),
         "einsum_dispatch_overhead_ratio": round(
             mode_ms["einsum"] / dense_ms, 2),
+        "grouped_dispatch_overhead_ratio": round(grouped_ratio, 2),
         "sort_vs_einsum_speedup": round(mode_ms["einsum"] / moe_ms, 2),
+        "grouped_vs_sort_speedup": round(moe_ms / mode_ms["grouped"], 2),
+        "grouped_no_regression_vs_sort": {
+            "max_ratio": no_reg_headroom,
+            "ratio": round(mode_ms["grouped"] / moe_ms, 2),
+            "ok": bool(mode_ms["grouped"] <= no_reg_headroom * moe_ms)},
+        "grouped_overhead_chip_target": {
+            "max": 1.5, "measured": round(grouped_ratio, 2),
+            "chip_only": True},
         "note": "dense hidden = top_k*expert_hidden so per-token matmul "
                 "FLOPs match; ratio > 1 is routing + dispatch/combine cost; "
-                "headline ratio uses dispatch_mode='sort' (the default)",
+                "headline ratio uses dispatch_mode='sort' (the default); "
+                "grouped_overhead_chip_target is asserted on TPU only",
     }
 
 
@@ -2885,6 +2911,11 @@ _EXTRA_ROWS = {
     "elastic_goodput": "elastic_goodput",
     "paged_kv_occupancy": "paged_kv_occupancy",
     "disagg_handoff": "disagg_handoff",
+    # CPU-runnable since the grouped dispatch mode: the
+    # grouped_no_regression_vs_sort gate holds on any platform (small
+    # shapes via the cpu kwargs); the ≤1.5 overhead ratio stays a
+    # chip-only target recorded inside the row
+    "moe_dispatch": "moe_dispatch",
 }
 # rows that only produce meaningful numbers on the chip (skipped with a
 # note under --rows on a cpu-fallback host)
@@ -2892,7 +2923,6 @@ _CHIP_ONLY_ROWS = {
     "resnet50_b128": "resnet50_b128",
     "bert_b64": "bert_b64",
     "flash_attention_8k": "flash_attention_8k",
-    "moe_dispatch": "moe_dispatch",
 }
 
 

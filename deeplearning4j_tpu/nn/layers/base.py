@@ -52,11 +52,20 @@ class DistContext:
     where the batch array is GLOBAL and group statistics are spelled as
     a sharding-friendly reshape instead. ``n_shards`` is the data-axis
     width either way, and ``bn_group_size`` the trainer-level default
-    statistics group size (overridable per layer)."""
+    statistics group size (overridable per layer).
+
+    ``ep_axis``/``ep_shards`` name the expert-parallel mesh axis on the
+    explicit path (``DistributedTrainer`` with
+    ``moe_expert_parallel_rules`` and an explicit strategy): expert-dim
+    params arrive sliced over that axis and MoE layers combine local
+    expert outputs with collectives bound to it. ``None``/1 everywhere
+    else (the implicit path shards experts through GSPMD instead)."""
 
     axis: Optional[str] = None
     n_shards: int = 1
     bn_group_size: Optional[int] = None
+    ep_axis: Optional[str] = None
+    ep_shards: int = 1
 
 
 @dataclasses.dataclass(frozen=True)

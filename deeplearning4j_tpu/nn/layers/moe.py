@@ -7,23 +7,40 @@ shards over a mesh axis via ``DistributedTrainer(param_sharding_rules=
 moe_expert_parallel_rules())`` — XLA then partitions the expert MLP and
 inserts the all-to-alls.
 
-Two dispatch formulations, selected by ``dispatch_mode``:
+Three dispatch formulations, selected by ``dispatch_mode``:
 
 * ``"sort"`` (default) — sort-based gather/scatter dispatch
   (ops/moe_dispatch.py): one ``lax.top_k`` route, capacity slots from a
   per-expert cumsum over the flat assignment list, one gather into the
   ``[E, C, d]`` expert buffer, gate-weighted gather back. Static shapes,
   no one-hot contractions; the routing cost is O(tokens·E) index math
-  instead of the einsum path's O(tokens·E·capacity·d).
+  instead of the einsum path's O(tokens·E·capacity·d). The expert MLP
+  still pays dense ``[E, C]`` MXU time over *capacity* slots.
+* ``"grouped"`` — the fast path: the same ``DispatchPlan``, but the sort
+  permutation (argsort of ``buffer_idx`` — already the by-expert order)
+  feeds both expert MLP matmuls through ``ops.grouped_matmul``, grouped
+  over the *actual* per-expert counts (``expert_tokens``), so padded
+  capacity slots stop costing FLOPs (the Pallas kernel skips m-tiles past
+  each group's frontier). The combine unsorts through the inverse
+  permutation into the same gate arithmetic (``ops.combine_rows``).
 * ``"einsum"`` — the classic dense Mesh-TF/GShard formulation (one-hot
   ``[tokens, E, capacity]`` dispatch/combine contractions). Kept for
   equivalence testing and as the reference semantics.
 
-Both modes implement the exact GShard capacity contract: slots are granted
+All modes implement the exact GShard capacity contract: slots are granted
 first-come-first-served in (round, token) order and tokens over an
 expert's capacity are dropped (their combine weight is 0 — the residual
 path carries them), so outputs and gradients agree between modes up to
 float reduction order.
+
+Explicit expert parallelism: inside the ``DistributedTrainer`` explicit
+shard_map path (``ctx.dist.ep_axis`` set and expert params sliced over
+the mesh's model axis), each shard routes the full token set with the
+replicated router, computes its local experts only — ``"sort"`` over the
+local ``[E/n, C]`` buffer slice, ``"grouped"`` over the locally-sorted
+rows — and combines with ``psum_scatter`` over the expert axis. Tensors
+entering the local branch carry a psum-in-backward wrapper so replicated
+params (router, upstream layers) receive the full cross-shard gradient.
 
 Observability: every ``apply`` refreshes ``state["expert_tokens"]`` ([E]
 kept assignments per expert) and ``state["dropped_tokens"]`` (overflow
@@ -35,6 +52,7 @@ drops), which ``obs.record_moe_metrics``/``MoEMetricsListener`` feed into
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Optional, Tuple
 
@@ -42,7 +60,9 @@ import jax
 import jax.numpy as jnp
 
 from ...core.config import register_config
+from ...ops.grouped_matmul import grouped_matmul
 from ...ops.moe_dispatch import (
+    combine_rows,
     gather_dispatch,
     make_dispatch_plan,
     scatter_combine,
@@ -53,7 +73,62 @@ from ..input_type import FeedForwardType, InputType, RecurrentType
 from ..weights import WeightInit, init_weights
 from .base import Layer, LayerContext, Params, State, apply_input_dropout
 
-_DISPATCH_MODES = ("sort", "einsum")
+_DISPATCH_MODES = ("sort", "einsum", "grouped")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _psum_in_bwd(x, axis):
+    """Identity forward; psums the cotangent over ``axis`` in backward.
+
+    Under explicit expert parallelism a replicated tensor (tokens, gates)
+    enters a per-shard local-expert branch; each shard backprops only its
+    own experts' contribution, so the gradient flowing back to replicated
+    producers (router, upstream layers) must be summed across expert
+    shards to stay replicated-consistent."""
+    return x
+
+
+def _psum_in_bwd_fwd(x, axis):
+    return x, None
+
+
+def _psum_in_bwd_bwd(axis, _, g):
+    return (jax.lax.psum(g, axis),)
+
+
+_psum_in_bwd.defvjp(_psum_in_bwd_fwd, _psum_in_bwd_bwd)
+
+
+def _ep_sum(y_local, axis, n_shards):
+    if y_local.shape[0] % n_shards == 0:
+        # reduce-scatter over tokens, gather back: the psum spelled so a
+        # token-sharded consumer could elide the all_gather
+        return jax.lax.all_gather(
+            jax.lax.psum_scatter(y_local, axis, scatter_dimension=0,
+                                 tiled=True),
+            axis, axis=0, tiled=True)
+    return jax.lax.psum(y_local, axis)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _ep_combine(y_local, axis, n_shards):
+    """Sum per-shard expert contributions over the expert axis, with an
+    IDENTITY backward. Each shard seeds its own (replicated-identical)
+    loss cotangent, so the correct per-loss cotangent of ``y_local`` is
+    ``g`` unchanged; psum's default transpose would re-psum it and scale
+    every expert-local gradient by the expert-axis size."""
+    return _ep_sum(y_local, axis, n_shards)
+
+
+def _ep_combine_fwd(y_local, axis, n_shards):
+    return _ep_sum(y_local, axis, n_shards), None
+
+
+def _ep_combine_bwd(axis, n_shards, _, g):
+    return (g,)
+
+
+_ep_combine.defvjp(_ep_combine_fwd, _ep_combine_bwd)
 
 
 @register_config
@@ -77,9 +152,11 @@ class MixtureOfExpertsLayer(Layer):
     # is PUSHED toward uniform expert load, not merely observed. 0 keeps
     # it diagnostic-only (read from state["aux_load_balance"]).
     balance_loss_weight: float = 0.0
-    # "sort" (gather/scatter, default) or "einsum" (dense one-hot
-    # contractions — the legacy GShard formulation, kept for equivalence
-    # testing). Identical capacity/drop semantics either way.
+    # "sort" (gather/scatter, default), "grouped" (sorted grouped expert
+    # matmul over actual per-expert counts — the Pallas fast path), or
+    # "einsum" (dense one-hot contractions — the legacy GShard
+    # formulation, kept for equivalence testing). Identical capacity/drop
+    # semantics in every mode.
     dispatch_mode: str = "sort"
 
     def __post_init__(self) -> None:
@@ -122,7 +199,8 @@ class MixtureOfExpertsLayer(Layer):
         # integers above 256 exactly.
         return {"aux_load_balance": jnp.zeros((), dtype),
                 "expert_tokens": jnp.zeros((self.num_experts,), jnp.float32),
-                "dropped_tokens": jnp.zeros((), jnp.float32)}
+                "dropped_tokens": jnp.zeros((), jnp.float32),
+                "capacity_slots": jnp.zeros((), jnp.float32)}
 
     def init(self, key: jax.Array, dtype: Any) -> Params:
         e, d, h, o = self.num_experts, self.n_in, self._hidden(), self.n_out
@@ -180,15 +258,129 @@ class MixtureOfExpertsLayer(Layer):
         combine = combine / jnp.maximum(denom, 1e-9)
         return dispatch, combine
 
+    def _expert_kernel(self, params: Params,
+                       name: str) -> Tuple[jax.Array, Optional[jax.Array]]:
+        """Expert weight-slab view hook: returns ``(weights, scale)``.
+
+        The full-precision layer stores weights directly (``scale`` is
+        None); ``QuantizedMixtureOfExpertsLayer`` overrides this to return
+        the int8/fp8 slab plus its per-expert per-output-channel scale,
+        which the matmul epilogues below fold in — so every dispatch mode
+        (einsum buffer, sort buffer, grouped rows) serves quantized
+        experts through the same code path."""
+        return params[name], None
+
     def _experts(self, params: Params, expert_in: jax.Array) -> jax.Array:
         """Batched expert MLPs over the [E, C, d] buffer — the leading E
         dim is what expert-parallel sharding rules partition."""
-        h = jnp.einsum("ecd,edh->ech", expert_in, params["We1"]) \
-            + params["be1"][:, None, :]
+        w1, s1 = self._expert_kernel(params, "We1")
+        h = jnp.einsum("ecd,edh->ech", expert_in, w1.astype(expert_in.dtype))
+        if s1 is not None:
+            h = h * s1[:, None, :].astype(h.dtype)
+        h = h + params["be1"][:, None, :]
         act = self.activation or Activation.RELU
         h = act(h)
-        return jnp.einsum("ech,eho->eco", h, params["We2"]) \
-            + params["be2"][:, None, :]
+        w2, s2 = self._expert_kernel(params, "We2")
+        out = jnp.einsum("ech,eho->eco", h, w2.astype(h.dtype))
+        if s2 is not None:
+            out = out * s2[:, None, :].astype(out.dtype)
+        return out + params["be2"][:, None, :]
+
+    def _experts_grouped(self, params: Params, rows: jax.Array,
+                         group_sizes: jax.Array, row_expert: jax.Array,
+                         capacity: int) -> jax.Array:
+        """Both expert MLP matmuls over rows pre-sorted by expert
+        (``ops.grouped_matmul`` — compute proportional to actual
+        per-expert counts, capacity only bounds the kernel tile).
+        ``row_expert`` [N] (clipped to the local expert range) gathers
+        per-row biases and quantization scales."""
+        w1, s1 = self._expert_kernel(params, "We1")
+        h = grouped_matmul(rows, group_sizes, w1.astype(rows.dtype),
+                           max_group_size=capacity)
+        if s1 is not None:
+            h = h * jnp.take(s1, row_expert, axis=0).astype(h.dtype)
+        h = h + jnp.take(params["be1"], row_expert, axis=0)
+        act = self.activation or Activation.RELU
+        h = act(h)
+        w2, s2 = self._expert_kernel(params, "We2")
+        out = grouped_matmul(h, group_sizes, w2.astype(h.dtype),
+                             max_group_size=capacity)
+        if s2 is not None:
+            out = out * jnp.take(s2, row_expert, axis=0).astype(out.dtype)
+        return out + jnp.take(params["be2"], row_expert, axis=0)
+
+    def _grouped_rows(self, params: Params, x2: jax.Array,
+                      buffer_idx: jax.Array, group_sizes: jax.Array,
+                      n_local: int, capacity: int) -> jax.Array:
+        """Sorted grouped expert compute returning per-assignment output
+        rows [k*n, o] in round-major flat order (ready for
+        ``ops.combine_rows``).
+
+        ``buffer_idx`` sorts kept assignments by (expert, slot) with
+        dropped/non-local assignments on a past-the-end sentinel, so its
+        argsort IS the by-expert order and rows past
+        ``sum(group_sizes)`` come back zero from the grouped matmul
+        (their bias-path values are discarded by the combine's zero gate,
+        exactly like the sort path's empty buffer slots)."""
+        kn = buffer_idx.shape[0]
+        n_tok = x2.shape[0]
+        k = kn // n_tok
+        order = jnp.argsort(buffer_idx)                     # by-expert order
+        flat_token = jnp.tile(jnp.arange(n_tok, dtype=jnp.int32), k)
+        rows_in = jnp.take(x2, flat_token[order], axis=0)   # [k*n, d]
+        sizes = group_sizes.astype(jnp.int32)
+        ends = jnp.cumsum(sizes)
+        row_expert = jnp.minimum(
+            jnp.searchsorted(ends, jnp.arange(kn, dtype=ends.dtype),
+                             side="right"),
+            n_local - 1).astype(jnp.int32)
+        out_rows = self._experts_grouped(params, rows_in, sizes, row_expert,
+                                         capacity)
+        # inverse permutation: back to round-major assignment order
+        inv = jnp.zeros((kn,), jnp.int32).at[order].set(
+            jnp.arange(kn, dtype=jnp.int32))
+        return jnp.take(out_rows, inv, axis=0)
+
+    def _ep_forward(self, params: Params, x2: jax.Array,
+                    gate_vals: jax.Array, plan, capacity: int,
+                    ep_axis: str) -> jax.Array:
+        """Explicit expert parallelism inside shard_map: this shard holds
+        ``E/n`` experts (params sliced over the model axis by the
+        trainer), routes the full replicated token set, computes only the
+        assignments its experts own, and combines with ``psum_scatter``
+        over the expert axis. Replicated inputs to the local branch are
+        wrapped so their gradients psum across shards (see
+        ``_psum_in_bwd``)."""
+        e = self.num_experts
+        e_loc = self._expert_kernel(params, "We1")[0].shape[0]
+        n_shards = e // e_loc
+        x2w = _psum_in_bwd(x2, ep_axis)
+        gate_w = _psum_in_bwd(gate_vals, ep_axis)
+        shard = jax.lax.axis_index(ep_axis)
+        first_slot = shard * (e_loc * capacity)
+        local_idx = plan.buffer_idx - first_slot
+        in_local = (local_idx >= 0) & (local_idx < e_loc * capacity)
+        local_idx = jnp.where(in_local, local_idx,
+                              e_loc * capacity).astype(jnp.int32)
+        if self.dispatch_mode == "grouped":
+            sizes_local = jax.lax.dynamic_slice_in_dim(
+                plan.expert_tokens, shard * e_loc, e_loc)
+            rows = self._grouped_rows(params, x2w, local_idx, sizes_local,
+                                      e_loc, capacity)
+            # non-local assignments carry real gates: their rows must be
+            # exactly zero so only the owning shard contributes
+            rows = rows * in_local[:, None].astype(rows.dtype)
+        else:  # "sort" over the local [E/n, C] buffer slice
+            slot_local = jax.lax.dynamic_slice_in_dim(
+                plan.slot_token, first_slot, e_loc * capacity)
+            expert_in = jnp.take(x2w, slot_local, axis=0, mode="fill",
+                                 fill_value=0).reshape(e_loc, capacity,
+                                                       x2.shape[-1])
+            out_e = self._experts(params, expert_in)
+            rows = jnp.take(out_e.reshape(e_loc * capacity, -1), local_idx,
+                            axis=0, mode="fill", fill_value=0)
+        y_local = combine_rows(rows, gate_w, plan.keep)
+        return _ep_combine(y_local, ep_axis, n_shards)
 
     def apply(self, params: Params, state: State, x: jax.Array,
               ctx: LayerContext) -> Tuple[jax.Array, State]:
@@ -211,13 +403,34 @@ class MixtureOfExpertsLayer(Layer):
 
         gates = jax.nn.softmax(x2 @ params["Wg"], axis=-1)       # [b, E]
 
-        if self.dispatch_mode == "sort":
+        e_loc = self._expert_kernel(params, "We1")[0].shape[0]
+        ep_axis = getattr(ctx.dist, "ep_axis", None) if ctx.dist else None
+        ep = ep_axis is not None and e_loc != e
+        if ep:
+            if self.dispatch_mode == "einsum":
+                raise ValueError(
+                    "dispatch_mode='einsum' has no explicit expert-parallel "
+                    "spelling; use 'sort' or 'grouped'")
+            if e % e_loc != 0:
+                raise ValueError(
+                    f"num_experts={e} must divide evenly over the expert-"
+                    f"parallel axis (local shard holds {e_loc})")
+
+        if self.dispatch_mode in ("sort", "grouped"):
             gate_vals, expert_idx = top_k_routing(gates, self.top_k)
             plan = make_dispatch_plan(expert_idx, e, capacity,
                                       token_mask=token_mask)
-            expert_in = gather_dispatch(x2, plan, e, capacity)   # [E, C, d]
-            out_e = self._experts(params, expert_in)
-            y = scatter_combine(out_e, gate_vals, plan)          # [b, o]
+            if ep:
+                y = self._ep_forward(params, x2, gate_vals, plan, capacity,
+                                     ep_axis)
+            elif self.dispatch_mode == "grouped":
+                rows = self._grouped_rows(params, x2, plan.buffer_idx,
+                                          plan.expert_tokens, e, capacity)
+                y = combine_rows(rows, gate_vals, plan.keep)     # [b, o]
+            else:
+                expert_in = gather_dispatch(x2, plan, e, capacity)
+                out_e = self._experts(params, expert_in)         # [E, C, o]
+                y = scatter_combine(out_e, gate_vals, plan)      # [b, o]
             expert_tokens = plan.expert_tokens.astype(jnp.float32)
             dropped = plan.dropped_tokens.astype(jnp.float32)
         else:
@@ -248,6 +461,10 @@ class MixtureOfExpertsLayer(Layer):
         new_state["aux_load_balance"] = e * jnp.sum(frac * mass)
         new_state["expert_tokens"] = expert_tokens
         new_state["dropped_tokens"] = dropped
+        # total granted capacity slots (E * C) this batch — lets listeners
+        # derive occupancy/drop pressure without re-deriving the GShard
+        # capacity formula client-side
+        new_state["capacity_slots"] = jnp.asarray(e * capacity, jnp.float32)
 
         if recurrent:
             y = jnp.transpose(y.reshape(b_, t_, self.n_out), (0, 2, 1))

@@ -32,6 +32,7 @@ from .passes import (
 from .quantize import (
     QuantizedConvolutionLayer,
     QuantizedDenseLayer,
+    QuantizedMixtureOfExpertsLayer,
     QuantizedSelfAttentionLayer,
     QuantizedTransformerDecoderBlockLayer,
     QuantizeWeightsPass,
@@ -46,6 +47,7 @@ __all__ = [
     "QuantizeWeightsPass",
     "QuantizedConvolutionLayer",
     "QuantizedDenseLayer",
+    "QuantizedMixtureOfExpertsLayer",
     "QuantizedSelfAttentionLayer",
     "QuantizedTransformerDecoderBlockLayer",
     "RewritePass",

@@ -65,6 +65,7 @@ from ..layers.attention import (
 from ..layers.base import Layer, LayerContext, Params, State, apply_input_dropout
 from ..layers.conv import ConvolutionLayer, _lax_padding
 from ..layers.feedforward import DenseLayer
+from ..layers.moe import MixtureOfExpertsLayer
 from .base import PassResult, RewritePass
 
 #: int8 symmetric range and fp8 e4m3 max-normal — the scale denominators.
@@ -90,25 +91,31 @@ def _quant_storage_dtype(quant_dtype: str):
                      f"expected one of {QUANT_DTYPES}")
 
 
-def quantize_weight(w, quant_dtype: str, *, channel_axis: int = -1
+def quantize_weight(w, quant_dtype: str, *,
+                    channel_axis: "int | Tuple[int, ...]" = -1
                     ) -> Tuple[jax.Array, jax.Array]:
     """Per-output-channel absmax quantization of one weight tensor.
 
     ``channel_axis`` names the OUTPUT-channel axis (kept at full
-    granularity; every other axis is reduced into the absmax). Scale math
+    granularity; every other axis is reduced into the absmax). A TUPLE of
+    axes keeps several — e.g. ``(0, 2)`` on an ``[E, d, h]`` expert slab
+    yields per-expert per-output-channel scales ``[E, h]``. Scale math
     runs in float64 on the host; returns ``(Wq, scale)`` with ``Wq`` in
-    the storage dtype and ``scale`` float32 of shape ``[n_channels]``.
+    the storage dtype and ``scale`` float32 shaped by the kept axes.
     The dequant identity is ``W ≈ Wq * scale`` broadcast over
     ``channel_axis``."""
     storage = _quant_storage_dtype(quant_dtype)
     w64 = np.asarray(w, np.float64)
-    axis = channel_axis % w64.ndim
-    reduce_axes = tuple(a for a in range(w64.ndim) if a != axis)
+    if isinstance(channel_axis, tuple):
+        keep = tuple(sorted(a % w64.ndim for a in channel_axis))
+    else:
+        keep = (channel_axis % w64.ndim,)
+    reduce_axes = tuple(a for a in range(w64.ndim) if a not in keep)
     amax = np.max(np.abs(w64), axis=reduce_axes) if reduce_axes \
         else np.abs(w64)
     denom = _INT8_MAX if quant_dtype == "int8" else _FP8_MAX
     scale = np.maximum(amax, _EPS) / denom
-    expand = tuple(None if a != axis else slice(None)
+    expand = tuple(slice(None) if a in keep else None
                    for a in range(w64.ndim))
     scaled = w64 / scale[expand]
     if quant_dtype == "int8":
@@ -327,9 +334,39 @@ class QuantizedTransformerDecoderBlockLayer(TransformerDecoderBlockLayer):
         return (r1 + ffn).transpose(0, 2, 1), new_state
 
 
+@register_config
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class QuantizedMixtureOfExpertsLayer(MixtureOfExpertsLayer):
+    """Rewrite product over :class:`MixtureOfExpertsLayer`: the expert
+    weight slabs ``We1``/``We2`` (``[E, d, h]``/``[E, h, o]``) stored
+    quantized with PER-EXPERT per-output-channel scales (``[E, h]``/
+    ``[E, o]`` — experts have independent weight distributions, so a
+    shared absmax would let one outlier expert crush the others'
+    resolution). The router ``Wg`` stays full precision: it is tiny and
+    its argmax decides routing, where rounding flips token assignments
+    rather than perturbing them smoothly. Dequant rides each expert
+    matmul's epilogue via the ``_expert_kernel`` hook, so all three
+    dispatch modes (einsum, sort, grouped) and the explicit
+    expert-parallel path serve quantized experts unchanged."""
+
+    quant_dtype: str = "int8"
+
+    def trainable_param_names(self) -> Tuple[str, ...]:
+        return ()
+
+    def init(self, key: jax.Array, dtype: Any) -> Params:
+        raise RuntimeError(
+            "QuantizedMixtureOfExpertsLayer is a rewrite product — see "
+            "QuantizeWeightsPass")
+
+    def _expert_kernel(self, params: Params, name: str):
+        return params[f"{name}_q"], params[f"{name}_scale"]
+
+
 _QUANTIZED_TYPES = (QuantizedDenseLayer, QuantizedConvolutionLayer,
                     QuantizedSelfAttentionLayer,
-                    QuantizedTransformerDecoderBlockLayer)
+                    QuantizedTransformerDecoderBlockLayer,
+                    QuantizedMixtureOfExpertsLayer)
 
 
 def count_quantized_layers(model) -> int:
@@ -351,8 +388,9 @@ def count_quantized_layers(model) -> int:
 
 class QuantizeWeightsPass(RewritePass):
     """Quantize the matmul weights of Dense / Conv / attention-projection
-    layers to ``dtype`` (``"int8"`` or ``"fp8"``), per-output-channel
-    absmax scales, dequant folded into each op's output epilogue.
+    / MoE-expert layers to ``dtype`` (``"int8"`` or ``"fp8"``),
+    per-output-channel absmax scales (per-expert for MoE slabs), dequant
+    folded into each op's output epilogue.
 
     ``act_ranges`` (``{layer_name: input_absmax}``, from
     :func:`calibrate`) additionally turns on int8 activation quantization
@@ -378,7 +416,7 @@ class QuantizeWeightsPass(RewritePass):
 
     # ---- per-layer transforms ----------------------------------------
     def _quantize_named(self, lparams: Dict[str, Any],
-                        names_axes: Sequence[Tuple[str, int]]
+                        names_axes: Sequence[Tuple[str, Any]]
                         ) -> Dict[str, Any]:
         """Replace each ``name`` weight with ``name_q``/``name_scale``;
         every other param entry (biases, LN) passes through."""
@@ -417,6 +455,15 @@ class QuantizeWeightsPass(RewritePass):
                 quant_dtype=self.dtype)
             return new, self._quantize_named(
                 lparams, [("Wq", 1), ("Wk", 1), ("Wv", 1), ("Wo", 1)])
+        if type(layer) is MixtureOfExpertsLayer and "We1" in lparams:
+            new = QuantizedMixtureOfExpertsLayer(
+                **{f.name: getattr(layer, f.name)
+                   for f in dataclasses.fields(layer)},
+                quant_dtype=self.dtype)
+            # per-expert (axis 0) × per-output-channel (axis 2) scales;
+            # Wg/be1/be2 pass through full precision
+            return new, self._quantize_named(
+                lparams, [("We1", (0, 2)), ("We2", (0, 2))])
         if type(layer) is TransformerDecoderBlockLayer and "Wq" in lparams:
             new = QuantizedTransformerDecoderBlockLayer(
                 **{f.name: getattr(layer, f.name)

@@ -90,12 +90,20 @@ def record_moe_metrics(state: Optional[Mapping[str, Any]],
     (``layer_name -> layer state``) into the registry.
 
     Every :class:`~deeplearning4j_tpu.nn.layers.MixtureOfExpertsLayer`
-    refreshes ``state["expert_tokens"]`` ([E] assignments kept per expert)
-    and ``state["dropped_tokens"]`` (capacity-overflow drops) per forward;
-    this turns the latest per-batch values into the cumulative series
+    refreshes ``state["expert_tokens"]`` ([E] assignments kept per expert),
+    ``state["dropped_tokens"]`` (capacity-overflow drops) and
+    ``state["capacity_slots"]`` (total buffer slots E·C) per forward;
+    this turns the latest per-batch values into
 
-    * ``dl4j_tpu_moe_expert_tokens_total{layer=,expert=}``
-    * ``dl4j_tpu_moe_dropped_tokens_total{layer=}``
+    * ``dl4j_tpu_moe_expert_tokens_total{layer=,expert=}`` (counter)
+    * ``dl4j_tpu_moe_dropped_tokens_total{layer=}`` (counter)
+    * ``dl4j_tpu_moe_capacity_slots{layer=}`` (gauge — alert when the
+      kept-token total approaches it: capacity_factor is too tight)
+    * ``dl4j_tpu_moe_drop_share{layer=}`` (gauge — dropped/(kept+dropped)
+      for THIS batch; the capacity_factor tuning signal)
+    * ``dl4j_tpu_moe_expert_load_cv{layer=}`` (gauge — std/mean of the
+      per-expert kept counts; 0 = perfectly balanced router, rising CV
+      means the aux loss is losing to expert collapse)
 
     Call once per completed step (that is what
     :class:`MoEMetricsListener` does). Returns the number of MoE layer
@@ -110,6 +118,18 @@ def record_moe_metrics(state: Optional[Mapping[str, Any]],
         "dl4j_tpu_moe_dropped_tokens_total",
         "MoE (token, slot) assignments dropped by capacity overflow",
         ("layer",))
+    slots = reg.gauge(
+        "dl4j_tpu_moe_capacity_slots",
+        "MoE expert-buffer slots (num_experts × capacity) per layer",
+        ("layer",))
+    share = reg.gauge(
+        "dl4j_tpu_moe_drop_share",
+        "Share of this batch's MoE assignments dropped by capacity "
+        "overflow: dropped / (kept + dropped)", ("layer",))
+    load_cv = reg.gauge(
+        "dl4j_tpu_moe_expert_load_cv",
+        "Coefficient of variation (std/mean) of per-expert kept token "
+        "counts this batch — 0 is perfect balance", ("layer",))
     seen = 0
     for lname, lstate in (state or {}).items():
         if not isinstance(lstate, Mapping) or "expert_tokens" not in lstate:
@@ -118,9 +138,18 @@ def record_moe_metrics(state: Optional[Mapping[str, Any]],
         counts = np.asarray(lstate["expert_tokens"], dtype=np.float64)
         for e_idx, c in enumerate(counts.tolist()):
             tok.labels(lname, str(e_idx)).inc(c)
+        kept = float(counts.sum())
+        mean = counts.mean() if counts.size else 0.0
+        load_cv.labels(lname).set(
+            float(counts.std() / mean) if mean > 0 else 0.0)
+        if "capacity_slots" in lstate:
+            slots.labels(lname).set(
+                float(np.asarray(lstate["capacity_slots"])))
         if "dropped_tokens" in lstate:
-            drop.labels(lname).inc(
-                float(np.asarray(lstate["dropped_tokens"])))
+            dropped = float(np.asarray(lstate["dropped_tokens"]))
+            drop.labels(lname).inc(dropped)
+            total = kept + dropped
+            share.labels(lname).set(dropped / total if total > 0 else 0.0)
     return seen
 
 

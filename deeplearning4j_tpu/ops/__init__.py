@@ -26,8 +26,15 @@ from .flash_attention import (
     mha_attention_reference,
     set_attention_impl,
 )
+from .grouped_matmul import (
+    grouped_matmul,
+    grouped_matmul_impl,
+    grouped_matmul_reference,
+    set_grouped_matmul_impl,
+)
 from .moe_dispatch import (
     DispatchPlan,
+    combine_rows,
     gather_dispatch,
     make_dispatch_plan,
     scatter_combine,
@@ -56,7 +63,12 @@ __all__ = [
     "mha_attention_reference",
     "set_attention_impl",
     "DispatchPlan",
+    "combine_rows",
     "gather_dispatch",
+    "grouped_matmul",
+    "grouped_matmul_impl",
+    "grouped_matmul_reference",
+    "set_grouped_matmul_impl",
     "make_dispatch_plan",
     "pack_row_blocks",
     "paged_cache_write",

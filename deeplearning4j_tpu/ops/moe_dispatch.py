@@ -132,12 +132,24 @@ def scatter_combine(out_e: jax.Array, gate_vals: jax.Array,
     exactly zero output (the residual path carries it).
     """
     e, c, o = out_e.shape
+    rows = jnp.take(out_e.reshape(e * c, o), plan.buffer_idx, axis=0,
+                    mode="fill", fill_value=0)                 # [k*n, o]
+    return combine_rows(rows, gate_vals, plan.keep,
+                        renormalize=renormalize, eps=eps)
+
+
+def combine_rows(rows: jax.Array, gate_vals: jax.Array, keep: jax.Array,
+                 *, renormalize: bool = True, eps: float = 1e-9) -> jax.Array:
+    """Gate-weight per-assignment output rows ``[k*n, o]`` (round-major
+    flat order) down to token order ``[n, o]`` — the combine arithmetic
+    shared by every dispatch mode, factored out so ``"grouped"`` (which
+    sources rows from the sorted grouped matmul instead of the ``[E, C]``
+    buffer) is gate-math-identical to ``"sort"`` by construction."""
     n, k = gate_vals.shape
+    o = rows.shape[-1]
     gate_flat = gate_vals.T.reshape(-1)                        # [k*n]
-    kept_gate = jnp.where(plan.keep, gate_flat, 0)
+    kept_gate = jnp.where(keep, gate_flat, 0)
     if renormalize:
         denom = jnp.sum(kept_gate.reshape(k, n), axis=0)       # [n]
         kept_gate = kept_gate / jnp.tile(jnp.maximum(denom, eps), k)
-    rows = jnp.take(out_e.reshape(e * c, o), plan.buffer_idx, axis=0,
-                    mode="fill", fill_value=0)                 # [k*n, o]
     return jnp.sum((rows * kept_gate[:, None]).reshape(k, n, o), axis=0)

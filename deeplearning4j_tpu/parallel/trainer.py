@@ -44,11 +44,24 @@ def moe_expert_parallel_rules(axis: str = "model",
 
     Shards every :class:`~deeplearning4j_tpu.nn.layers.MixtureOfExpertsLayer`
     expert-dim parameter (``We1``/``be1``/``We2``/``be2`` all carry a
-    leading ``E``) and leaves the router ``Wg`` replicated. Valid for both
-    ``dispatch_mode="sort"`` and ``"einsum"``: the sort path's ``[E, C, d]``
-    expert buffer keeps the same leading expert dim, so GSPMD partitions
-    the batched expert MLP identically and inserts the all-to-alls around
-    the gather/scatter instead of the one-hot contractions.
+    leading ``E``) and leaves the router ``Wg`` replicated.
+
+    On the default implicit (GSPMD) path this is valid for every
+    ``dispatch_mode``: the sort/grouped paths' expert buffers keep the
+    same leading expert dim as the einsum path, so GSPMD partitions the
+    batched expert MLP identically and inserts the all-to-alls around the
+    gather/scatter instead of the one-hot contractions.
+
+    With an EXPLICIT strategy (shard_map path — e.g.
+    ``BucketedAllReduceSync``) these rules are the sanctioned exception
+    to the no-TP-rules restriction: because every matched param shards
+    only its leading expert dim over one non-data axis, the trainer
+    slices expert params over ``axis``, hands layers the axis name via
+    ``DistContext.ep_axis``, and ``MixtureOfExpertsLayer`` spells the
+    local-expert compute + ``psum_scatter`` combine itself
+    (``dispatch_mode`` "sort" or "grouped"; composes with ``zero1=True``,
+    which keeps sharding the replicated params' updater slices over the
+    data axis while expert slices stay on ``axis``).
 
     ``layer_pattern`` narrows the match to specific layer names (rules are
     matched against ``"layername/paramname"``).
@@ -143,11 +156,23 @@ class DistributedTrainer:
             raise ValueError(
                 f"bn_group_size {self.bn_group_size} must divide the data "
                 f"axis ({self.n_data_shards} shards)")
+        self._ep_axis: Optional[str] = None
         if param_sharding_rules and self.strategy.explicit:
-            raise ValueError(
-                "param_sharding_rules (tensor parallelism) requires the default "
-                "SyncAllReduce strategy — explicit strategies replicate params"
-            )
+            # Sanctioned exception: pure expert-parallel rules (every spec
+            # shards ONLY dim 0 over one non-data mesh axis — the shape
+            # moe_expert_parallel_rules emits). The MoE layers spell the
+            # local compute + combine themselves via DistContext.ep_axis;
+            # any other rule shape still has no explicit-path spelling.
+            self._ep_axis = self._resolve_ep_axis(param_sharding_rules)
+            if self._ep_axis is None:
+                raise ValueError(
+                    "param_sharding_rules (tensor parallelism) requires the "
+                    "default SyncAllReduce strategy — explicit strategies "
+                    "replicate params. Exception: expert-parallel rules "
+                    "(every spec P(axis) on dim 0 over one non-data axis, "
+                    "e.g. moe_expert_parallel_rules()) are spelled "
+                    "explicitly by the MoE layers."
+                )
         if self.zero1 and not getattr(self.strategy, "replicated_grads", True):
             raise ValueError(
                 "zero1 requires a strategy whose synced gradients are identical "
@@ -183,8 +208,23 @@ class DistributedTrainer:
         self.params = self._put_tree(model.params, self._param_shardings())
         self.state = self._put_tree(model.state, self._replicated)
         self.opt_state = self._put_tree(host_opt, self._opt_shardings)
-        self.strat_state = self._put_tree(
-            self.strategy.init_state(model.params), self._replicated)
+        # Explicit EP: the sync strategy sees LOCAL (per-expert-shard)
+        # grad shapes inside shard_map, so shape-derived layouts (e.g.
+        # BucketedAllReduceSync's buckets) must be sized from the local
+        # template, and per-shard persistent sync state (compression
+        # error feedback) would diverge across the expert axis — reject.
+        strat_template = (model.params if self._ep_axis is None
+                          else self._ep_local_template())
+        strat0 = self.strategy.init_state(strat_template)
+        if self._ep_axis is not None and any(
+                np.ndim(leaf) > 0
+                for leaf in jax.tree_util.tree_leaves(strat0)):
+            raise ValueError(
+                "expert parallelism on the explicit path requires a sync "
+                "strategy without per-replica persistent state (error "
+                "feedback would diverge across expert shards); use "
+                "BucketedAllReduceSync or SyncAllReduce")
+        self.strat_state = self._put_tree(strat0, self._replicated)
         self.iteration = 0
         self._step = None
         self.metrics_every = int(metrics_every)
@@ -208,6 +248,52 @@ class DistributedTrainer:
             return jax.tree_util.tree_map(
                 lambda leaf: put_one(leaf, shardings), tree)
         return jax.tree_util.tree_map(put_one, tree, shardings)
+
+    # ----- explicit expert parallelism -------------------------------
+    def _resolve_ep_axis(self, rules) -> Optional[str]:
+        """The expert-parallel mesh axis IF every rule spec is P(axis) on
+        dim 0 over one shared non-data mesh axis; None otherwise."""
+        axes = set()
+        for _, spec in rules:
+            entries = tuple(spec)
+            if len(entries) != 1 or entries[0] is None:
+                return None
+            ax = entries[0]
+            if isinstance(ax, (tuple, list)):
+                return None
+            axes.add(ax)
+        if len(axes) != 1:
+            return None
+        ax = axes.pop()
+        if ax == self.data_axis or ax not in self.mesh.axis_names:
+            return None
+        return ax
+
+    @property
+    def ep_shards(self) -> int:
+        return self.mesh.shape[self._ep_axis] if self._ep_axis else 1
+
+    def _ep_local_template(self):
+        """Host template of the PER-SHARD param shapes under explicit EP
+        (expert dim divided over the EP axis) — what grads look like
+        inside shard_map, for shape-derived strategy layouts."""
+        n = self.ep_shards
+        out = {}
+        for ln, lp in self.model.params.items():
+            d = {}
+            for pn, p in lp.items():
+                shp = list(np.shape(p))
+                spec = self._spec_for(f"{ln}/{pn}")
+                if tuple(spec) and shp:
+                    if shp[0] % n:
+                        raise ValueError(
+                            f"expert-parallel param {ln}/{pn} dim 0 "
+                            f"({shp[0]}) must divide the {self._ep_axis!r} "
+                            f"axis ({n} shards)")
+                    shp[0] //= n
+                d[pn] = np.zeros(shp, dtype=np.asarray(p).dtype)
+            out[ln] = d
+        return out
 
     # ----- shardings -------------------------------------------------
     def _spec_for(self, path: str) -> P:
@@ -265,8 +351,11 @@ class DistributedTrainer:
         when rules shard other dims); everything else — scalars (step
         counts), non-divisible leaves, non-elementwise layers — stays
         replicated. Without zero1: fully replicated (the historical
-        layout, and what pre-zero1 checkpoints expect)."""
-        if not self.zero1:
+        layout, and what pre-zero1 checkpoints expect) — except under
+        explicit EP, where param-shaped leaves follow their param's
+        expert sharding so the per-shard optax update sees matching
+        slices."""
+        if not self.zero1 and self._ep_axis is None:
             return self._replicated
         out = {}
         for lname, lstate in host_opt.items():
@@ -287,7 +376,7 @@ class DistributedTrainer:
     def _updater_pspecs(self):
         """PartitionSpec mirror of :meth:`_updater_shardings` for the
         explicit (shard_map) path's in/out specs."""
-        if not self.zero1:
+        if not self.zero1 and self._ep_axis is None:
             return P()
         return jax.tree_util.tree_map(
             lambda sh: sh.spec, self._opt_shardings,
@@ -387,7 +476,8 @@ class DistributedTrainer:
             optim = LayerOptimizers(model, zero1_axis=axis,
                                     zero1_sliced=flags)
         dist = DistContext(axis=axis, n_shards=n,
-                           bn_group_size=self.bn_group_size)
+                           bn_group_size=self.bn_group_size,
+                           ep_axis=self._ep_axis, ep_shards=self.ep_shards)
 
         def shard_step(params, opt_state, state, strat_state, x, y, rng, it):
             rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
@@ -434,11 +524,20 @@ class DistributedTrainer:
         rep = P()
         data = P(self.data_axis)
         opt_specs = self._updater_pspecs()
+        # Under explicit EP, expert params enter/leave the shard_map
+        # sliced over the expert axis; everything else stays replicated.
+        if self._ep_axis is not None:
+            param_specs = {
+                ln: {pn: self._spec_for(f"{ln}/{pn}") for pn in lp}
+                for ln, lp in model.params.items()
+            }
+        else:
+            param_specs = rep
         mapped = _shmap(
             shard_step,
             self.mesh,
-            in_specs=(rep, opt_specs, rep, rep, data, data, rep, rep),
-            out_specs=(rep, opt_specs, rep, rep, rep),
+            in_specs=(param_specs, opt_specs, rep, rep, data, data, rep, rep),
+            out_specs=(param_specs, opt_specs, rep, rep, rep),
         )
         return jax.jit(mapped, donate_argnums=(0, 1, 2, 3) + (
             (4, 5) if self.donate_inputs else ()))

@@ -43,9 +43,35 @@ def test_select_rows_filters_exactly():
     assert list(sel) == ["paged_kv_occupancy", "disagg_handoff"]
     assert sel["paged_kv_occupancy"] == "paged_kv_occupancy"
     assert sel["disagg_handoff"] == "disagg_handoff"
+    # ISSUE 18: moe_dispatch is CPU-runnable now (grouped no-regression
+    # gate runs everywhere; the ≤1.5 overhead ratio stays chip-only)
+    sel = bench.select_rows("moe_dispatch")
+    assert sel == {"moe_dispatch": "moe_dispatch"}
+    assert "moe_dispatch" in bench._EXTRA_ROWS
+    assert "moe_dispatch" not in bench._CHIP_ONLY_ROWS
     # every selectable row maps to a registered measurement
     for row, meas in {**bench._EXTRA_ROWS, **bench._CHIP_ONLY_ROWS}.items():
         assert meas in bench._MEASUREMENTS, (row, meas)
+
+
+def test_moe_dispatch_row_grouped_columns():
+    """The moe_dispatch row reports all three dispatch modes and the
+    grouped gates (ISSUE 18) on a CPU-sized config."""
+    bench = _load_bench()
+    row = bench.measure_moe_dispatch(tokens=64, d=16, experts=4, top_k=2,
+                                     hidden=32, iters=1)
+    for key in ("moe_sort_grad_step_ms", "moe_einsum_grad_step_ms",
+                "moe_grouped_grad_step_ms", "grouped_dispatch_overhead_ratio",
+                "grouped_vs_sort_speedup"):
+        assert isinstance(row[key], float) and row[key] > 0, key
+    gate = row["grouped_no_regression_vs_sort"]
+    assert set(gate) == {"max_ratio", "ratio", "ok"}
+    # iters=1 on micro shapes is timing-noise territory; the structural
+    # contract is the smoke here — the real gate runs via --rows with
+    # the tuned cpu kwargs (see _child_measure)
+    assert gate["ok"] == (gate["ratio"] <= gate["max_ratio"])
+    chip = row["grouped_overhead_chip_target"]
+    assert chip["chip_only"] is True and chip["max"] == 1.5
 
 
 def test_select_rows_rejects_unknown_and_empty():

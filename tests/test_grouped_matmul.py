@@ -1,0 +1,160 @@
+"""Sorted grouped expert matmul (ops/grouped_matmul.py, ISSUE 18).
+
+Tier-1 contract: the masked-XLA reference equals a naive per-group numpy
+loop (including empty groups and dropped rows past the frontier), the
+Pallas kernel (interpret mode on CPU) equals the reference, the custom
+VJP equals ``jax.grad`` of the reference and float64 numerics, and the
+impl seam validates its inputs.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops import (
+    grouped_matmul,
+    grouped_matmul_impl,
+    grouped_matmul_reference,
+    set_grouped_matmul_impl,
+)
+from deeplearning4j_tpu.ops.grouped_matmul import _gmm_pallas, _tiling
+
+
+def _case(seed=0, e=4, d=8, h=16, n=40, sizes=(7, 0, 12, 5),
+          dtype=np.float32):
+    """lhs rows sorted by group; sum(sizes) < n leaves dropped tail rows."""
+    assert len(sizes) == e and sum(sizes) <= n
+    rs = np.random.RandomState(seed)
+    lhs = rs.randn(n, d).astype(dtype)
+    rhs = rs.randn(e, d, h).astype(dtype)
+    gs = np.asarray(sizes, np.int32)
+    return lhs, gs, rhs
+
+
+def _naive(lhs, group_sizes, rhs):
+    n, _ = lhs.shape
+    e, _, h = rhs.shape
+    out = np.zeros((n, h), np.float64)
+    start = 0
+    for g in range(e):
+        stop = start + int(group_sizes[g])
+        out[start:stop] = lhs[start:stop].astype(np.float64) \
+            @ rhs[g].astype(np.float64)
+        start = stop
+    return out  # rows past the frontier stay zero
+
+
+def test_reference_matches_naive_loop():
+    lhs, gs, rhs = _case()
+    y = np.asarray(grouped_matmul_reference(jnp.asarray(lhs),
+                                            jnp.asarray(gs),
+                                            jnp.asarray(rhs)))
+    np.testing.assert_allclose(y, _naive(lhs, gs, rhs), rtol=1e-5,
+                               atol=1e-5)
+    # dropped rows (past sum(group_sizes)) produce exactly zero
+    np.testing.assert_array_equal(y[int(gs.sum()):], 0.0)
+
+
+def test_empty_and_full_groups():
+    lhs, gs, rhs = _case(e=3, sizes=(0, 0, 6), n=6)
+    y = np.asarray(grouped_matmul(jnp.asarray(lhs), jnp.asarray(gs),
+                                  jnp.asarray(rhs)))
+    np.testing.assert_allclose(y, _naive(lhs, gs, rhs), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_pallas_interpret_matches_reference():
+    lhs, gs, rhs = _case(seed=2)
+    m_pad = _tiling(lhs.shape[0], None, 8)[0]
+    y_pl = _gmm_pallas(jnp.asarray(lhs), jnp.asarray(rhs), jnp.asarray(gs),
+                       m_pad, 8, interpret=True)
+    y_ref = grouped_matmul_reference(jnp.asarray(lhs), jnp.asarray(gs),
+                                     jnp.asarray(rhs))
+    np.testing.assert_allclose(np.asarray(y_pl), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("max_group", [None, 16])
+def test_vjp_matches_reference_grad(max_group):
+    lhs, gs, rhs = _case(seed=3, dtype=np.float64)
+    g = np.random.RandomState(9).randn(lhs.shape[0],
+                                       rhs.shape[-1]).astype(np.float64)
+
+    def f(fn):
+        def loss(l, r):
+            y = fn(l, jnp.asarray(gs), r, max_group_size=max_group)
+            return jnp.sum(y * jnp.asarray(g))
+        return jax.grad(loss, argnums=(0, 1))
+
+    dl, dr = f(grouped_matmul)(jnp.asarray(lhs), jnp.asarray(rhs))
+    dl_r, dr_r = f(grouped_matmul_reference)(jnp.asarray(lhs),
+                                             jnp.asarray(rhs))
+    np.testing.assert_allclose(np.asarray(dl), np.asarray(dl_r),
+                               rtol=1e-10, atol=1e-10)
+    np.testing.assert_allclose(np.asarray(dr), np.asarray(dr_r),
+                               rtol=1e-10, atol=1e-10)
+
+
+def test_vjp_matches_central_difference():
+    lhs, gs, rhs = _case(seed=4, e=2, d=3, h=4, n=7, sizes=(3, 2),
+                         dtype=np.float64)
+
+    def loss(l, r):
+        return jnp.sum(jnp.square(
+            grouped_matmul(l, jnp.asarray(gs), r)))
+
+    dl = np.asarray(jax.grad(loss, 0)(jnp.asarray(lhs), jnp.asarray(rhs)))
+    eps = 1e-6
+    for (i, j) in [(0, 0), (2, 1), (4, 2), (6, 0)]:  # incl. a dropped row
+        lp, lm = lhs.copy(), lhs.copy()
+        lp[i, j] += eps
+        lm[i, j] -= eps
+        num = (loss(jnp.asarray(lp), jnp.asarray(rhs))
+               - loss(jnp.asarray(lm), jnp.asarray(rhs))) / (2 * eps)
+        np.testing.assert_allclose(dl[i, j], float(num), rtol=1e-5,
+                                   atol=1e-8)
+
+
+def test_bf16_uses_f32_accumulation():
+    lhs, gs, rhs = _case(seed=5, n=32, sizes=(10, 6, 9, 7))
+    y16 = np.asarray(grouped_matmul(
+        jnp.asarray(lhs, jnp.bfloat16), jnp.asarray(gs),
+        jnp.asarray(rhs, jnp.bfloat16)), np.float32)
+    np.testing.assert_allclose(y16, _naive(lhs, gs, rhs), rtol=5e-2,
+                               atol=5e-2)
+
+
+def test_int8_rhs_is_cast_not_rejected():
+    """Quantized expert slabs arrive as int8; the op casts to the lhs
+    compute dtype (small integers are exact in float)."""
+    lhs, gs, _ = _case(seed=6)
+    rhs_q = np.random.RandomState(7).randint(-127, 128,
+                                             (4, 8, 16)).astype(np.int8)
+    y = np.asarray(grouped_matmul(jnp.asarray(lhs), jnp.asarray(gs),
+                                  jnp.asarray(rhs_q)))
+    np.testing.assert_allclose(
+        y, _naive(lhs, gs, rhs_q.astype(np.float32)), rtol=1e-4, atol=1e-3)
+
+
+def test_impl_seam_validates():
+    assert grouped_matmul_impl() in ("auto", "pallas", "xla")
+    prev = grouped_matmul_impl()
+    try:
+        set_grouped_matmul_impl("xla")
+        assert grouped_matmul_impl() == "xla"
+        with pytest.raises(ValueError, match="unknown grouped_matmul"):
+            set_grouped_matmul_impl("cudnn")
+    finally:
+        set_grouped_matmul_impl(prev)
+
+
+def test_shape_validation():
+    lhs, gs, rhs = _case()
+    with pytest.raises(ValueError):
+        grouped_matmul(jnp.asarray(lhs), jnp.asarray(gs),
+                       jnp.asarray(rhs[:, :5]))  # d mismatch
+    with pytest.raises(ValueError):
+        grouped_matmul(jnp.asarray(lhs), jnp.asarray(gs[:2]),
+                       jnp.asarray(rhs))  # E mismatch

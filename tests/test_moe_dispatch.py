@@ -1,9 +1,11 @@
-"""Sort-based MoE dispatch (ops/moe_dispatch.py, ISSUE 3).
+"""Sort-based MoE dispatch (ops/moe_dispatch.py, ISSUE 3 + 18).
 
-Tier-1 contract: ``dispatch_mode="sort"`` (gather/scatter) and
-``"einsum"`` (legacy dense one-hot) implement the SAME GShard routing —
-identical slot assignment (first-come-first-served in (round, token)
-order), identical capacity drops, matching outputs and gradients — plus
+Tier-1 contract: ``dispatch_mode="sort"`` (gather/scatter), ``"einsum"``
+(legacy dense one-hot) and ``"grouped"`` (sorted grouped expert matmul,
+ops.grouped_matmul) implement the SAME GShard routing — identical slot
+assignment (first-come-first-served in (round, token) order), identical
+capacity drops, matching outputs and gradients across the full
+{mode} × {top_k} × {capacity_factor} × {mask} × {dtype} matrix — plus
 the routing-observability state and the micro-bench tool smoke.
 """
 
@@ -166,6 +168,101 @@ def test_capacity_overflow_drops_sort_mode():
     assert np.asarray(state["expert_tokens"]).max() <= 1
 
 
+# ---- full mode-equivalence matrix (ISSUE 18) ------------------------------
+
+
+def _moe(mode, k, cap, dtype, e=4, d=6, h=8, o=6, seed=0):
+    lay = MixtureOfExpertsLayer(
+        n_in=d, n_out=o, num_experts=e, hidden=h, top_k=k,
+        capacity_factor=cap, activation=Activation.RELU,
+        dispatch_mode=mode)
+    params = lay.init(jax.random.PRNGKey(seed), dtype)
+    return lay, params
+
+
+# Curated slice of the mode × top_k × capacity × mask × dtype cross:
+# "grouped" (the bit-identical claim) gets the full k × cap cross in
+# f32 plus masked/bf16 spot checks; "einsum" (float-tolerance
+# reference) gets one spot check per varied dimension. The full
+# 48-case cross costs ~1 min of tier-1 budget for no extra coverage.
+_MATRIX = [
+    ("grouped", 1, 1.0, False, "float32"),
+    ("grouped", 2, 1.0, False, "float32"),
+    ("grouped", 4, 1.0, False, "float32"),
+    ("grouped", 1, 1.5, False, "float32"),
+    ("grouped", 2, 1.5, False, "float32"),
+    ("grouped", 4, 1.5, False, "float32"),
+    ("grouped", 2, 1.5, True, "float32"),
+    ("grouped", 2, 1.0, False, "bfloat16"),
+    ("grouped", 4, 1.5, True, "bfloat16"),
+    ("einsum", 1, 1.0, False, "float32"),
+    ("einsum", 2, 1.5, False, "float32"),
+    ("einsum", 4, 1.0, False, "float32"),
+    ("einsum", 2, 1.0, True, "float32"),
+    ("einsum", 2, 1.5, False, "bfloat16"),
+]
+
+
+@pytest.mark.parametrize(
+    "mode,k,cap,masked,dtype", _MATRIX,
+    ids=[f"{m}-{k}-{c}-{'masked' if mk else 'flat'}-{d}"
+         for m, k, c, mk, d in _MATRIX])
+def test_mode_equivalence_matrix(mode, k, cap, masked, dtype):
+    """Every non-default dispatch mode matches "sort" on outputs AND
+    parameter gradients across top_k × capacity_factor × mask × dtype.
+    "grouped" shares the sort plan and combine arithmetic, so its
+    outputs must be exact in f32; "einsum" reassociates reductions, so
+    it gets float tolerance."""
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    base, params = _moe("sort", k, cap, dt)
+    other, _ = _moe(mode, k, cap, dt)
+    rs = np.random.RandomState(11)
+    if masked:
+        b, t = 2, 5
+        x = jnp.asarray(rs.rand(b, 6, t), dt)
+        mask = jnp.asarray((np.arange(t) < 3)[None, :].repeat(b, 0)
+                           .astype(np.float32))
+    else:
+        x = jnp.asarray(rs.rand(10, 6), dt)
+        mask = None
+
+    def run(lay):
+        def loss(p):
+            y, state = lay.apply(p, lay.init_state(dt), x,
+                                 LayerContext(mask=mask))
+            return jnp.sum(jnp.square(y.astype(jnp.float32))), (y, state)
+        (l, (y, state)), grads = jax.value_and_grad(
+            loss, has_aux=True)(params)
+        return np.asarray(y, np.float32), state, grads
+
+    ys, ss, gs = run(base)
+    yo, so, go = run(other)
+    scale = max(float(np.abs(ys).max()), 1e-6)
+    if mode == "grouped" and dtype == "float32":
+        out_tol = dict(rtol=0, atol=1e-6 * scale)
+    elif dtype == "float32":
+        out_tol = dict(rtol=1e-5, atol=1e-6)
+    else:  # bf16: accumulation order differs between spellings
+        out_tol = dict(rtol=0, atol=3e-2 * scale)
+    np.testing.assert_allclose(yo, ys, err_msg="outputs", **out_tol)
+    np.testing.assert_array_equal(np.asarray(ss["expert_tokens"]),
+                                  np.asarray(so["expert_tokens"]))
+    assert float(ss["dropped_tokens"]) == float(so["dropped_tokens"])
+    assert float(ss["capacity_slots"]) == float(so["capacity_slots"]) > 0
+    # tolerance scaled by the GLOBAL gradient magnitude: with k=1 the
+    # renormalized gate makes the true router gradient exactly zero and
+    # both spellings produce only roundoff noise there — a per-param
+    # scale would compare noise against noise
+    gscale = max(max(np.abs(np.asarray(g, np.float32)).max()
+                     for g in gs.values()), 1e-6)
+    gtol = 1e-5 if dtype == "float32" else 6e-2
+    for name in gs:
+        a = np.asarray(gs[name], np.float32)
+        b = np.asarray(go[name], np.float32)
+        np.testing.assert_allclose(b, a, rtol=0, atol=gtol * gscale,
+                                   err_msg=f"grad {name}")
+
+
 # ---- gradcheck (float64, reference GradCheckUtil harness) -----------------
 
 
@@ -205,15 +302,17 @@ def test_gradcheck_modes_agree_with_balance_loss():
     rs = np.random.default_rng(10)
     x = rs.normal(size=(9, 5))
     y = np.eye(2)[np.arange(9) % 2]
-    ms, me = build("sort"), build("einsum")
-    me.params = jax.tree_util.tree_map(lambda a: a, ms.params)  # same init
+    ms = build("sort")
     gs = ms.calculate_gradients(x, y)
-    ge = me.calculate_gradients(x, y)
     flat_s = jax.tree_util.tree_leaves(gs)
-    flat_e = jax.tree_util.tree_leaves(ge)
-    for a, b in zip(flat_s, flat_e):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-8, atol=1e-10)
+    for mode in ("einsum", "grouped"):
+        mo = build(mode)
+        mo.params = jax.tree_util.tree_map(lambda a: a, ms.params)
+        go = mo.calculate_gradients(x, y)
+        for a, b in zip(flat_s, jax.tree_util.tree_leaves(go)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-8, atol=1e-10,
+                                       err_msg=mode)
 
 
 # ---- observability --------------------------------------------------------
@@ -301,3 +400,5 @@ def test_bench_tool_smoke(capsys):
     assert row["modes_agree"]
     assert row["sort_grad_step_ms"] > 0
     assert row["einsum_grad_step_ms"] > 0
+    assert row["grouped_grad_step_ms"] > 0
+    assert row["grouped_max_abs_output_diff"] == 0.0

@@ -26,6 +26,7 @@ from deeplearning4j_tpu.nn.layers import (
 from deeplearning4j_tpu.nn.rewrite import (
     QuantizedConvolutionLayer,
     QuantizedDenseLayer,
+    QuantizedMixtureOfExpertsLayer,
     QuantizedSelfAttentionLayer,
     QuantizedTransformerDecoderBlockLayer,
     QuantizeWeightsPass,
@@ -127,6 +128,64 @@ def test_int8_pass_rewrites_dense_and_bounds_error():
     out = np.asarray(q.output(x))
     assert np.abs(out - base).max() < 5e-2
     assert np.mean((out - base) ** 2) < 1e-4
+
+
+@pytest.mark.parametrize("mode", ["einsum", "sort", "grouped"])
+def test_int8_pass_rewrites_moe_experts(mode):
+    """MoE expert slabs quantize with per-expert per-output-channel
+    scales; the router Wg and biases stay full precision; all dispatch
+    modes serve the quantized experts (ISSUE 18)."""
+    from deeplearning4j_tpu.nn.layers import MixtureOfExpertsLayer
+
+    conf = (NeuralNetConfiguration.builder().seed(11).list()
+            .layer(MixtureOfExpertsLayer(n_out=8, num_experts=4, hidden=16,
+                                         top_k=2, dispatch_mode=mode))
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(8)).build())
+    model = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 8).astype(np.float32)
+    base = np.asarray(model.output(x))
+    q, applied = rewrite_model(model, [QuantizeWeightsPass("int8")])
+    assert applied == ["quantize_weights_int8"]
+    lay = q.conf.layers[0]
+    assert type(lay) is QuantizedMixtureOfExpertsLayer
+    assert lay.dispatch_mode == mode
+    assert lay.trainable_param_names() == ()
+    assert count_quantized_layers(q) == 1
+    lname = q.conf.layer_name(0)
+    lp = q.params[lname]
+    assert set(lp) == {"Wg", "We1_q", "We1_scale", "We2_q", "We2_scale",
+                       "be1", "be2"}
+    assert lp["We1_q"].dtype == jnp.int8
+    assert lp["We1_scale"].shape == (4, 16)  # per-expert × per-channel
+    assert lp["We2_scale"].shape == (4, 8)
+    assert lp["Wg"].dtype == jnp.float32  # router untouched
+    out = np.asarray(q.output(x))
+    assert np.abs(out - base).max() < 5e-2
+    # idempotent: re-running the pass is a no-op
+    q2, ap2 = rewrite_model(q, [QuantizeWeightsPass("int8")])
+    assert ap2 == [] and q2 is q
+    with pytest.raises(RuntimeError, match="rewrite product"):
+        lay.init(None, jnp.float32)
+
+
+def test_quantize_weight_tuple_axis_per_expert():
+    """Tuple channel_axis keeps several axes at full granularity — the
+    per-expert expert-slab scheme. Per-expert scales must beat one
+    shared-absmax scale when expert magnitudes differ wildly."""
+    rng = np.random.RandomState(3)
+    w = rng.randn(4, 8, 16)
+    w[0] *= 100.0  # an outlier expert would crush a shared absmax
+    q, s = quantize_weight(w, "int8", channel_axis=(0, 2))
+    assert q.shape == w.shape and s.shape == (4, 16)
+    deq = np.asarray(q, np.float64) * np.asarray(s, np.float64)[:, None, :]
+    per_expert_err = np.abs(deq - w)[1:].max()
+    q1, s1 = quantize_weight(w, "int8", channel_axis=2)
+    deq1 = np.asarray(q1, np.float64) * np.asarray(s1, np.float64)
+    shared_err = np.abs(deq1 - w)[1:].max()
+    assert per_expert_err < shared_err / 10
 
 
 def test_int8_pass_rewrites_conv():

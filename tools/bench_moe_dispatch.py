@@ -1,8 +1,8 @@
 #!/usr/bin/env python
 """MoE dispatch micro-bench: sort (gather/scatter) vs einsum (dense
-one-hot) on CPU-sized shapes.
+one-hot) vs grouped (sorted grouped expert matmul) on CPU-sized shapes.
 
-ISSUE 3 tooling: a standalone, seconds-not-minutes comparison of the two
+ISSUE 3/18 tooling: a standalone, seconds-not-minutes comparison of the
 ``MixtureOfExpertsLayer.dispatch_mode`` spellings on shapes a laptop CPU
 handles, printing one JSON line (bench.py's ``moe_dispatch`` measurement
 is the full-shape TPU row; this is the fast local loop for dispatch-path
@@ -11,7 +11,7 @@ work). Runs standalone::
     python tools/bench_moe_dispatch.py [--tokens 2048] [--mode both]
 
 and as a tier-1 smoke via tests/test_moe_dispatch.py, which also asserts
-the two modes agree numerically on the benched shape.
+the modes agree numerically on the benched shape.
 """
 
 from __future__ import annotations
@@ -32,7 +32,8 @@ def run(tokens: int = 2048, d: int = 64, experts: int = 8, top_k: int = 2,
     """Time one jitted grad step per dispatch mode; returns the JSON row.
 
     With ``check=True`` also verifies the modes agree on outputs (max
-    abs diff under a float32 tolerance) before timing — a bench of two
+    abs diff under a float32 tolerance; sort vs grouped must be EXACT —
+    same gate arithmetic by construction) before timing — a bench of
     paths that disagree measures nothing.
     """
     import jax
@@ -47,7 +48,7 @@ def run(tokens: int = 2048, d: int = 64, experts: int = 8, top_k: int = 2,
     grads = {}
     outs = {}
     times = {}
-    for mode in ("sort", "einsum"):
+    for mode in ("sort", "einsum", "grouped"):
         lay = MixtureOfExpertsLayer(
             n_in=d, n_out=d, num_experts=experts, hidden=hidden,
             top_k=top_k, capacity_factor=capacity_factor,
@@ -79,7 +80,9 @@ def run(tokens: int = 2048, d: int = 64, experts: int = 8, top_k: int = 2,
         "iters": iters,
         "sort_grad_step_ms": round(times["sort"], 3),
         "einsum_grad_step_ms": round(times["einsum"], 3),
+        "grouped_grad_step_ms": round(times["grouped"], 3),
         "sort_vs_einsum_speedup": round(times["einsum"] / times["sort"], 2),
+        "grouped_vs_sort_speedup": round(times["sort"] / times["grouped"], 2),
     }
     if check:
         out_diff = float(np.max(np.abs(outs["sort"] - outs["einsum"])))
@@ -88,9 +91,18 @@ def run(tokens: int = 2048, d: int = 64, experts: int = 8, top_k: int = 2,
             float(np.max(np.abs(np.asarray(grads["sort"][k])
                                 - np.asarray(grads["einsum"][k]))))
             for k in grads["sort"])
+        grouped_out_diff = float(
+            np.max(np.abs(outs["sort"] - outs["grouped"])))
+        grouped_grad_diff = max(
+            float(np.max(np.abs(np.asarray(grads["sort"][k])
+                                - np.asarray(grads["grouped"][k]))))
+            for k in grads["sort"])
         row["max_abs_output_diff"] = out_diff
         row["max_abs_grad_diff"] = grad_diff
-        row["modes_agree"] = bool(out_diff <= 1e-4 * scale)
+        row["grouped_max_abs_output_diff"] = grouped_out_diff
+        row["grouped_max_abs_grad_diff"] = grouped_grad_diff
+        row["modes_agree"] = bool(out_diff <= 1e-4 * scale
+                                  and grouped_out_diff <= 1e-5 * scale)
     return row
 
 
@@ -104,7 +116,7 @@ def main(argv=None) -> int:
     ap.add_argument("--capacity-factor", type=float, default=1.25)
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--no-check", action="store_true",
-                    help="skip the numeric sort==einsum verification")
+                    help="skip the numeric mode-equivalence verification")
     args = ap.parse_args(argv)
     row = run(tokens=args.tokens, d=args.d, experts=args.experts,
               top_k=args.top_k, hidden=args.hidden,
