@@ -355,6 +355,17 @@ class EnginePool:
         # unaffected (same dispatch code, no fabric metrics)
         self._has_remote = any(getattr(e, "is_remote", False)
                                for e in self.replicas + self.decode_replicas)
+        # template for add_replica(): clone-a-local-replica knobs. The
+        # model/version are read LIVE at add time (self.model), so a
+        # replica added after a hot swap serves the swapped version.
+        self._replica_template = dict(
+            batch_limit=batch_limit, workers=workers,
+            queue_limit=queue_limit, default_timeout=default_timeout,
+            flush_timeout=flush_timeout, clock=clock,
+            fault_injector=fault_injector, tracer=tracer)
+        self._replica_seq = len(engines)
+        self._adaptive = bool(adaptive)
+        self._target_p95_s = float(target_p95_s)
 
         # pool-level admission: the shed-first-by-priority gate in front
         # of dispatch. Default window = the sum of the replica windows
@@ -407,6 +418,9 @@ class EnginePool:
             "dl4j_tpu_pool_dispatch_total",
             "Requests dispatched by the pool, per replica",
             ("pool", "replica"))
+        self._c_disp_family = disp
+        # children outlive membership: a dispatcher that captured the old
+        # replica list may still count against a replica being removed
         self._c_disp = {e.name: disp.labels(self.name, e.name)
                         for e in self.replicas + self.decode_replicas}
         # per-replica injector site names, formatted once (not per request)
@@ -438,7 +452,8 @@ class EnginePool:
             ("pool",)).labels(self.name)
         self._g_replicas = reg.gauge(
             "dl4j_tpu_pool_replicas",
-            "Replica engines fronted by this pool", ("pool",)).labels(
+            "Replica engines fronted by this pool (tracks "
+            "add_replica/remove_replica membership live)", ("pool",)).labels(
                 self.name)
         self._g_replicas.set(len(self.replicas) + len(self.decode_replicas))
         cache_ev = reg.counter(
@@ -833,6 +848,111 @@ class EnginePool:
         over the pool for the warmed, probationed path)."""
         return self.swap(self.make_servable(model, version=version))
 
+    # ----- replica membership (autoscaling) -----------------------------
+    def add_replica(self, engine=None):
+        """Grow the pool by one replica, safe under concurrent dispatch
+        (membership changes are atomic list reassignments — an in-flight
+        dispatcher keeps the list it captured). ``engine=None`` clones a
+        local :class:`ParallelInference` from the pool's template at the
+        CURRENT model/version; pass a prebuilt engine (e.g. a
+        ``RemoteReplica``) to grow across fabric hosts. Returns the
+        engine. Metric children are wired before the replica becomes
+        dispatchable, so the first dispatch to it can already count."""
+        if self._shutdown or self._draining:
+            raise RuntimeError(f"{self.name} is "
+                               + ("shut down" if self._shutdown
+                                  else "draining"))
+        if engine is None:
+            with self._lock:
+                i = self._replica_seq
+                self._replica_seq += 1
+            engine = ParallelInference(
+                self.model, circuit_breaker=self._breaker_factory(),
+                registry=self.registry, name=f"{self.name}-r{i}",
+                model_version=self.model_version,
+                **self._replica_template)
+        name = engine.name
+        with self._lock:
+            if any(e.name == name
+                   for e in self.replicas + self.decode_replicas):
+                raise ValueError(
+                    f"{self.name}: replica {name!r} already in the pool")
+            if name not in self._c_disp:
+                self._c_disp[name] = self._c_disp_family.labels(self.name,
+                                                                name)
+            self._site_names[name] = f"{DISPATCH_SITE}.{name}"
+            if getattr(engine, "is_remote", False) and not self._has_remote:
+                # first remote replica flips the pool onto the failover
+                # dispatch path: create the fabric series now
+                self._c_failover_family = self.registry.counter(
+                    "dl4j_tpu_fabric_failover_total",
+                    "Requests failed over to another replica after a "
+                    "remote replica became unavailable mid-request "
+                    "(connection error/503; labeled by the replica "
+                    "failed AWAY from)", ("pool", "replica"))
+                for e in self.replicas + self.decode_replicas:
+                    self._failover_children[e.name] = \
+                        self._c_failover_family.labels(self.name, e.name)
+                self._has_remote = True
+            if self._has_remote and self._c_failover_family is not None:
+                self._failover_children[name] = \
+                    self._c_failover_family.labels(self.name, name)
+            if hasattr(engine, "output_async"):
+                self.replicas = self.replicas + [engine]
+            else:
+                self.decode_replicas = self.decode_replicas + [engine]
+            if self._adaptive and hasattr(engine, "_h_forward"):
+                self.batchers = self.batchers + [
+                    AdaptiveBatcher(engine, target_p95_s=self._target_p95_s)]
+            self._g_replicas.set(
+                len(self.replicas) + len(self.decode_replicas))
+        self.registry.log_event("pool_replica_add", pool=self.name,
+                                replica=name,
+                                replicas=len(self.replicas)
+                                + len(self.decode_replicas))
+        return engine
+
+    def remove_replica(self, name: str, *,
+                       drain_timeout: Optional[float] = 30.0):
+        """Shrink the pool: unpublish replica ``name`` (new dispatches
+        stop choosing it immediately), then drain it — in-flight work
+        completes — and shut it down. Dispatchers that captured the old
+        replica list race harmlessly: a submit that loses to the
+        post-drain shutdown raises and falls over to the next candidate.
+        Refuses to remove the last replica of its partition. Returns the
+        removed engine."""
+        with self._lock:
+            part = None
+            for lst_name in ("replicas", "decode_replicas"):
+                lst = getattr(self, lst_name)
+                if any(e.name == name for e in lst):
+                    part = lst_name
+                    break
+            if part is None:
+                raise ValueError(
+                    f"{self.name}: no replica named {name!r}")
+            lst = getattr(self, part)
+            if len(lst) == 1:
+                kind = "decode" if part == "decode_replicas" else "inference"
+                raise ValueError(
+                    f"{self.name}: refusing to remove {name!r} — it is "
+                    f"the last {kind} replica")
+            engine = next(e for e in lst if e.name == name)
+            setattr(self, part, [e for e in lst if e is not engine])
+            self.batchers = [b for b in self.batchers
+                             if b.engine is not engine]
+            self._g_replicas.set(
+                len(self.replicas) + len(self.decode_replicas))
+        if hasattr(engine, "drain"):
+            engine.drain(timeout=drain_timeout)
+        if hasattr(engine, "shutdown"):
+            engine.shutdown(drain=False)
+        self.registry.log_event("pool_replica_remove", pool=self.name,
+                                replica=name,
+                                replicas=len(self.replicas)
+                                + len(self.decode_replicas))
+        return engine
+
     # ----- introspection ------------------------------------------------
     def load_score(self) -> float:
         return float(self._admission.pending)
@@ -840,8 +960,11 @@ class EnginePool:
     def stats(self) -> dict:
         all_replicas = self.replicas + self.decode_replicas
         self._update_imbalance(all_replicas, force=True)
-        dispatched = {name: int(c.value)
-                      for name, c in self._c_disp.items()}
+        # membership views iterate the LIVE replica lists, not the metric
+        # children (which outlive removed replicas by design)
+        live_names = {e.name for e in all_replicas}
+        dispatched = {e.name: int(self._c_disp[e.name].value)
+                      for e in all_replicas}
         adm = self._admission.stats()
         lookups = sum(int(self._c_cache[e].value) for e in ("hit", "miss"))
         hits = int(self._c_cache["hit"].value)
@@ -850,7 +973,8 @@ class EnginePool:
             "replica_count": len(all_replicas),
             "dispatched": dispatched,
             "dispatch_errors": {n: int(c.value)
-                                for n, c in self._disp_err_children.items()},
+                                for n, c in self._disp_err_children.items()
+                                if n in live_names},
             "load_scores": {e.name: e.load_score() for e in all_replicas},
             "load_imbalance": float(self._g_imbalance.value),
             "circuit_state": self.circuit_state.value,
@@ -875,7 +999,8 @@ class EnginePool:
                 "healthy": {e.name: e.circuit_state is CircuitState.CLOSED
                             for e in remotes},
                 "failovers": {n: int(c.value)
-                              for n, c in self._failover_children.items()},
+                              for n, c in self._failover_children.items()
+                              if n in live_names},
             }
         # remote replicas surface their host's speculative counters (the
         # `/stats` `generate.speculative` section, cached by the adapter's

@@ -171,7 +171,8 @@ class JsonModelServer:
                  generator=None,
                  generate_path: str = "/v1/generate",
                  pool=None,
-                 prefill=None) -> None:
+                 prefill=None,
+                 multiplexer=None) -> None:
         if model is not None and pool is not None:
             raise ValueError("pass model= (server-owned engine) or pool= "
                              "(caller-owned EnginePool), not both")
@@ -202,6 +203,13 @@ class JsonModelServer:
         # server routes to them; their lifecycle (deploy/rollback/
         # shutdown) stays with the caller that owns them.
         self._managers: dict = dict(managers or {})
+        # ModelMultiplexer (serving/multiplex.py): models it registers are
+        # served under the same POST /v1/models/<name> route — an explicit
+        # managers= entry wins on name collision. The multiplexer pages
+        # weights in/out under its byte budget; the server threads the
+        # X-Tenant header through so its per-tenant SLO policy applies.
+        # Caller-owned lifecycle, drained on stop like managers=.
+        self._mux = multiplexer
         self._pi = None if model is None else ParallelInference(
             model, inference_mode=InferenceMode.BATCHED,
             batch_limit=batch_limit, workers=workers,
@@ -264,9 +272,15 @@ class JsonModelServer:
                     self._send(200, outer.traces_payload(
                         urlparse(self.path).query))
                 elif self.path == _MODELS_PREFIX:
-                    self._send(200, {"models": {
+                    payload = {"models": {
                         n: m.describe() for n, m in
-                        sorted(outer._managers.items())}})
+                        sorted(outer._managers.items())}}
+                    if outer._mux is not None:
+                        # residency per model (warm|parked|paging) plus
+                        # the budget gauges — the operator view of
+                        # eviction churn
+                        payload["multiplex"] = outer._mux.describe()
+                    self._send(200, payload)
                 elif self.path == "/metrics":
                     body = render_prometheus(outer.registry).encode()
                     self.send_response(200)
@@ -341,6 +355,19 @@ class JsonModelServer:
                 if self.path.startswith(_MODELS_PREFIX + "/"):
                     mname = self.path[len(_MODELS_PREFIX) + 1:]
                     mgr = outer._managers.get(mname)
+                    if mgr is None and outer._mux is not None \
+                            and mname in outer._mux:
+                        # multiplexed model: the pager resolves residency
+                        # (cold miss queues behind the page-in, bounded by
+                        # the tenant's deadline) before the manager submit
+                        tenant = self.headers.get("X-Tenant")
+                        pin = self.headers.get("X-Model-Version")
+                        key = self._request_id
+                        return lambda data, deadline: outer._mux.submit(
+                            mname, data,
+                            tenant=tenant.strip() if tenant else None,
+                            priority=prio, deadline=deadline, version=pin,
+                            key=key)
                     if mgr is None:
                         self._send(404, {"error": f"unknown model {mname!r}"})
                         return None
@@ -717,8 +744,10 @@ class JsonModelServer:
         # a registered manager's engine — dedupe by identity so it is
         # counted once (double-counting inflates X-Load-Score and skews
         # the front pool's dispatch away from this host)
-        targets = [self._pi, self._pool, self._generator, self._prefill]
-        targets.extend(m.engine for m in self._managers.values())
+        targets = [self._pi, self._pool, self._generator, self._prefill,
+                   self._mux]
+        targets.extend(m.engine for m in self._managers.values()
+                       if m.engine is not None)
         score, seen = 0.0, set()
         for e in targets:
             if e is None or id(e) in seen:
@@ -755,12 +784,23 @@ class JsonModelServer:
 
     def add_model(self, name: str, manager) -> "JsonModelServer":
         """Register a :class:`~deeplearning4j_tpu.serving.manager.
-        ModelManager` under ``POST /v1/models/<name>``."""
-        self._managers[name] = manager
+        ModelManager` under ``POST /v1/models/<name>``. Copy-on-write: a
+        handler thread mid-request keeps the mapping it resolved against
+        — registration with in-flight traffic never trips a concurrent
+        iteration (health/stats snapshot the same way)."""
+        managers = dict(self._managers)
+        managers[name] = manager
+        self._managers = managers
         return self
 
     def remove_model(self, name: str) -> None:
-        self._managers.pop(name, None)
+        """Unregister ``name`` (copy-on-write, see :meth:`add_model`).
+        In-flight requests that already resolved the manager complete
+        against it; the caller still owns the manager's lifecycle and
+        drains/shuts it down after removal."""
+        managers = dict(self._managers)
+        managers.pop(name, None)
+        self._managers = managers
 
     def health(self) -> tuple:
         """({"status": ...}, http_code). Truthful: draining while stopping,
@@ -771,8 +811,11 @@ class JsonModelServer:
         is CLOSED while any replica is healthy — one sick replica out of
         N degrades that replica's traffic, not the whole node's health;
         per-replica circuits are itemized in the payload)."""
+        # a parked manager has no engine (weights paged out) — it is not
+        # unhealthy, just cold; residency is itemized per model below
         engines = ([] if self._pi is None else [self._pi]) + \
-            [m.engine for m in self._managers.values()]
+            [m.engine for m in self._managers.values()
+             if m.engine is not None]
         circuits = [e.circuit_state for e in engines]
         queue_depth = sum(e.stats()["queue_depth"] for e in engines)
         payload = {}
@@ -832,9 +875,21 @@ class JsonModelServer:
             payload["circuit"] = self._pi.circuit_state.value
         if self._managers:
             payload["models"] = {
-                n: {"circuit": m.engine.circuit_state.value,
+                n: {"circuit": (m.engine.circuit_state.value
+                                if m.engine is not None else "parked"),
+                    "residency": getattr(m, "residency", "warm"),
                     "live_version": m.live_version}
                 for n, m in sorted(self._managers.items())}
+        if self._mux is not None:
+            d = self._mux.describe()
+            payload["multiplex"] = {
+                "budget_bytes": d["budget_bytes"],
+                "resident_bytes": d["resident_bytes"],
+                "resident_models": d["resident_models"],
+                "registered_models": d["registered_models"],
+                "models": {n: info["residency"]
+                           for n, info in d["models"].items()},
+            }
         return payload, (200 if status == "ok" else 503)
 
     def stats(self) -> dict:
@@ -844,6 +899,8 @@ class JsonModelServer:
         if self._managers:
             s["models"] = {n: m.stats()
                            for n, m in sorted(self._managers.items())}
+        if self._mux is not None:
+            s["multiplex"] = self._mux.stats()
         if self._generator is not None:
             s["generate"] = self._generator.stats()
         if self._prefill is not None:
@@ -871,7 +928,10 @@ class JsonModelServer:
             if self._pool is not None:
                 self._pool.drain(timeout=drain_timeout)
             for m in self._managers.values():
-                m.engine.drain(timeout=drain_timeout)
+                if m.engine is not None:  # parked managers have no engine
+                    m.engine.drain(timeout=drain_timeout)
+            if self._mux is not None:
+                self._mux.drain(timeout=drain_timeout)
             if self._generator is not None:
                 self._generator.drain(timeout=drain_timeout)
         self._httpd.shutdown()

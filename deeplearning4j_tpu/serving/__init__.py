@@ -12,6 +12,11 @@ The production-serving subsystem between training and the HTTP edge
   open), plus canary/shadow rollout on a second engine.
 * :class:`~.router.ModelRouter` — deterministic hash-split canary
   routing and fail-open shadow mirroring.
+* :class:`~.multiplex.ModelMultiplexer` — multi-tenant weight paging:
+  N registered models behind one submit surface on a fixed byte budget
+  (LRU + request-rate-EWMA eviction via ``ModelManager.park()``,
+  per-tenant SLO admission, bounded cold-start page-in queueing) plus
+  :class:`~.multiplex.PoolAutoscaler` for load-driven replica counts.
 
 ``remote/JsonModelServer`` exposes managed models over HTTP
 (``GET /v1/models``, ``POST /v1/models/<name>``, ``X-Model-Version``
@@ -26,7 +31,14 @@ from .disagg import (
     deserialize_handoff,
     serialize_handoff,
 )
-from .manager import LOAD_SITE, WARMUP_SITE, ModelManager, SwapError
+from .manager import (
+    LOAD_SITE,
+    WARMUP_SITE,
+    ModelManager,
+    ModelParkedError,
+    SwapError,
+)
+from .multiplex import ModelMultiplexer, PoolAutoscaler, model_bytes
 from .router import ModelRouter
 from .store import (
     LATEST,
@@ -44,14 +56,18 @@ __all__ = [
     "ChecksumMismatchError",
     "DisaggCoordinator",
     "ModelManager",
+    "ModelMultiplexer",
+    "ModelParkedError",
     "ModelRouter",
     "ModelStore",
     "ModelStoreError",
     "ModelVersion",
     "PartialHandoffError",
+    "PoolAutoscaler",
     "PrefillEngine",
     "SwapError",
     "VersionNotFoundError",
     "deserialize_handoff",
+    "model_bytes",
     "serialize_handoff",
 ]

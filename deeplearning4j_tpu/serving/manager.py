@@ -75,6 +75,13 @@ class SwapError(RuntimeError):
     """A deploy/rollback could not complete; the prior version is live."""
 
 
+class ModelParkedError(RuntimeError):
+    """The model's device weights are paged out (``park()``); a request
+    must page the model back in (``unpark()``) before it can serve. The
+    multiplexing layer treats this as a cold-start miss and queues the
+    page-in instead of failing the request."""
+
+
 class _Deployment:
     """A resident version: servable + the breaker that judged it + the
     rewrite pipeline it was loaded under (so a canary promotion replays
@@ -166,6 +173,10 @@ class ModelManager:
         self._canary: Optional[_Deployment] = None
         self._canary_engine: Optional[ParallelInference] = None
         self._router: Optional[ModelRouter] = None
+        # park()/unpark(): non-None while the device weights are paged
+        # out — holds exactly the pipeline state a page-in must replay
+        self._parked: Optional[Dict] = None
+        self._owns_engine = engine is None
 
         if engine is not None:
             self.engine = engine
@@ -284,10 +295,16 @@ class ModelManager:
     # ----- deploy / rollback ------------------------------------------
     @property
     def live_version(self) -> str:
+        parked = self._parked
+        if parked is not None:
+            return parked["version"]
         return self._live.version
 
     @property
     def previous_version(self) -> Optional[str]:
+        parked = self._parked
+        if parked is not None:
+            return parked["previous_version"]
         return self._previous.version if self._previous else None
 
     @property
@@ -309,6 +326,20 @@ class ModelManager:
         with self._lock:
             opt = self._resolve_optimize(optimize)
             entry = self.store.resolve(self.model_name, version)
+            if self._parked is not None:
+                # deploy-while-parked retargets the page-in: the next
+                # unpark loads this version under this pipeline. No
+                # load/warm happens now — a cold model costs nothing
+                # until traffic actually pages it in (fleet-wide deploy
+                # fan-outs stay cheap across mostly-cold hosts).
+                self._parked["version"] = str(entry.version)
+                self._parked["optimize"] = opt
+                self._parked["previous_version"] = None
+                self._parked["canary"] = None
+                self.registry.log_event(
+                    "model_parked_deploy", model=self.model_name,
+                    version=str(entry.version))
+                return entry
             if (str(entry.version) == self._live.version
                     and opt == self._live.optimize):
                 return entry
@@ -388,6 +419,9 @@ class ModelManager:
     def rollback(self) -> ModelVersion:
         """Manually swap back to the previously live version."""
         with self._lock:
+            if self._parked is not None:
+                raise ModelParkedError(
+                    f"{self.model_name} is parked; unpark before rollback")
             if self._previous is None:
                 raise SwapError(f"{self.model_name}: no previous version "
                                 f"resident to roll back to")
@@ -438,6 +472,9 @@ class ModelManager:
         :meth:`promote_canary` replays the same pipeline on the live
         engine (rollback stays free: the incumbent servable is resident)."""
         with self._lock:
+            if self._parked is not None:
+                raise ModelParkedError(
+                    f"{self.model_name} is parked; unpark before canary")
             if self._canary is not None:
                 raise SwapError(f"{self.model_name}: canary v"
                                 f"{self._canary.version} already running")
@@ -539,6 +576,150 @@ class ModelManager:
         engine.shutdown(drain=True, drain_timeout=10.0)
         self._c_swap["canary_stopped"].inc()
 
+    # ----- weight paging (park / unpark) ------------------------------
+    @property
+    def parked(self) -> bool:
+        return self._parked is not None
+
+    @property
+    def residency(self) -> str:
+        """``"warm"`` or ``"parked"`` — the multiplexing layer overlays
+        the transient ``"paging"`` state while a page-in is running."""
+        return "parked" if self._parked is not None else "warm"
+
+    def resident_bytes(self) -> int:
+        """Device-weight bytes this manager keeps resident: every param/
+        state leaf of the live, rollback and canary servables (deduped —
+        rollback and live can share nothing, but a servable without a
+        local model, e.g. remote-backed, contributes 0). Parked → 0."""
+        import jax
+
+        with self._lock:
+            if self._parked is not None:
+                return 0
+            total = 0
+            for dep in (self._live, self._previous, self._canary):
+                model = getattr(dep.servable, "model", None) \
+                    if dep is not None else None
+                if model is None:
+                    continue
+                leaves = jax.tree_util.tree_leaves(
+                    (getattr(model, "params", None),
+                     getattr(model, "state", None)))
+                total += sum(int(leaf.size) * leaf.dtype.itemsize
+                             for leaf in leaves if hasattr(leaf, "dtype"))
+            return total
+
+    def park(self, *, drain_timeout: Optional[float] = 30.0) -> bool:
+        """Page the model out: drain + shut down the engine and drop
+        every resident servable (the device weights), keeping only the
+        pipeline state a page-in needs — live version id + its rewrite
+        pipeline, the rollback target's id, and a running canary's spec
+        (version/weight/shadow/pipeline) so :meth:`unpark` replays the
+        exact deployment, quantization included. Store artifacts are
+        untouched and :meth:`resident_versions` keeps counting the
+        parked versions, so GC can never collect what a page-in needs.
+        Idempotent: returns False when already parked."""
+        with self._lock:
+            if self._parked is not None:
+                return False
+            if not self._owns_engine:
+                raise SwapError(
+                    f"{self.model_name}: cannot park a caller-owned "
+                    f"engine (pass model=/version= so the manager owns "
+                    f"the engine lifecycle)")
+            if self._live.entry is None:
+                raise SwapError(
+                    f"{self.model_name}: live version is not backed by a "
+                    f"store artifact; a page-in could not replay it")
+            canary_spec = None
+            if self._canary is not None:
+                canary_spec = {
+                    "version": self._canary.entry.version
+                    if self._canary.entry is not None
+                    else self._canary.version,
+                    "weight": self._router.canary_weight
+                    if self._router is not None else 0.0,
+                    "shadow": bool(self._router is not None
+                                   and self._router.shadow is not None),
+                    "optimize": self._canary.optimize,
+                }
+                self._stop_canary_locked()
+            state = {
+                "version": self._live.version,
+                "optimize": self._live.optimize,
+                "previous_version": self.previous_version,
+                "canary": canary_spec,
+                "warm_shape": self.engine.last_input_shape,
+            }
+            engine = self.engine
+            # flip first: submits refuse (ModelParkedError) while the
+            # engine drains, so no request can race the teardown
+            self._parked = state
+        engine.shutdown(drain=True, drain_timeout=drain_timeout)
+        with self._lock:
+            self.engine = None
+            self._live = None
+            self._previous = None
+            self._probation_until = 0.0
+        self.registry.log_event("model_park", model=self.model_name,
+                                version=state["version"])
+        return True
+
+    def unpark(self) -> ModelVersion:
+        """Page the model back in by replaying the recorded deployment:
+        load + checksum-verify the parked version from the store, apply
+        the same rewrite pipeline (a quantized deploy pages back in
+        quantized — byte-identical weights, since the rewrite is a
+        deterministic function of the immutable artifact), rebuild the
+        engine, warm on the shapes served before the park, and restart a
+        recorded canary. On failure the manager STAYS parked (the next
+        request retries the page-in). Idempotent when already warm."""
+        with self._lock:
+            if self._parked is None:
+                return self.store.resolve(self.model_name,
+                                          self._live.version)
+            state = self._parked
+            model, entry = self._load(state["version"],
+                                      optimize=state["optimize"])
+            breaker = self._breaker_factory()
+            engine = ParallelInference(
+                model, circuit_breaker=breaker, registry=self.registry,
+                name=f"{self.model_name}-live",
+                model_version=str(entry.version), **self._engine_opts)
+            if state["warm_shape"] is not None:
+                engine.last_input_shape = tuple(state["warm_shape"])
+            old_engine, self.engine = self.engine, engine
+            try:
+                self._warm(engine._servable, engine)
+            except Exception as e:
+                self.engine = old_engine
+                engine.shutdown(drain=False)
+                self._c_swap["warmup_failed"].inc()
+                raise SwapError(
+                    f"{self.model_name} v{entry.version}: page-in warmup "
+                    f"failed; staying parked: {e}") from e
+            self._live = _Deployment(entry, engine._servable, breaker,
+                                     optimize=state["optimize"])
+            self._previous = None
+            self._parked = None
+            self._set_live_gauge()
+            self._set_quantized_gauge()
+            self.registry.log_event("model_unpark", model=self.model_name,
+                                    version=str(entry.version))
+            canary = state.get("canary")
+            if canary is not None:
+                try:
+                    self.start_canary(canary["version"],
+                                      weight=canary["weight"],
+                                      shadow=canary["shadow"],
+                                      optimize=canary["optimize"])
+                except Exception as e:  # canary restore is best-effort
+                    self.registry.log_event(
+                        "canary_restore_failed", model=self.model_name,
+                        version=str(canary["version"]), error=str(e))
+            return entry
+
     # ----- request path -----------------------------------------------
     def submit(self, x, *, key: Optional[str] = None,
                version: Optional[Union[int, str]] = None,
@@ -549,6 +730,9 @@ class ModelManager:
         the canary) — pinning is how a client deterministically hits the
         canary or asserts which version answered. ``priority`` names an
         admission priority class (HTTP ``X-Priority``)."""
+        if self._parked is not None:
+            raise ModelParkedError(
+                f"{self.model_name} is parked (weights paged out)")
         if version is not None:
             want = str(version).lstrip("v")
             if want == self._live.version:
@@ -587,6 +771,15 @@ class ModelManager:
         from ..nn.rewrite import count_quantized_layers
 
         with self._lock:
+            if self._parked is not None:
+                return {
+                    "name": self.model_name,
+                    "residency": "parked",
+                    "live_version": self._parked["version"],
+                    "previous_version": self._parked["previous_version"],
+                    "parked_canary": self._parked["canary"],
+                    "optimize": self._parked["optimize"],
+                }
             canary = None
             if self._canary is not None:
                 canary = {
@@ -601,6 +794,7 @@ class ModelManager:
             return {
                 "quantized_layers": count_quantized_layers(live_model),
                 "name": self.model_name,
+                "residency": "warm",
                 "live_version": self._live.version,
                 "previous_version": self.previous_version,
                 "canary": canary,
@@ -611,9 +805,19 @@ class ModelManager:
 
     def resident_versions(self):
         """Version ids that must survive GC (live, rollback target,
-        canary)."""
+        canary) — INCLUDING while parked: a paged-out model's versions
+        are exactly the artifacts the next page-in loads, so GC deleting
+        them would turn every future cold-start into a 404."""
         out = set()
         with self._lock:
+            if self._parked is not None:
+                canary = self._parked["canary"]
+                for v in (self._parked["version"],
+                          self._parked["previous_version"],
+                          str(canary["version"]) if canary else None):
+                    if v is not None and str(v).isdigit():
+                        out.add(int(v))
+                return out
             for dep in (self._live, self._previous, self._canary):
                 if dep is not None and dep.version.isdigit():
                     out.add(int(dep.version))
@@ -625,7 +829,11 @@ class ModelManager:
                              in_use=self.resident_versions())
 
     def stats(self) -> Dict:
+        if self._parked is not None:
+            return {"residency": "parked",
+                    "live_version": self.live_version}
         s = self.engine.stats()
+        s["residency"] = "warm"
         if self._canary_engine is not None:
             s["canary"] = self._canary_engine.stats()
         return s
@@ -635,4 +843,6 @@ class ModelManager:
         with self._lock:
             if self._canary is not None:
                 self._stop_canary_locked()
-        self.engine.shutdown(drain=drain, drain_timeout=drain_timeout)
+            engine = self.engine
+        if engine is not None:  # parked: nothing resident to tear down
+            engine.shutdown(drain=drain, drain_timeout=drain_timeout)

@@ -49,6 +49,11 @@ def test_select_rows_filters_exactly():
     assert sel == {"moe_dispatch": "moe_dispatch"}
     assert "moe_dispatch" in bench._EXTRA_ROWS
     assert "moe_dispatch" not in bench._CHIP_ONLY_ROWS
+    # ISSUE 19: the multiplexing row (>= 2x models-served at equal byte
+    # budget) is a standalone CPU CI entry point
+    sel = bench.select_rows("model_multiplex")
+    assert sel == {"model_multiplex": "model_multiplex"}
+    assert "model_multiplex" not in bench._CHIP_ONLY_ROWS
     # every selectable row maps to a registered measurement
     for row, meas in {**bench._EXTRA_ROWS, **bench._CHIP_ONLY_ROWS}.items():
         assert meas in bench._MEASUREMENTS, (row, meas)
@@ -108,6 +113,7 @@ def test_cli_list_rows_and_unknown_row_exit():
     assert "elastic_goodput" in listing["rows"]
     assert "paged_kv_occupancy" in listing["rows"]
     assert "disagg_handoff" in listing["rows"]
+    assert "model_multiplex" in listing["rows"]
     # an unknown row fails fast (exit 2, error names the row) BEFORE any
     # probe/measurement work
     bad = subprocess.run([sys.executable, _BENCH, "--rows", "nope"],

@@ -376,3 +376,35 @@ def test_gc_never_collects_running_canary(store):
         assert [v.version for v in store.versions("m")] == [5]
     finally:
         mgr.shutdown(drain=False)
+
+
+def test_gc_never_collects_parked_versions(store):
+    """ISSUE 19 satellite regression: a PARKED manager (weights paged
+    out by the multiplexer) keeps reporting its live/previous/canary
+    versions in ``resident_versions()``, so GC can never delete the
+    artifact a later page-in needs — the paged-out analogue of the
+    canary protection above."""
+    for seed in (3, 4, 5):
+        store.publish("m", _model(seed))  # now v1..v5
+    mgr = ModelManager(store, "m", version=4, registry=MetricsRegistry(),
+                       batch_limit=4, probation_seconds=3600.0)
+    x = np.ones((1, 4), np.float32)
+    try:
+        before = np.asarray(mgr.output(x))
+        mgr.deploy(5)           # live=5, previous=4
+        mgr.start_canary(2, weight=0.5)
+        assert mgr.resident_versions() == {2, 4, 5}
+        mgr.park()
+        # paged out, but the page-in still needs all three artifacts
+        assert mgr.resident_versions() == {2, 4, 5}
+        removed = mgr.gc(keep_last=1)
+        assert removed == {"m": [1, 3]}
+        assert [v.version for v in store.versions("m")] == [2, 4, 5]
+        # and the page-in actually works off the protected artifacts —
+        # live version, canary spec and all
+        mgr.unpark()
+        assert mgr.live_version == "5"
+        assert mgr.canary_version == "2"
+        assert np.asarray(mgr.output(x)).shape == before.shape
+    finally:
+        mgr.shutdown(drain=False)

@@ -1,0 +1,394 @@
+"""ModelMultiplexer residency accounting and paging semantics
+(serving/multiplex.py, ISSUE 19): byte-budget math against the model's
+actual leaf bytes (quantized deploys resident at their int8 size), LRU
+eviction with the request-rate EWMA as tie-break, park/unpark
+idempotence, byte-identical quantized page-in replay, bounded
+cold-start queueing, and the server's register/unregister race with
+in-flight traffic. All CPU, fake clocks where ordering matters."""
+
+import json
+import threading
+import urllib.request
+from urllib.error import HTTPError
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.core.resilience import AdmissionRejectedError, Deadline
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.obs import MetricsRegistry
+from deeplearning4j_tpu.serving import (
+    ModelManager,
+    ModelMultiplexer,
+    ModelParkedError,
+    ModelStore,
+    model_bytes,
+)
+
+X = np.linspace(-1.0, 1.0, 4, dtype=np.float32).reshape(1, 4)
+
+
+def _model(seed=1):
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = ModelStore(str(tmp_path / "registry"))
+    for i in range(4):
+        s.publish(f"m{i}", _model(i + 1))
+    return s
+
+
+def _mux(store, budget, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("manager_defaults",
+                  dict(workers=1, batch_limit=4, probation_seconds=0.0,
+                       warmup_example=X))
+    return ModelMultiplexer(store, budget_bytes=budget, **kw)
+
+
+# ----- byte accounting -------------------------------------------------
+def test_model_bytes_is_leaf_bytes_and_quantized_is_smaller(store):
+    """The budget's unit of account: size × itemsize over every
+    params/state leaf — the cache_bytes arithmetic applied to weights —
+    and an int8 rewrite pages in smaller than its f32 source."""
+    import jax
+
+    model, _ = store.load("m0", 1)
+    expect = sum(int(l.size) * l.dtype.itemsize for l in
+                 jax.tree_util.tree_leaves((model.params, model.state)))
+    assert model_bytes(model) == expect > 0
+
+    from deeplearning4j_tpu.nn.rewrite import rewrite_model
+
+    q, applied = rewrite_model(model, "inference:int8",
+                               context="inference")
+    assert any(p.startswith("quantize_weights_") for p in applied)
+    assert model_bytes(q) < model_bytes(model)
+
+
+def test_resident_bytes_tracks_manager_measurements(store):
+    mux = _mux(store, 10**9)
+    try:
+        mux.register("m0")
+        mux.register("m1", optimize="inference:int8")
+        assert mux.resident_bytes() == 0  # nothing loaded at register
+        m0 = mux.ensure_resident("m0")
+        assert mux.resident_bytes() == m0.resident_bytes() > 0
+        f32_total = mux.resident_bytes()
+        m1 = mux.ensure_resident("m1")
+        assert mux.resident_bytes() == \
+            m0.resident_bytes() + m1.resident_bytes()
+        # the quantized model's residency cost is its int8 size
+        assert m1.resident_bytes() < m0.resident_bytes()
+        assert mux.describe()["models"]["m1"]["bytes"] == \
+            m1.resident_bytes()
+        mux.park("m0")
+        assert mux.resident_bytes() == m1.resident_bytes()  # warm only
+        assert mux.describe()["models"]["m0"]["bytes"] == 0
+        assert f32_total > m1.resident_bytes()
+    finally:
+        mux.shutdown(drain=False)
+
+
+def test_budget_enforced_and_single_model_overcommit_serves(store):
+    """Eviction keeps resident bytes under budget; a budget too small
+    for even ONE model overcommits (logged) instead of refusing."""
+    probe = _mux(store, 10**9)
+    probe.register("m0")
+    probe.ensure_resident("m0")
+    per = probe.resident_bytes()
+    probe.shutdown(drain=False)
+
+    mux = _mux(store, int(per * 1.5))  # room for exactly one
+    try:
+        for i in range(3):
+            mux.register(f"m{i}")
+        for i in range(3):
+            np.asarray(mux.output(f"m{i}", X))
+            assert mux.resident_bytes() <= int(per * 1.5)
+        assert mux.describe()["resident_models"] == 1
+    finally:
+        mux.shutdown(drain=False)
+
+    tiny = _mux(store, max(1, per // 2))  # smaller than any model
+    try:
+        tiny.register("m0")
+        out = np.asarray(tiny.output("m0", X))  # still serves
+        assert out.shape == (1, 3)
+        assert tiny.resident_bytes() > tiny.budget_bytes  # overcommitted
+    finally:
+        tiny.shutdown(drain=False)
+
+
+# ----- eviction policy -------------------------------------------------
+def test_eviction_is_lru_with_ewma_tiebreak(store):
+    clk = [100.0]
+    mux = _mux(store, 10**9, clock=lambda: clk[0])
+    try:
+        for i in range(4):
+            mux.register(f"m{i}")
+        # warm m0..m2 at distinct times: m0 oldest
+        for i, t in ((0, 100.0), (1, 200.0), (2, 300.0)):
+            clk[0] = t
+            mux.output(f"m{i}", X)
+        per = mux.resident_bytes() // 3
+        mux.budget_bytes = per * 3 + per // 2  # room for 3, not 4
+        # pin m3's page-in estimate to its true resident size (a
+        # never-loaded model estimates from the store artifact, which is
+        # larger and would over-evict — correct, but not what this test
+        # pins down)
+        mux._slots["m3"].bytes = per
+        clk[0] = 400.0
+        mux.output("m3", X)  # forces one eviction
+        assert mux.state("m0") == "parked", "LRU victim must be m0"
+        assert all(mux.state(m) == "warm" for m in ("m1", "m2", "m3"))
+
+        # tie on last_used -> lower request-rate EWMA loses
+        clk[0] = 500.0
+        mux.output("m0", X)  # m0 back in; someone else was evicted
+        warm = [m for m in mux.models() if mux.state(m) == "warm"]
+        clk[0] = 600.0
+        for m in warm:  # equalize recency across all warm models
+            mux._slots[m].last_used = 600.0
+        others = [m for m in warm if m != "m0"]
+        mux._slots["m0"].ewma = 0.001  # coldest trend
+        for m in others:
+            mux._slots[m].ewma = 5.0
+        cold = next(m for m in mux.models() if mux.state(m) == "parked")
+        mux.ensure_resident(cold)
+        assert mux.state("m0") == "parked", \
+            "EWMA tie-break must evict the coldest trend"
+        assert all(mux.state(m) == "warm" for m in others)
+    finally:
+        mux.shutdown(drain=False)
+
+
+def test_prefetch_fills_headroom_by_ewma_without_evicting(store):
+    mux = _mux(store, 10**9)
+    try:
+        for i in range(3):
+            mux.register(f"m{i}")
+        mux.output("m0", X)
+        per = mux.resident_bytes()
+        mux.budget_bytes = per * 2 + per // 2  # headroom for ONE more
+        # pin estimates to true resident size (see the LRU test)
+        mux._slots["m1"].bytes = mux._slots["m2"].bytes = per
+        mux._slots["m1"].ewma = 1.0
+        mux._slots["m2"].ewma = 9.0  # hottest parked trend
+        fetched = mux.prefetch(limit=2)
+        assert fetched == ["m2"], fetched  # m1 would need an eviction
+        assert mux.state("m2") == "warm"
+        assert mux.state("m0") == "warm", "prefetch must never evict"
+        assert mux.state("m1") == "parked"
+    finally:
+        mux.shutdown(drain=False)
+
+
+# ----- park / unpark ---------------------------------------------------
+def test_manager_park_unpark_idempotent_and_exact_replay(store):
+    reg = MetricsRegistry()
+    mgr = ModelManager(store, "m0", registry=reg, workers=1,
+                       batch_limit=4, probation_seconds=0.0)
+    try:
+        before = np.asarray(mgr.output(X))
+        assert mgr.park() is True
+        assert mgr.park() is False  # idempotent
+        assert mgr.parked and mgr.engine is None
+        with pytest.raises(ModelParkedError):
+            mgr.submit(X)
+        entry = mgr.unpark()
+        assert str(entry.version) == mgr.live_version
+        assert mgr.unpark().version == entry.version  # idempotent
+        assert np.array_equal(np.asarray(mgr.output(X)), before)
+    finally:
+        mgr.shutdown(drain=False)
+
+
+def test_unpark_replays_quantized_deploy_byte_identically(store):
+    reg = MetricsRegistry()
+    mgr = ModelManager(store, "m0", registry=reg, workers=1,
+                       batch_limit=4, probation_seconds=0.0,
+                       optimize="inference:int8")
+    try:
+        from deeplearning4j_tpu.nn.rewrite import count_quantized_layers
+
+        before = np.asarray(mgr.output(X))
+        assert count_quantized_layers(mgr.engine.model) > 0
+        qbytes = mgr.resident_bytes()
+        mgr.park()
+        assert mgr.resident_bytes() == 0
+        mgr.unpark()
+        assert count_quantized_layers(mgr.engine.model) > 0, \
+            "page-in must replay the int8 rewrite pipeline"
+        assert mgr.resident_bytes() == qbytes
+        assert np.array_equal(np.asarray(mgr.output(X)), before), \
+            "quantized unpark must serve the exact pre-park outputs"
+    finally:
+        mgr.shutdown(drain=False)
+
+
+def test_coldstart_queues_and_bounded_deadline_sheds(store):
+    """A miss on a cold model queues behind the page-in; a queued waiter
+    whose deadline exhausts sheds with AdmissionRejectedError (503 +
+    Retry-After at the HTTP edge), never a silent hang."""
+    reg = MetricsRegistry()
+    mux = _mux(store, 10**9, registry=reg)
+    try:
+        mux.register("m0")
+        fut, _ = mux.submit("m0", X)  # cold miss pages in, then serves
+        assert np.asarray(fut.result(timeout=60)).shape == (1, 3)
+        c = reg.get("dl4j_tpu_serving_coldstart_misses_total")
+        assert c.labels("mux", "m0").value == 1.0
+        h = reg.get("dl4j_tpu_serving_pagein_seconds")
+        assert h.labels("mux").count == 1
+
+        # a waiter behind a stuck page-in gives up at its deadline
+        mux._slots["m0"].state = "paging"  # simulate a wedged pager
+        with pytest.raises(AdmissionRejectedError):
+            mux.ensure_resident(
+                "m0", deadline=Deadline.after(0.2, clock=mux._clock))
+        mux._slots["m0"].state = "warm"
+    finally:
+        mux.shutdown(drain=False)
+
+
+def test_eviction_mid_flight_completes_and_resubmits(store):
+    """A model evicted between residency check and engine submit costs a
+    retry, never a lost request: park drains first, and submit() pages
+    the model back in transparently."""
+    mux = _mux(store, 10**9)
+    try:
+        mux.register("m0")
+        before = np.asarray(mux.output("m0", X))
+        stop = threading.Event()
+        errors, served = [], [0]
+
+        def client():
+            while not stop.is_set():
+                try:
+                    out = np.asarray(mux.output("m0", X, timeout=30.0))
+                    # tolerance, not bytes: concurrent clients batch
+                    # together and the padded batch forward is not
+                    # bit-identical to a single-row one (exact replay is
+                    # pinned by the single-request park/unpark tests)
+                    assert np.allclose(out, before, atol=1e-4)
+                    served[0] += 1
+                except Exception as e:
+                    errors.append(e)
+
+        threads = [threading.Thread(target=client) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for _ in range(3):  # evict under fire
+            mux.park("m0")
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:3]
+        assert served[0] > 0
+    finally:
+        mux.shutdown(drain=False)
+
+
+# ----- server integration ---------------------------------------------
+def _post(port, path, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        json.dumps({"data": X.tolist()}).encode(),
+        {"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_server_reports_residency_and_tenant_header(store):
+    from deeplearning4j_tpu.remote.server import JsonModelServer
+
+    reg = MetricsRegistry()
+    mux = _mux(store, 10**9, registry=reg,
+               tenants={"gold": {"priority": "high",
+                                 "pagein_deadline_s": 30.0}},
+               priorities={"high": 1.0, "low": 0.5})
+    mux.register("m0")
+    mux.register("m1")
+    srv = JsonModelServer(registry=reg, multiplexer=mux,
+                          name="mux-srv").start()
+    try:
+        code, body = _post(srv.port, "/v1/models/m0",
+                           {"X-Tenant": "gold"})
+        assert code == 200 and "output" in body
+        mux.park("m1")  # never served: stays parked
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/health", timeout=15) as r:
+            h = json.loads(r.read())
+        assert h["multiplex"]["models"] == {"m0": "warm", "m1": "parked"}
+        assert h["multiplex"]["budget_bytes"] == mux.budget_bytes
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/v1/models",
+                timeout=15) as r:
+            m = json.loads(r.read())
+        assert m["multiplex"]["models"]["m0"]["residency"] == "warm"
+        t = reg.get("dl4j_tpu_serving_tenant_requests_total")
+        assert t.labels("mux", "gold").value == 1.0
+        with pytest.raises(HTTPError) as ei:
+            _post(srv.port, "/v1/models/nope")
+        assert ei.value.code == 404
+    finally:
+        srv.stop(drain=False)
+        mux.shutdown(drain=False)
+
+
+def test_register_unregister_race_with_inflight_traffic(store):
+    """ISSUE 19 satellite: add_model/remove_model are copy-on-write, so
+    churning registrations while handler threads serve and scrape
+    health/stats never drops a request or trips concurrent mutation."""
+    from deeplearning4j_tpu.remote.server import JsonModelServer
+
+    reg = MetricsRegistry()
+    mgr = ModelManager(store, "m0", registry=reg, workers=1,
+                       batch_limit=4, probation_seconds=0.0)
+    extra = ModelManager(store, "m1", registry=reg, workers=1,
+                         batch_limit=4, probation_seconds=0.0)
+    srv = JsonModelServer(registry=reg, managers={"m0": mgr},
+                          name="race-srv").start()
+    stop = threading.Event()
+    errors = []
+    try:
+        def client():
+            while not stop.is_set():
+                try:
+                    code, _ = _post(srv.port, "/v1/models/m0")
+                    assert code == 200
+                except Exception as e:
+                    errors.append(e)
+
+        def scraper():
+            while not stop.is_set():
+                try:
+                    srv.health()
+                    srv.stats()
+                except Exception as e:
+                    errors.append(e)
+
+        threads = [threading.Thread(target=client) for _ in range(2)]
+        threads.append(threading.Thread(target=scraper))
+        for t in threads:
+            t.start()
+        for _ in range(50):  # churn registrations under fire
+            srv.add_model("m1", extra)
+            srv.remove_model("m1")
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:3]
+    finally:
+        stop.set()
+        srv.stop(drain=False)
+        mgr.shutdown(drain=False)
+        extra.shutdown(drain=False)

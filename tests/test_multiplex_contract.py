@@ -1,0 +1,22 @@
+"""Tier-1 wiring for tools/check_multiplex_contract.py: the multi-tenant
+multiplexing chaos contract (README.md "Multi-tenant multiplexing") —
+8 models behind one server on a budget sized for ~4 warm over real
+HTTP, hot tenants in-SLO during cold-tenant page-in churn, zero
+requests lost to eviction, byte-identical unpark replay (quantized
+included), kill-during-page-in recovery — is enforced on every test
+run, not just when someone remembers to run the tool. Honors
+``DL4J_CHAOS_SEED`` like every chaos harness."""
+
+import os
+import sys
+
+_TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+
+
+def test_multiplex_contract_smoke():
+    sys.path.insert(0, _TOOLS)
+    try:
+        import check_multiplex_contract
+    finally:
+        sys.path.remove(_TOOLS)
+    assert check_multiplex_contract.main(log=lambda m: None) == 0

@@ -1,0 +1,184 @@
+"""EnginePool dynamic membership + PoolAutoscaler (ISSUE 19):
+add_replica/remove_replica are drain-safe under concurrent dispatch,
+the replica gauge and stats() track membership live (the PR-19 fix for
+the construction-time-only gauge), removal refuses to empty a
+partition, and the autoscaler grows/shrinks on load-score EWMA trends
+with cooldown. All CPU, fake clocks for the controller."""
+
+import threading
+import types
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.obs import MetricsRegistry
+from deeplearning4j_tpu.parallel.pool import EnginePool
+from deeplearning4j_tpu.serving import PoolAutoscaler
+
+X = np.linspace(-1.0, 1.0, 4, dtype=np.float32).reshape(1, 4)
+
+
+def _model(seed=1):
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=3))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _pool(reg, **kw):
+    kw.setdefault("replicas", 2)
+    kw.setdefault("workers", 1)
+    kw.setdefault("batch_limit", 4)
+    return EnginePool(model=_model(), registry=reg, name="dyn", **kw)
+
+
+def _fake_load(engine, value):
+    engine._fake_load = value
+    engine.load_score = types.MethodType(
+        lambda self: getattr(self, "_fake_load", 0.0), engine)
+
+
+def test_membership_changes_update_gauge_and_stats_live():
+    reg = MetricsRegistry()
+    pool = _pool(reg)
+    g = reg.get("dl4j_tpu_pool_replicas").labels("dyn")
+    try:
+        assert g.value == 2.0
+        added = pool.add_replica()
+        assert added.name == "dyn-r2"
+        assert added.model_version == pool.model_version
+        assert g.value == 3.0
+        pool.output(X)  # dispatchable immediately
+        removed = pool.remove_replica("dyn-r0", drain_timeout=10.0)
+        assert removed.name == "dyn-r0"
+        assert g.value == 2.0
+        s = pool.stats()
+        assert s["replica_count"] == 2
+        # live-membership views: the removed replica drops out of every
+        # block even though its counter children survive
+        assert set(s["dispatched"]) == {"dyn-r1", "dyn-r2"}
+        assert set(s["load_scores"]) == {"dyn-r1", "dyn-r2"}
+        assert "dyn-r0" not in s["dispatch_errors"]
+        # duplicate names are refused
+        with pytest.raises(ValueError, match="already in the pool"):
+            pool.add_replica(pool.replicas[0])
+    finally:
+        pool.shutdown(drain=False)
+
+
+def test_remove_refuses_last_replica_and_unknown_name():
+    reg = MetricsRegistry()
+    pool = _pool(reg, replicas=1)
+    try:
+        with pytest.raises(ValueError, match="last inference replica"):
+            pool.remove_replica("dyn-r0")
+        with pytest.raises(ValueError, match="no replica named"):
+            pool.remove_replica("ghost")
+    finally:
+        pool.shutdown(drain=False)
+
+
+def test_membership_churn_under_concurrent_dispatch_loses_nothing():
+    """The drain-safety criterion: clients hammer the pool while
+    replicas are added and removed; every request succeeds (a dispatch
+    racing a removal falls over to the next candidate)."""
+    reg = MetricsRegistry()
+    pool = _pool(reg)
+    stop = threading.Event()
+    errors, served = [], [0]
+    try:
+        def client():
+            while not stop.is_set():
+                try:
+                    pool.output(X, timeout=30.0)
+                    served[0] += 1
+                except Exception as e:
+                    errors.append(e)
+
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for _ in range(3):  # churn membership under fire
+            e = pool.add_replica()
+            pool.remove_replica(e.name, drain_timeout=10.0)
+        victim = pool.replicas[0].name
+        pool.add_replica()
+        pool.remove_replica(victim, drain_timeout=10.0)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:3]
+        assert served[0] > 0
+    finally:
+        pool.shutdown(drain=False)
+
+
+def test_added_replica_serves_current_version_after_swap():
+    reg = MetricsRegistry()
+    pool = _pool(reg)
+    try:
+        pool.swap_model(_model(9), version="7")
+        added = pool.add_replica()
+        assert added.model_version == "7"
+        # pool-wide swap still validates against the LIVE count
+        sv = pool.make_servable(_model(3), version="8")
+        pool.swap(sv)
+        assert all(e.model_version == "8" for e in pool.replicas)
+    finally:
+        pool.shutdown(drain=False)
+
+
+def test_autoscaler_grows_shrinks_with_cooldown_and_counters():
+    clk = [0.0]
+    reg = MetricsRegistry()
+    pool = _pool(reg)
+    sc = PoolAutoscaler(pool, min_replicas=1, max_replicas=3,
+                        high_load=1.0, low_load=0.2, halflife_s=0.001,
+                        cooldown_s=5.0, clock=lambda: clk[0],
+                        registry=reg)
+    try:
+        for e in pool.replicas:
+            _fake_load(e, 4.0)
+        clk[0] = 10.0
+        obs = sc.tick()
+        assert obs["action"] == "grow" and len(pool.replicas) == 3
+        clk[0] = 12.0  # inside cooldown: no thrash
+        for e in pool.replicas:
+            _fake_load(e, 4.0)
+        assert sc.tick()["action"] == "cooldown"
+        clk[0] = 16.0  # at max: hold even though hot
+        assert sc.tick()["action"] == "hold"
+        for e in pool.replicas:
+            _fake_load(e, 0.0)
+        clk[0] = 30.0
+        obs = sc.tick()
+        assert obs["action"] == "shrink" and len(pool.replicas) == 2
+        clk[0] = 40.0
+        assert sc.tick()["action"] == "shrink"
+        clk[0] = 50.0  # at min: hold
+        assert sc.tick()["action"] == "hold"
+        assert len(pool.replicas) == 1
+        c = reg.get("dl4j_tpu_pool_autoscale_total")
+        assert c.labels("dyn", "grow").value == 1.0
+        assert c.labels("dyn", "shrink").value == 2.0
+        # the pool still serves after scaling down
+        assert np.asarray(pool.output(X)).shape == (1, 3)
+    finally:
+        pool.shutdown(drain=False)
+
+
+def test_autoscaler_validates_bounds():
+    reg = MetricsRegistry()
+    pool = _pool(reg)
+    try:
+        with pytest.raises(ValueError):
+            PoolAutoscaler(pool, min_replicas=0)
+        with pytest.raises(ValueError):
+            PoolAutoscaler(pool, min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError):
+            PoolAutoscaler(pool, high_load=1.0, low_load=1.0)
+    finally:
+        pool.shutdown(drain=False)
