@@ -2997,6 +2997,94 @@ def measure_model_multiplex(n_models: int = 8, warm_target: int = 4,
         mux.shutdown(drain=False)
 
 
+def measure_pipeline_bubble_share(n_stages: int = 4, n_micro: int = 8,
+                                  n_blocks: int = 8, nin: int = 16,
+                                  hidden: int = 64, nout: int = 8,
+                                  warmup_steps: int = 1, bench_steps: int = 4,
+                                  bubble_gate: float = 0.35,
+                                  force_devices: int = 0) -> dict:
+    """Pipeline-parallel row (ISSUE 20 acceptance): the analytic bubble
+    share (S-1)/(M+S-1) of both tick schedules at (S, M), the resident-
+    microbatch contrast (1F1B's min(S, M) vs GPipe's M — the memory story
+    that lets M grow to shrink the bubble), fenced step time for both
+    schedules on a pipe=S mesh, and the <0.35 bubble gate at the
+    S=4/M=8/1F1B operating point. Trajectory equality vs the single-device
+    Solver is a tier-1 test (test_pipeline_trainer.py), not re-proven
+    here. ``force_devices`` forces N virtual host devices on the CPU
+    fallback (must land before backend init)."""
+    if force_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={force_devices}"
+            ).strip()
+
+    import numpy as np
+
+    from deeplearning4j_tpu.nn import (
+        Activation, InputType, LossFunction, MultiLayerNetwork,
+        NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel import (PipelineParallelTrainer,
+                                             make_mesh)
+    from deeplearning4j_tpu.parallel.pipeline import build_pipeline_schedule
+    from deeplearning4j_tpu.train import Adam
+
+    def build():
+        b = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-3))
+             .list()
+             .layer(DenseLayer(n_out=hidden, activation=Activation.TANH)))
+        for _ in range(n_blocks):
+            b = b.layer(DenseLayer(n_out=hidden, activation=Activation.TANH))
+        conf = (b.layer(OutputLayer(n_out=nout, loss=LossFunction.MCXENT))
+                .set_input_type(InputType.feed_forward(nin)).build())
+        return MultiLayerNetwork(conf).init()
+
+    import jax as _jax
+    mesh = make_mesh(devices=_jax.devices()[:n_stages], pipe=n_stages)
+    batch = 4 * n_micro
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, nin).astype(np.float32)
+    y = np.eye(nout, dtype=np.float32)[rng.randint(0, nout, batch)]
+
+    def timed_steps(trainer, k: int) -> float:
+        _host_fence(trainer.params)
+        start = time.perf_counter()
+        for _ in range(k):
+            trainer.fit_batch(x, y)
+        _host_fence(trainer.params)
+        return (time.perf_counter() - start) / k
+
+    out = {"n_stages": n_stages, "n_micro": n_micro, "batch": batch}
+    for kind in ("1f1b", "gpipe"):
+        tr = PipelineParallelTrainer(build(), mesh, n_micro=n_micro,
+                                     schedule=kind, stage_time_probe=False)
+        timed_steps(tr, warmup_steps)
+        st = tr.stats()
+        out[f"bubble_share_{kind}"] = round(st["bubble_share"], 4)
+        out[f"resident_microbatches_{kind}"] = st["resident_microbatches"]
+        out[f"step_ms_{kind}"] = round(timed_steps(tr, bench_steps) * 1e3, 3)
+        if kind == "1f1b":
+            out["stage_param_bytes_per_device"] = tr.stage_param_bytes()
+            out["stage_param_bytes_global"] = tr.stage_param_bytes(
+                per_device=False)
+    # the memory lever in one number: what M could grow to at the same
+    # residency once 1F1B caps stashes at min(S, M)
+    big_m = 4 * n_micro
+    out["bubble_share_1f1b_4x_micro"] = round(
+        build_pipeline_schedule(n_stages, big_m, "1f1b").bubble_share, 4)
+    bubble = out["bubble_share_1f1b"]
+    out["bubble_gate"] = {"max": bubble_gate, "value": bubble,
+                          "ok": bool(bubble < bubble_gate)}
+    out["note"] = (
+        "bubble share is schedule-analytic ((S-1)/(M+S-1), identical for "
+        "both schedules at equal M); 1F1B's win is residency — min(S, M) "
+        "stashed microbatches vs GPipe's M — which is what lets M (and so "
+        "the bubble denominator) grow at fixed activation memory")
+    return out
+
+
 _MEASUREMENTS = {
     "lenet": measure_lenet,
     "resnet50": measure_resnet50,
@@ -3028,6 +3116,7 @@ _MEASUREMENTS = {
     "paged_kv_occupancy": measure_paged_kv_occupancy,
     "disagg_handoff": measure_disagg_handoff,
     "model_multiplex": measure_model_multiplex,
+    "pipeline_bubble_share": measure_pipeline_bubble_share,
 }
 
 # extras row name -> measurement name (the artifact's "extras" keys, in
@@ -3067,6 +3156,9 @@ _EXTRA_ROWS = {
     # shapes via the cpu kwargs); the ≤1.5 overhead ratio stays a
     # chip-only target recorded inside the row
     "moe_dispatch": "moe_dispatch",
+    # schedule analytics + fenced step times run fine on 8 virtual CPU
+    # devices; the <0.35 bubble gate is platform-independent
+    "pipeline_bubble_share": "pipeline_bubble_share",
 }
 # rows that only produce meaningful numbers on the chip (skipped with a
 # note under --rows on a cpu-fallback host)
@@ -3232,6 +3324,10 @@ def _child_measure(name: str, platform: str) -> None:
             # boot cost of each restart so the >0.90 gate reflects the
             # supervisor's bookkeeping, not this box's compile speed
             "elastic_goodput": {"total_iters": 280, "pace_s": 0.3},
+            # 8 virtual devices make the pipe=4 mesh real on the 1-core
+            # host; tiny blocks keep both schedule jits in the timeout
+            "pipeline_bubble_share": {"force_devices": 8, "hidden": 32,
+                                      "bench_steps": 2},
         }.get(name, {})
     result = _MEASUREMENTS[name](**kwargs)
     print(json.dumps(result))

@@ -84,6 +84,36 @@ class ComputationGraph:
             if s.layer is not None and s.layer.has_params()
         ]
 
+    def linear_chain(self) -> List[VertexSpec]:
+        """The vertex sequence when this graph is one input→output layer
+        chain (each vertex a layer consuming exactly the previous vertex's
+        output) — the shape pipeline-stage partitioning requires. Raises
+        ``ValueError`` for branching/merging topologies or op vertices."""
+        conf = self.conf
+        if len(conf.network_inputs) != 1 or len(conf.network_outputs) != 1:
+            raise ValueError(
+                "pipeline partitioning needs exactly one graph input and "
+                f"one output, got {len(conf.network_inputs)}/"
+                f"{len(conf.network_outputs)}")
+        prev = conf.network_inputs[0]
+        chain: List[VertexSpec] = []
+        for spec in conf.vertices:
+            if spec.layer is None:
+                raise ValueError(
+                    f"vertex {spec.name!r} is an op vertex — pipeline "
+                    "partitioning needs a pure layer chain")
+            if tuple(spec.inputs) != (prev,):
+                raise ValueError(
+                    f"vertex {spec.name!r} consumes {spec.inputs}, not the "
+                    f"previous vertex {prev!r} — not a linear chain")
+            chain.append(spec)
+            prev = spec.name
+        if prev != conf.network_outputs[0]:
+            raise ValueError(
+                f"the chain ends at {prev!r}, not the network output "
+                f"{conf.network_outputs[0]!r}")
+        return chain
+
     # ------------------------------------------------------------------ init
     def init(self, seed: Optional[int] = None) -> "ComputationGraph":
         rng = RngState(self.conf.seed if seed is None else seed)

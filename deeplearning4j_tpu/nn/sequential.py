@@ -167,11 +167,18 @@ class MultiLayerNetwork:
         mask: Optional[jax.Array] = None,
         rnn_state: Optional[Dict[str, Dict[str, jax.Array]]] = None,
         upto: Optional[int] = None,
+        start: int = 0,
         collect: bool = False,
         dist=None,
     ):
-        """Pure forward through layers [0, upto). Returns
-        (out, new_state, new_rnn_state, activations?)."""
+        """Pure forward through layers [start, upto). Returns
+        (out, new_state, new_rnn_state, activations?).
+
+        With ``start > 0`` (pipeline stages fold a layer RANGE), ``x`` is
+        the activation entering layer ``start`` and ``mask`` the mask at
+        that boundary; the InputType walk still advances from the input so
+        per-layer mask propagation and RNG folds stay index-aligned with
+        the full forward."""
         params, x = self._to_compute(params, x)
         new_state: Dict[str, Dict[str, jax.Array]] = {}
         new_rnn: Dict[str, Dict[str, jax.Array]] = {}
@@ -180,7 +187,10 @@ class MultiLayerNetwork:
         n = len(self.layers) if upto is None else upto
         # per-layer input types for mask propagation (from config walk)
         it = self.conf.input_type
-        for i in range(n):
+        for i in range(start):
+            if it is not None:
+                it = self.layers[i].output_type(it)
+        for i in range(start, n):
             layer = self.layers[i]
             name = self.conf.layer_name(i)
             lstate = dict(state.get(name, {}))

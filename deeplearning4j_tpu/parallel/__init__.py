@@ -35,9 +35,13 @@ from .strategies import (
     TopKCompressedSync,
 )
 from .sequence import ring_attention, ulysses_attention
-from .pipeline import (dense_block_stage, pipeline_apply,
-                       pipeline_stages_init, shard_stage_params)
-from .trainer import DistributedTrainer, moe_expert_parallel_rules
+from .pipeline import (PipelineSchedule, StagePartition,
+                       build_pipeline_schedule, dense_block_stage,
+                       partition_stages, pipeline_apply,
+                       pipeline_stages_init, pipeline_value_and_grad,
+                       shard_stage_params)
+from .trainer import (DistributedTrainer, PipelineParallelTrainer,
+                      moe_expert_parallel_rules)
 from .inference import InferenceMode, ParallelInference, Servable
 from .decode import DecodeAIMD, DecodeEngine, GenerationHandle
 from .pool import AdaptiveBatcher, EnginePool, PoolServable, ResponseCache
@@ -54,9 +58,15 @@ __all__ = [
     "ShardedEmbeddingTable",
     "shard_rows",
     "DistributedTrainer",
+    "PipelineParallelTrainer",
+    "PipelineSchedule",
+    "StagePartition",
     "ring_attention",
+    "build_pipeline_schedule",
+    "partition_stages",
     "pipeline_apply",
     "pipeline_stages_init",
+    "pipeline_value_and_grad",
     "shard_stage_params",
     "dense_block_stage",
     "ulysses_attention",

@@ -28,6 +28,8 @@ class MeshSpec:
       * ``data``  — batch (data parallel; DP)
       * ``model`` — hidden/feature (tensor parallel; TP)
       * ``seq``   — sequence/context (ring attention; SP/CP)
+      * ``pipe``  — layer sequence (pipeline parallel; PP —
+        PipelineParallelTrainer stages, e.g. ``MeshSpec(pipe=4, data=2)``)
     A size of -1 means "all remaining devices".
     """
 

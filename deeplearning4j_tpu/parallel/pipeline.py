@@ -1,23 +1,41 @@
-"""Pipeline parallelism (GPipe-style microbatch pipelining).
+"""Pipeline parallelism (PP) — the third parallel axis, for models bigger
+than a chip.
 
 Beyond-reference capability (SURVEY §2.3: PP absent upstream — "model must
 fit on one device"). TPU-native design: the layer stack is split into S
-uniform stages whose stacked params shard over a ``pipe`` mesh axis; a
-``shard_map`` + ``lax.scan`` schedule runs M microbatches through
-M + S - 1 ticks, handing activations to the next stage with ``ppermute``
-each tick (the neighbor transfer rides ICI). Reverse-mode AD differentiates
-straight through the schedule — the backward pass is the reversed pipeline
-with reversed ppermutes, which is exactly GPipe's backward.
+stages whose params shard over a ``pipe`` mesh axis; a ``shard_map`` +
+``lax.scan`` schedule runs M microbatches through the stages, handing
+activations to the neighbor stage with ``ppermute`` each tick (the transfer
+rides ICI).
 
-Constraint (the classic one): every stage maps [mb, d] -> [mb, d] with
-identical shapes — transformer-block pipelining. Stage 0 additionally owns
-an input projection and the last stage an output head, applied outside the
-rotated region.
+Two levels of API live here:
+
+* ``pipeline_apply`` — the forward-only GPipe fill–drain primitive over
+  uniform stacked stages (reverse-mode AD differentiates straight through
+  it: the backward pass is the reversed pipeline with reversed ppermutes,
+  which is exactly GPipe's backward). Resident activations are O(M): AD
+  saves every tick of the scan.
+* ``build_pipeline_schedule`` / ``pipeline_value_and_grad`` — explicit
+  tick schedules (``"gpipe"`` fill–drain or interleaved ``"1f1b"``) where
+  forward AND backward are individual scheduled ops. Both run the same
+  2(M+S-1) ticks — bubble share (S-1)/(M+S-1) — but 1F1B bounds resident
+  activations at min(S, M) microbatches instead of GPipe's M: stage s
+  runs at most S-s forwards ahead of its backwards, so stashes stay O(S).
+  The engine stashes stage *inputs* and recomputes the forward under
+  ``jax.vjp`` at the backward tick (activation remat), so the stash is one
+  boundary activation per in-flight microbatch.
+* ``partition_stages`` — splits a ``MultiLayerNetwork`` /
+  linear-chain ``ComputationGraph`` layer sequence into S stages balanced
+  by parameter count: stage 0 owns the input/prelude layers, the last
+  stage owns the head/loss, and the periodic middle (the transformer-block
+  region, detected by layer-config signature) is distributed greedily.
+  ``parallel.trainer.PipelineParallelTrainer`` consumes the partition.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +45,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import shmap as _shmap
 
+# Schedule op codes (lax.switch branch indices).
+PIPE_IDLE, PIPE_FWD, PIPE_BWD = 0, 1, 2
+
+SCHEDULES = ("gpipe", "1f1b")
+
+
+def _check_stage_leading(stage_params: Any, n_stages: int, axis: str) -> None:
+    for path, leaf in jax.tree_util.tree_flatten_with_path(stage_params)[0]:
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stage_params leaf {jax.tree_util.keystr(path)} has leading "
+                f"dim {leaf.shape[0]} but the {axis!r} mesh axis has "
+                f"{n_stages} stages — each shard would silently apply only "
+                "its first slice")
+
 
 def pipeline_apply(
     stage_fn: Callable[[Any, jax.Array], jax.Array],
@@ -35,7 +68,7 @@ def pipeline_apply(
     mesh: Mesh,
     axis: str = "pipe",
 ) -> jax.Array:
-    """Run ``x`` through S pipelined stages.
+    """Run ``x`` through S pipelined stages (GPipe fill–drain, forward).
 
     ``stage_params``: pytree whose leaves have leading dim S (one slice per
     stage), sharded over ``axis``. ``x``: [M, mb, d] microbatches.
@@ -44,13 +77,7 @@ def pipeline_apply(
     """
     n_stages = mesh.shape[axis]
     n_micro = x.shape[0]
-    for path, leaf in jax.tree_util.tree_flatten_with_path(stage_params)[0]:
-        if leaf.shape[0] != n_stages:
-            raise ValueError(
-                f"stage_params leaf {jax.tree_util.keystr(path)} has leading "
-                f"dim {leaf.shape[0]} but the {axis!r} mesh axis has "
-                f"{n_stages} stages — each shard would silently apply only "
-                "its first slice")
+    _check_stage_leading(stage_params, n_stages, axis)
 
     def worker(params, xs):
         # params leaves [1, ...] (this stage's slice); xs [M, mb, d]
@@ -63,10 +90,14 @@ def pipeline_apply(
 
         def tick(state, t):
             carry, buf = state
-            # stage 0 injects microbatch t (when one is still due)
-            inject = jnp.where(t < n_micro, t, n_micro - 1)
+            # stage idx works on microbatch t - idx; outside [0, M) it is
+            # filling/draining and must not burn compute on stale rows —
+            # lax.cond skips the stage body entirely on inactive ticks.
+            inject = jnp.clip(t, 0, n_micro - 1)
             act_in = jnp.where(idx == 0, xs[inject], carry)
-            act_out = stage_fn(p_local, act_in)
+            active = (t >= idx) & (t - idx < n_micro)
+            act_out = lax.cond(
+                active, lambda a: stage_fn(p_local, a), lambda a: a, act_in)
             # the last stage banks microbatch t - (S - 1) as it completes
             done = t - (n_stages - 1)
             slot = jnp.clip(done, 0, n_micro - 1)
@@ -85,14 +116,478 @@ def pipeline_apply(
         (carry, buf), _ = lax.scan(
             tick, (carry, buf), jnp.arange(n_micro + n_stages - 1))
         # every device returns its buf; only the last stage's is filled —
-        # psum-select so the result is replicated
-        keep = (idx == n_stages - 1).astype(buf.dtype)
-        return lax.psum(buf * keep, axis)
+        # mask with where + psum so the result is replicated. The mask is
+        # dtype-safe: bool activations ride an int32 psum, ints psum
+        # directly — no float multiply in the select path.
+        masked = jnp.where(idx == n_stages - 1, buf, jnp.zeros_like(buf))
+        if buf.dtype == jnp.bool_:
+            return lax.psum(masked.astype(jnp.int32), axis).astype(jnp.bool_)
+        return lax.psum(masked, axis)
 
     spec_params = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
     mapped = _shmap(worker, mesh, in_specs=(spec_params, P()),
                     out_specs=P())
     return mapped(stage_params, x)
+
+
+# ---------------------------------------------------------------------------
+# Explicit tick schedules: GPipe fill–drain and interleaved 1F1B
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineSchedule:
+    """Static tick tables driving one pipelined forward+backward pass.
+
+    All tables are [ticks, n_stages]: ``ops[t, s]`` is the op stage ``s``
+    runs at tick ``t`` (PIPE_IDLE/PIPE_FWD/PIPE_BWD), ``mb[t, s]`` the
+    microbatch it targets; ``fwd_recv[t, s]`` / ``bwd_recv[t, s]`` name
+    the microbatch whose activation / cotangent arrives over the ring at
+    the START of tick ``t`` (-1: nothing — the ppermuted value is
+    garbage and must be dropped).
+
+    ``max_inflight`` is the per-stage peak of forwards-minus-backwards —
+    the number of stashed boundary activations the engine must keep
+    resident, and the memory story that separates 1F1B (≤ min(S, M))
+    from GPipe (= M). ``bubble_share`` is the fraction of stage-ticks
+    spent idle: 1 - 2M/T = (S-1)/(M+S-1) for both schedules.
+    """
+
+    kind: str
+    n_stages: int
+    n_micro: int
+    ticks: int
+    ops: np.ndarray
+    mb: np.ndarray
+    fwd_recv: np.ndarray
+    bwd_recv: np.ndarray
+    max_inflight: int
+    bubble_share: float
+
+
+def build_pipeline_schedule(n_stages: int, n_micro: int,
+                            schedule: str = "1f1b") -> PipelineSchedule:
+    """Build the static tick tables for ``schedule`` at (S, M).
+
+    Per-stage op queues are laid out canonically and then run through a
+    discrete-event simulation: a forward needs its input activation
+    (stage 0: always ready; else sent by the upstream forward one tick
+    earlier), a backward needs its cotangent (last stage: its own
+    forward's loss, ready the next tick; else sent by the downstream
+    backward one tick earlier). GPipe queues all M forwards then all M
+    backwards in reverse microbatch order (the in-flight window stays a
+    consecutive range, so K stash slots indexed mb % K never collide);
+    1F1B (PipeDream-flush) warms up with min(S-1-s, M) forwards then
+    strictly alternates F/B, bounding in-flight at min(S, M).
+    """
+    S, M = int(n_stages), int(n_micro)
+    if S < 1 or M < 1:
+        raise ValueError(f"need n_stages >= 1 and n_micro >= 1, got {S}/{M}")
+    if schedule not in SCHEDULES:
+        raise ValueError(
+            f"unknown schedule {schedule!r}; pick one of {SCHEDULES}")
+
+    queues: List[List[Tuple[int, int]]] = []
+    for s in range(S):
+        if schedule == "gpipe":
+            q = [(PIPE_FWD, m) for m in range(M)]
+            q += [(PIPE_BWD, m) for m in reversed(range(M))]
+        else:
+            w = min(S - 1 - s, M)
+            q = [(PIPE_FWD, m) for m in range(w)]
+            f, b = w, 0
+            while f < M:
+                q += [(PIPE_FWD, f), (PIPE_BWD, b)]
+                f, b = f + 1, b + 1
+            q += [(PIPE_BWD, m) for m in range(b, M)]
+        queues.append(q)
+
+    INF = 1 << 30
+    f_avail = np.full((S, M), INF, np.int64)
+    f_avail[0, :] = 0
+    b_avail = np.full((S, M), INF, np.int64)
+    pos = [0] * S
+    inflight = np.zeros(S, np.int64)
+    max_inflight = 1
+    events: List[Tuple[int, int, int, int]] = []  # (tick, stage, op, mb)
+    max_ticks = 4 * (M + S) + 8
+    t = 0
+    while any(pos[s] < len(queues[s]) for s in range(S)):
+        if t >= max_ticks:  # pragma: no cover - deadlock guard
+            raise AssertionError("pipeline schedule failed to converge")
+        for s in range(S):
+            if pos[s] >= len(queues[s]):
+                continue
+            op, m = queues[s][pos[s]]
+            avail = f_avail if op == PIPE_FWD else b_avail
+            if avail[s, m] > t:
+                continue
+            pos[s] += 1
+            events.append((t, s, op, m))
+            if op == PIPE_FWD:
+                inflight[s] += 1
+                max_inflight = max(max_inflight, int(inflight[s]))
+                if s + 1 < S:
+                    f_avail[s + 1, m] = t + 1
+                else:
+                    b_avail[s, m] = t + 1  # loss cotangent of own output
+            else:
+                inflight[s] -= 1
+                if s > 0:
+                    b_avail[s - 1, m] = t + 1
+        t += 1
+    T = t
+
+    ops = np.full((T, S), PIPE_IDLE, np.int32)
+    mbt = np.zeros((T, S), np.int32)
+    fwd_recv = np.full((T, S), -1, np.int32)
+    bwd_recv = np.full((T, S), -1, np.int32)
+    for tt, s, op, m in events:
+        ops[tt, s] = op
+        mbt[tt, s] = m
+        if op == PIPE_FWD and s + 1 < S:
+            assert tt + 1 < T
+            fwd_recv[tt + 1, s + 1] = m
+        elif op == PIPE_BWD and s > 0:
+            assert tt + 1 < T
+            bwd_recv[tt + 1, s - 1] = m
+
+    bubble = 0.0 if S == 1 else 1.0 - (2.0 * M) / T
+    if schedule == "1f1b":
+        assert max_inflight <= min(S, M), (max_inflight, S, M)
+    return PipelineSchedule(
+        kind=schedule, n_stages=S, n_micro=M, ticks=T, ops=ops, mb=mbt,
+        fwd_recv=fwd_recv, bwd_recv=bwd_recv, max_inflight=max_inflight,
+        bubble_share=float(bubble))
+
+
+def run_pipeline_schedule(
+    fwd_fn: Callable[[Any, jax.Array, jax.Array], jax.Array],
+    loss_fn: Callable[[Any, jax.Array, jax.Array], jax.Array],
+    params: Any,
+    sched: PipelineSchedule,
+    axis: str,
+    carry_like: Any,
+) -> Tuple[jax.Array, Any]:
+    """Run one scheduled forward+backward pass inside a shard_map worker.
+
+    ``fwd_fn(params, m, act_in) -> act_out`` is this stage's forward for
+    microbatch ``m`` (stage 0 must ignore ``act_in`` and read its own
+    input; activations between stages all share ``carry_like``'s
+    shape/dtype). ``loss_fn(params, act_out, m) -> scalar`` is the
+    last-stage loss for microbatch ``m``. Returns ``(loss_sum, grads)``:
+    the un-normalized per-stage contributions — the sum of microbatch
+    losses on the last stage (zero elsewhere) and the local gradient
+    accumulator (prelude/head params held replicated but computed on one
+    stage come back zero on the others; psum over ``axis`` recovers
+    totals).
+
+    Backward ticks recompute the stage forward from the stashed *input*
+    activation under ``jax.vjp`` (remat), so only K = max_inflight
+    boundary activations stay resident — the 1F1B O(S) memory bound.
+    """
+    S, K, T = sched.n_stages, sched.max_inflight, sched.ticks
+    idx = lax.axis_index(axis)
+    is_last = idx == S - 1
+    ops = jnp.asarray(sched.ops)
+    mbt = jnp.asarray(sched.mb)
+    frt = jnp.asarray(sched.fwd_recv)
+    brt = jnp.asarray(sched.bwd_recv)
+    cshape = tuple(carry_like.shape)
+    cdtype = jnp.dtype(carry_like.dtype)
+    zero_c = jnp.zeros(cshape, cdtype)
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+    def tick(state, t):
+        fbuf, bbuf, stash, fin, bin_, gacc, loss = state
+        op = ops[t, idx]
+        m = mbt[t, idx]
+        slot = jnp.remainder(m, K)
+        # bank the ring arrivals into their mb % K slots (in-flight
+        # microbatches form a consecutive range < K wide: no collisions)
+        fm = frt[t, idx]
+        bm = brt[t, idx]
+        fbuf = jnp.where(
+            fm >= 0,
+            lax.dynamic_update_index_in_dim(fbuf, fin,
+                                            jnp.remainder(fm, K), 0),
+            fbuf)
+        bbuf = jnp.where(
+            bm >= 0,
+            lax.dynamic_update_index_in_dim(bbuf, bin_,
+                                            jnp.remainder(bm, K), 0),
+            bbuf)
+        x_in = lax.dynamic_index_in_dim(fbuf, slot, 0, keepdims=False)
+
+        def br_idle():
+            return stash, gacc, jnp.zeros((), jnp.float32), zero_c, zero_c
+
+        def br_fwd():
+            h = fwd_fn(params, m, x_in).astype(cdtype)
+            new_stash = lax.dynamic_update_index_in_dim(stash, x_in, slot, 0)
+            return new_stash, gacc, jnp.zeros((), jnp.float32), h, zero_c
+
+        def br_bwd():
+            xi = lax.dynamic_index_in_dim(stash, slot, 0, keepdims=False)
+
+            def mid():
+                _, vjp = jax.vjp(
+                    lambda p, x: fwd_fn(p, m, x).astype(cdtype), params, xi)
+                g = lax.dynamic_index_in_dim(bbuf, slot, 0, keepdims=False)
+                gp, gx = vjp(g)
+                return gp, gx.astype(cdtype), jnp.zeros((), jnp.float32)
+
+            def last():
+                lval, vjp = jax.vjp(
+                    lambda p, x: loss_fn(p, fwd_fn(p, m, x).astype(cdtype),
+                                         m).astype(jnp.float32),
+                    params, xi)
+                gp, gx = vjp(jnp.ones((), jnp.float32))
+                return gp, gx.astype(cdtype), lval
+
+            gp, gx, lval = lax.cond(is_last, last, mid)
+            new_gacc = jax.tree_util.tree_map(lambda a, b: a + b, gacc, gp)
+            return stash, new_gacc, lval, zero_c, gx
+
+        stash, gacc, lval, fsend, bsend = lax.switch(
+            op, (br_idle, br_fwd, br_bwd))
+        loss = loss + lval
+        fin2 = lax.ppermute(fsend, axis, fwd_perm)
+        bin2 = lax.ppermute(bsend, axis, bwd_perm)
+        return (fbuf, bbuf, stash, fin2, bin2, gacc, loss), None
+
+    buf0 = jnp.zeros((K,) + cshape, cdtype)
+    gacc0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+    init = (buf0, buf0, buf0, zero_c, zero_c, gacc0,
+            jnp.zeros((), jnp.float32))
+    (_, _, _, _, _, gacc, loss), _ = lax.scan(tick, init, jnp.arange(T))
+    return loss, gacc
+
+
+def pipeline_value_and_grad(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    y: jax.Array,
+    loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+    mesh: Mesh,
+    axis: str = "pipe",
+    schedule: str = "1f1b",
+) -> Tuple[jax.Array, Any]:
+    """Scheduled loss+grad over S uniform stacked stages.
+
+    Equal to ``value_and_grad`` of ``mean_m loss_fn(fold(x[m]), y[m])``
+    but executed under the selected tick schedule. ``loss_fn(out, y_mb)``
+    must return the microbatch-mean scalar. Returns (loss, grads) with
+    grads matching ``stage_params``' stacked layout.
+    """
+    n_stages = mesh.shape[axis]
+    n_micro = x.shape[0]
+    _check_stage_leading(stage_params, n_stages, axis)
+    sched = build_pipeline_schedule(n_stages, n_micro, schedule)
+    carry_like = jax.ShapeDtypeStruct(x.shape[1:], x.dtype)
+
+    def worker(params, xs, ys):
+        idx = lax.axis_index(axis)
+        p_local = jax.tree_util.tree_map(lambda a: a[0], params)
+
+        def fwd(p, m, xi):
+            return stage_fn(p, jnp.where(idx == 0, xs[m], xi))
+
+        def lfn(p, h, m):
+            return loss_fn(h, ys[m])
+
+        loss, grads = run_pipeline_schedule(
+            fwd, lfn, p_local, sched, axis, carry_like)
+        inv = 1.0 / n_micro
+        loss = lax.psum(jnp.where(idx == n_stages - 1, loss, 0.0),
+                        axis) * inv
+        grads = jax.tree_util.tree_map(lambda a: (a * inv)[None], grads)
+        return loss, grads
+
+    spec_params = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    mapped = _shmap(worker, mesh, in_specs=(spec_params, P(), P()),
+                    out_specs=(P(), spec_params))
+    return mapped(stage_params, x, y)
+
+
+# ---------------------------------------------------------------------------
+# Stage partitioning for real models
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class StagePartition:
+    """How a layer sequence splits into S pipeline stages.
+
+    Unit indices refer to the model's layer sequence (``_model_units``).
+    ``prelude`` (input layers, stage 0) and ``head`` (output/loss layers,
+    last stage) bracket ``blocks``: ``n_blocks`` structurally identical
+    runs of ``period`` layers, distributed contiguously —
+    ``blocks_per_stage[s]`` consecutive blocks per stage, balanced by
+    parameter count against the prelude/head base loads.
+    """
+
+    n_stages: int
+    period: int
+    prelude: Tuple[int, ...]
+    blocks: Tuple[Tuple[int, ...], ...]
+    head: Tuple[int, ...]
+    blocks_per_stage: Tuple[int, ...]
+    stage_units: Tuple[Tuple[int, ...], ...]
+    stage_costs: Tuple[int, ...]
+    balance: float
+
+    @property
+    def n_blocks(self) -> int:
+        return len(self.blocks)
+
+    def block_offsets(self) -> Tuple[int, ...]:
+        out, off = [], 0
+        for c in self.blocks_per_stage:
+            out.append(off)
+            off += c
+        return tuple(out)
+
+    def locate_block(self, b: int) -> Tuple[int, int]:
+        """Block index -> (stage, slot-within-stage)."""
+        off = 0
+        for s, c in enumerate(self.blocks_per_stage):
+            if b < off + c:
+                return s, b - off
+            off += c
+        raise IndexError(b)
+
+
+def _model_units(model) -> List[Tuple[str, Any, Any]]:
+    """The (name, layer, preprocessor) sequence of a sequential model or a
+    linear-chain ComputationGraph — the shape pipeline partitioning needs."""
+    conf = getattr(model, "conf", None)
+    if hasattr(model, "layers") and hasattr(conf, "layer_name"):
+        return [(conf.layer_name(i), layer, None)
+                for i, layer in enumerate(model.layers)]
+    if hasattr(model, "linear_chain"):
+        return [(spec.name, spec.layer, spec.preprocessor)
+                for spec in model.linear_chain()]
+    raise TypeError(
+        f"cannot partition {type(model).__name__} into pipeline stages: "
+        "expected a MultiLayerNetwork or a ComputationGraph")
+
+
+def _unit_signature(layer, params) -> Any:
+    """Structural identity of a layer: its config minus the name, plus its
+    param shapes/dtypes. Equal signatures <=> stackable pipeline blocks."""
+    shapes = tuple(sorted(
+        (k, tuple(np.shape(v)), str(jnp.asarray(v).dtype))
+        for k, v in params.items()))
+    try:
+        anon = dataclasses.replace(layer, name=None)
+    except Exception:
+        anon = type(layer).__name__
+    return (anon, shapes)
+
+
+def partition_stages(model, n_stages: int) -> StagePartition:
+    """Split an initialized model's layer sequence into ``n_stages``
+    pipeline stages balanced by parameter count.
+
+    Finds the largest-parameter-cost periodic run of structurally
+    identical layer blocks (period chosen smallest on ties), anchors
+    everything before it to stage 0 (prelude) and everything after —
+    always including the output layer — to the last stage (head), and
+    hands each stage at least one block, distributing the rest greedily
+    onto the least-loaded stage. Raises ``ValueError`` when the sequence
+    has no periodic region with >= ``n_stages`` repeats (e.g. LeNet's
+    conv->dense chain) — such models cannot pipeline here yet.
+    """
+    S = int(n_stages)
+    if S < 2:
+        raise ValueError(
+            f"n_stages={S}: pipeline partitioning needs >= 2 stages "
+            "(use the single-device Solver otherwise)")
+    units = _model_units(model)
+    L = len(units)
+    params = model.params
+    costs = [sum(int(np.prod(np.shape(v)))
+                 for v in params.get(name, {}).values())
+             for name, _, _ in units]
+    sigs = [_unit_signature(layer, params.get(name, {}))
+            for name, layer, _ in units]
+
+    body_end = L - 1  # the output layer is always head
+    best: Optional[Tuple[int, int, int]] = None  # (a, p, n)
+    best_key: Optional[Tuple[int, int, int]] = None
+    for p in range(1, body_end // S + 1):
+        for a in range(0, body_end - p + 1):
+            n = 1
+            while (a + (n + 1) * p <= body_end
+                   and sigs[a + n * p:a + (n + 1) * p] == sigs[a:a + p]):
+                n += 1
+            if n >= S:
+                cost = sum(costs[a:a + n * p])
+                key = (cost, -p, -a)
+                if best_key is None or key > best_key:
+                    best, best_key = (a, p, n), key
+    if best is None:
+        raise ValueError(
+            f"cannot partition {L} layers into {S} pipeline stages: no run "
+            f"of >= {S} structurally identical layer blocks found — "
+            "pipeline partitioning needs a periodic middle (repeated "
+            "dense/transformer blocks); heterogeneous chains like "
+            "conv->dense don't pipeline here yet")
+    a, p, n = best
+
+    # The block region must preserve the activation shape (block k's output
+    # feeds block k+1): verify via the static InputType walk when available.
+    conf = getattr(model, "conf", None)
+    it = getattr(conf, "input_type", None)
+    if it is not None and hasattr(model, "layers"):
+        types = [it]
+        for _, layer, _ in units:
+            it = layer.output_type(it)
+            types.append(it)
+        if types[a] != types[a + p]:
+            raise ValueError(
+                f"periodic block at layers [{a}, {a + p}) does not preserve "
+                f"the activation type ({types[a]} -> {types[a + p]}): "
+                "stages cannot ring-pass activations of differing shapes")
+
+    prelude = tuple(range(a))
+    blocks = tuple(tuple(range(a + b * p, a + (b + 1) * p))
+                   for b in range(n))
+    head = tuple(range(a + n * p, L))
+    block_cost = sum(costs[a:a + p])
+    loads = [float(block_cost)] * S
+    loads[0] += sum(costs[i] for i in prelude)
+    loads[-1] += sum(costs[i] for i in head)
+    counts = [1] * S
+    for _ in range(n - S):
+        s = int(np.argmin(loads))
+        counts[s] += 1
+        loads[s] += block_cost
+
+    stage_units: List[Tuple[int, ...]] = []
+    off = 0
+    for s in range(S):
+        ids = list(prelude) if s == 0 else []
+        for b in range(off, off + counts[s]):
+            ids.extend(blocks[b])
+        off += counts[s]
+        if s == S - 1:
+            ids.extend(head)
+        stage_units.append(tuple(ids))
+    mean_load = sum(loads) / S
+    balance = (max(loads) / mean_load) if mean_load > 0 else 1.0
+    return StagePartition(
+        n_stages=S, period=p, prelude=prelude, blocks=blocks, head=head,
+        blocks_per_stage=tuple(counts), stage_units=tuple(stage_units),
+        stage_costs=tuple(int(v) for v in loads), balance=float(balance))
+
+
+# ---------------------------------------------------------------------------
+# Stand-in stages (bench / tests)
+# ---------------------------------------------------------------------------
 
 
 def pipeline_stages_init(

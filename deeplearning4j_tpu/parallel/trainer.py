@@ -1020,3 +1020,701 @@ class DistributedTrainer:
         if t is None and isinstance(self.strat_state, dict):
             t = self.strat_state.get("threshold")  # custom strategies
         return None if t is None else float(t)
+
+
+# ===========================================================================
+# Pipeline-parallel training (PP × DP)
+# ===========================================================================
+
+
+class PipelineParallelTrainer:
+    """Pipeline-parallel trainer: the layer sequence split over a ``pipe``
+    mesh axis, microbatches streamed through the stages under a GPipe or
+    1F1B tick schedule, composing with data parallelism (and ZeRO-1
+    updater-state sharding) inside each stage across the ``data`` axis.
+
+    Layout: :func:`~deeplearning4j_tpu.parallel.pipeline.partition_stages`
+    splits the model into prelude (stage 0) / periodic blocks / head
+    (last stage). Block params stack as ``[S, k_max, *shape]`` leaves
+    sharded over ``pipe`` — each device holds ONLY its own stage's blocks,
+    which is what lets a model bigger than one device's memory train
+    (see :meth:`stage_param_bytes`). Prelude/head params are replicated
+    (they are small: embeddings/heads) but computed only at their owning
+    stage; their gradients come back zero elsewhere and a psum over
+    ``pipe`` recovers the totals.
+
+    The checkpoint surface (``params`` / ``opt_state`` / ``state``
+    properties) speaks GLOBAL name-keyed trees structurally identical to
+    the single-device model's, so orbax/zip checkpoints interchange with
+    ``Solver`` and ``DistributedTrainer`` both ways — PP↔non-PP restores
+    re-shard exactly like zero1↔replicated already do.
+
+    Scope (clear errors otherwise): sequential models / linear-chain
+    graphs with a periodic middle; stateless layers (no BN running stats
+    / MoE counters); full-precision compute; no masks/TBPTT; gradient
+    normalization NONE or elementwise clip; elementwise updaters on block
+    layers (LARS/LAMB trust-ratio norms would span the stacked leaves —
+    they remain fine on prelude/head and in DistributedTrainer).
+    """
+
+    def __init__(self, model, mesh: Optional[Mesh] = None, *,
+                 n_micro: int = 8, schedule: str = "1f1b",
+                 pipe_axis: str = "pipe", data_axis: str = "data",
+                 zero1: bool = False, partition=None,
+                 registry=None, stage_time_probe: bool = True) -> None:
+        from ..nn.layers.output import BaseOutputLayer
+        from ..train.updaters import updater_from_any, Sgd as _Sgd
+        from .pipeline import (_model_units, build_pipeline_schedule,
+                               partition_stages)
+
+        if mesh is None:
+            mesh = make_mesh(pipe=len(jax.devices()))
+        if pipe_axis not in mesh.shape:
+            raise ValueError(f"mesh has no {pipe_axis!r} axis: {mesh.shape}")
+        self.model = model
+        self.mesh = mesh
+        self.pipe_axis = pipe_axis
+        self.data_axis = data_axis
+        self.n_stages = int(mesh.shape[pipe_axis])
+        self._n_data = int(mesh.shape.get(data_axis, 1))
+        self.n_micro = int(n_micro)
+        self.schedule = schedule
+        self.zero1 = bool(zero1) and self._n_data > 1
+        self.iteration = 0
+        self.strat_state: dict = {}
+        self._multiprocess = False
+        self._step_cache: dict = {}
+        self._stage_probe_pending = bool(stage_time_probe)
+
+        model._check_init()
+        conf = model.conf
+        if getattr(conf, "compute_dtype", None):
+            raise ValueError(
+                "PipelineParallelTrainer does not support compute_dtype "
+                "mixed precision yet — drop compute_dtype or use "
+                "DistributedTrainer")
+        from ..nn.conf import GradientNormalization as _GN
+        if conf.gradient_normalization not in (
+                _GN.NONE, _GN.CLIP_ELEMENT_WISE_ABSOLUTE_VALUE):
+            raise ValueError(
+                f"gradient normalization {conf.gradient_normalization} "
+                "computes per-layer/param-type norms that would span the "
+                "stacked pipeline blocks; use NONE or "
+                "CLIP_ELEMENT_WISE_ABSOLUTE_VALUE")
+        for name, st in model.state.items():
+            if st:
+                raise ValueError(
+                    f"layer {name!r} carries persistent state "
+                    f"({sorted(st)}): stateful layers (batch norm running "
+                    "stats, MoE counters) do not pipeline here yet")
+
+        self._units = _model_units(model)
+        self._n_units = len(self._units)
+        if not isinstance(self._units[-1][1], BaseOutputLayer):
+            raise ValueError("the last layer must be an output/loss layer")
+        self.partition = (partition if partition is not None
+                          else partition_stages(model, self.n_stages))
+        if self.partition.n_stages != self.n_stages:
+            raise ValueError(
+                f"partition is for {self.partition.n_stages} stages, mesh "
+                f"{pipe_axis!r} axis has {self.n_stages}")
+        self._sched = build_pipeline_schedule(
+            self.n_stages, self.n_micro, schedule)
+
+        part = self.partition
+        self._k_max = max(part.blocks_per_stage)
+        # block b -> (stage, slot); unit i -> location
+        self._block_place = [part.locate_block(b)
+                             for b in range(part.n_blocks)]
+        self._aux_names = [self._units[i][0]
+                           for i in (*part.prelude, *part.head)
+                           if model.params.get(self._units[i][0])]
+
+        # per-layer optax chains (shared construction with Solver /
+        # DistributedTrainer — checkpoint structure compatibility)
+        self.optim = LayerOptimizers(model)
+        global_upd = (updater_from_any(conf.updater)
+                      if conf.updater is not None else _Sgd())
+        self._body_tx = []
+        for j, i0 in enumerate(part.blocks[0]):
+            name0, layer0, _ = self._units[i0]
+            if not model.params.get(name0):
+                import optax as _optax
+                self._body_tx.append(_optax.set_to_zero())
+                continue
+            upd = (updater_from_any(layer0.updater)
+                   if layer0.updater is not None else global_upd)
+            # Trust-ratio updaters (Lars/Lamb) keep elementwise=True for
+            # ZeRO-1 (their norms re-spell as slice-local + psum), but here
+            # the coupling is the problem itself: a per-tensor norm over a
+            # stacked [S, k, ...] leaf spans every block instance. Their
+            # to_optax_zero1 override is the marker for that coupling.
+            from ..train.updaters import IUpdater as _IUpd
+            coupled = (not getattr(upd, "elementwise", False)
+                       or type(upd).to_optax_zero1
+                       is not _IUpd.to_optax_zero1)
+            if not layer0.frozen and coupled:
+                raise ValueError(
+                    f"block layer {name0!r} uses {type(upd).__name__}, "
+                    "whose per-tensor (trust-ratio) norms would span the "
+                    "stacked [S, k] pipeline leaves; use an elementwise "
+                    "updater (Sgd/Adam/...) on block layers")
+            self._body_tx.append(self.optim.txs[name0])
+
+        # ---- device layout --------------------------------------------
+        self._pipe_sh = NamedSharding(mesh, P(pipe_axis))
+        self._repl_sh = NamedSharding(mesh, P())
+        S, K = self.n_stages, self._k_max
+        self._aux = {
+            name: jax.device_put(model.params[name], self._repl_sh)
+            for name in self._aux_names}
+        self._body = []
+        for j, i0 in enumerate(part.blocks[0]):
+            stacked = {}
+            for pname, p0 in model.params[self._units[i0][0]].items():
+                arr = np.zeros((S, K) + tuple(p0.shape),
+                               jnp.asarray(p0).dtype)
+                for b in range(part.n_blocks):
+                    s, kb = self._block_place[b]
+                    bname = self._units[part.blocks[b][j]][0]
+                    arr[s, kb] = np.asarray(
+                        jax.device_get(model.params[bname][pname]))
+                stacked[pname] = jax.device_put(arr, self._pipe_sh)
+            self._body.append(stacked)
+
+        self._aux_opt = {}
+        self._aux_opt_sh = {}
+        for name in self._aux_names:
+            st = self.optim.txs[name].init(self._aux[name])
+            shs = jax.tree_util.tree_map(
+                lambda leaf: NamedSharding(mesh, zero1_partition_spec(
+                    tuple(np.shape(leaf)), self._n_data, data_axis))
+                if self.zero1 and self.optim.elementwise.get(name, False)
+                else self._repl_sh, st)
+            self._aux_opt[name] = jax.tree_util.tree_map(
+                jax.device_put, st, shs)
+            self._aux_opt_sh[name] = shs
+        self._body_opt = [tx.init(bp)
+                          for tx, bp in zip(self._body_tx, self._body)]
+        self._validate_body_opt_roundtrip()
+
+        self._has_reg = any(
+            getattr(layer, f, None)
+            for _, layer, _ in self._units
+            for f in ("l1", "l2", "l1_bias", "l2_bias"))
+        self._active_counts = np.asarray(part.blocks_per_stage, np.int32)
+        self._block_offsets = np.asarray(part.block_offsets(), np.int32)
+        self._init_metrics(registry)
+
+    # ------------------------------------------------------------ metrics
+    def _init_metrics(self, registry) -> None:
+        from ..obs import get_registry
+
+        self.registry = registry if registry is not None else get_registry()
+        self.registry.gauge(
+            "dl4j_tpu_training_pipeline_bubble_share",
+            "Fraction of pipeline stage-ticks idle under the tick "
+            "schedule: (S-1)/(M+S-1) for GPipe and 1F1B both",
+            labelnames=("schedule",)).labels(self.schedule).set(
+                self._sched.bubble_share)
+        self.registry.gauge(
+            "dl4j_tpu_training_pipeline_resident_microbatches",
+            "Peak per-stage stashed boundary activations (microbatches): "
+            "min(S, M) under 1F1B vs M under GPipe",
+            labelnames=("schedule",)).labels(self.schedule).set(
+                self._sched.max_inflight)
+        spg = self.registry.gauge(
+            "dl4j_tpu_training_pipeline_stage_params",
+            "Parameter count owned per pipeline stage (partition balance)",
+            labelnames=("stage",))
+        for s, c in enumerate(self.partition.stage_costs):
+            spg.labels(str(s)).set(float(c))
+        self._stage_time_gauge = self.registry.gauge(
+            "dl4j_tpu_training_pipeline_stage_step_seconds",
+            "Per-stage compiled fold time (one-off probe at first "
+            "fit_batch): the schedule's tick length is the max over "
+            "stages", labelnames=("stage",))
+
+    # ---------------------------------------------------- layer folding
+    def _apply_unit(self, i, params_by_name, h, key):
+        from ..nn.layers.base import LayerContext, apply_layer
+        name, layer, preproc = self._units[i]
+        k = jax.random.fold_in(key, i) if key is not None else None
+        ctx = LayerContext(train=True, rng=k, mask=None, dist=None)
+        if preproc is not None:
+            h, _ = preproc.apply({}, {}, h, ctx)
+        y, _ = apply_layer(layer, params_by_name.get(name, {}), {}, h, ctx)
+        return y
+
+    def _fold_prelude(self, aux, xmb, key):
+        h = xmb
+        for i in self.partition.prelude:
+            h = self._apply_unit(i, aux, h, key)
+        return h
+
+    def _fold_block(self, body, kb, g, h, key):
+        """One pipeline block: position-j params sliced at stacked slot kb.
+        ``g`` is the global block index — folded into the rng so dropout
+        differs between block instances."""
+        from ..nn.layers.base import LayerContext, apply_layer
+        for j, i0 in enumerate(self.partition.blocks[0]):
+            _, layer, preproc = self._units[i0]
+            pj = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, kb, 0, False),
+                body[j])
+            k = (jax.random.fold_in(
+                jax.random.fold_in(key, self._n_units + j), g)
+                if key is not None else None)
+            ctx = LayerContext(train=True, rng=k, mask=None, dist=None)
+            if preproc is not None:
+                h, _ = preproc.apply({}, {}, h, ctx)
+            h, _ = apply_layer(layer, pj, {}, h, ctx)
+        return h
+
+    def _fold_body(self, body, h, key, n_active, g0):
+        """Fold this stage's resident blocks: k_max scan steps, inactive
+        (zero-padded) slots skipped under lax.cond."""
+        def step(hh, kb):
+            out = jax.lax.cond(
+                kb < n_active,
+                lambda v: self._fold_block(body, kb, g0 + kb, v, key),
+                lambda v: v, hh)
+            return out, None
+        h, _ = jax.lax.scan(step, h, jnp.arange(self._k_max))
+        return h
+
+    def _fold_head_loss(self, aux, h, ymb, key):
+        from ..nn.layers.base import LayerContext
+        part = self.partition
+        for i in part.head[:-1]:
+            h = self._apply_unit(i, aux, h, key)
+        i = part.head[-1]
+        name, layer, preproc = self._units[i]
+        k = jax.random.fold_in(key, i) if key is not None else None
+        ctx = LayerContext(train=True, rng=k, mask=None, dist=None)
+        if preproc is not None:
+            h, _ = preproc.apply({}, {}, h, ctx)
+        return layer.compute_loss(aux.get(name, {}), h, ymb, ctx)
+
+    def _reg_score(self, aux, body):
+        from ..nn.sequential import _layer_reg_score
+        sd = jnp.float32
+        total = jnp.zeros((), sd)
+        for i in (*self.partition.prelude, *self.partition.head):
+            name, layer, _ = self._units[i]
+            if aux.get(name):
+                total = total + _layer_reg_score(layer, aux[name], sd)
+        for j, i0 in enumerate(self.partition.blocks[0]):
+            if body[j]:
+                # stacked leaves: elementwise |w| / w^2 sums cover every
+                # block at once; zero pads contribute zero
+                total = total + _layer_reg_score(
+                    self._units[i0][1], body[j], sd)
+        return total
+
+    # ---------------------------------------------------------- the step
+    def _boundary_struct(self, mb_shape, x_dtype):
+        x_s = jax.ShapeDtypeStruct(mb_shape, x_dtype)
+
+        def pre(aux, xm):
+            return self._fold_prelude(aux, xm, jax.random.PRNGKey(0))
+
+        boundary = jax.eval_shape(pre, self._aux, x_s)
+
+        def blk(xm):
+            body0 = [jax.tree_util.tree_map(lambda a: a[0], bj)
+                     for bj in self._body]
+            return self._fold_block(body0, jnp.int32(0), jnp.int32(0), xm,
+                                    jax.random.PRNGKey(0))
+
+        out = jax.eval_shape(blk, boundary)
+        if (out.shape, out.dtype) != (boundary.shape, boundary.dtype):
+            raise ValueError(
+                f"pipeline block does not preserve the boundary activation "
+                f"({boundary.shape}/{boundary.dtype} -> {out.shape}/"
+                f"{out.dtype}): stages cannot ring-pass activations of "
+                "differing shapes")
+        return boundary
+
+    def _build_step(self, x_shape, x_dtype, y_shape, y_dtype):
+        import optax
+        from ..nn.conf import GradientNormalization as _GN
+        from .pipeline import run_pipeline_schedule
+
+        mesh, S, D = self.mesh, self.n_stages, self._n_data
+        pipe, data = self.pipe_axis, self.data_axis
+        part, sched = self.partition, self._sched
+        conf = self.model.conf
+        mb_local = x_shape[1] // D
+        boundary = self._boundary_struct((mb_local,) + tuple(x_shape[2:]),
+                                         x_dtype)
+        n_act = jnp.asarray(self._active_counts)
+        offs = jnp.asarray(self._block_offsets)
+
+        def worker(aux, body, xs, ys, kd):
+            idx = jax.lax.axis_index(pipe)
+            body_local = [jax.tree_util.tree_map(lambda a: a[0], bj)
+                          for bj in body]
+            key = jax.random.wrap_key_data(kd)
+            if D > 1:
+                key = jax.random.fold_in(key, jax.lax.axis_index(data))
+
+            def fwd(p, m, xi):
+                p_aux, p_body = p
+                mkey = jax.random.fold_in(key, m)
+                x0 = jax.lax.cond(
+                    idx == 0,
+                    lambda: self._fold_prelude(p_aux, xs[m], mkey).astype(
+                        boundary.dtype),
+                    lambda: xi)
+                return self._fold_body(p_body, x0, mkey, n_act[idx],
+                                       offs[idx])
+
+            def lfn(p, h, m):
+                p_aux, _ = p
+                mkey = jax.random.fold_in(key, m)
+                return self._fold_head_loss(p_aux, h, ys[m], mkey)
+
+            loss, (g_aux, g_body) = run_pipeline_schedule(
+                fwd, lfn, (aux, body_local), sched, pipe, boundary)
+            inv = 1.0 / self.n_micro
+            loss = jax.lax.psum(
+                jnp.where(idx == S - 1, loss, 0.0), pipe) * inv
+            # prelude/head grads live on one stage, zero elsewhere
+            g_aux = jax.tree_util.tree_map(
+                lambda a: jax.lax.psum(a, pipe) * inv, g_aux)
+            g_body = jax.tree_util.tree_map(
+                lambda a: (a * inv)[None], g_body)
+            if D > 1:
+                loss = jax.lax.pmean(loss, data)
+                g_aux = jax.tree_util.tree_map(
+                    lambda a: jax.lax.pmean(a, data), g_aux)
+                g_body = jax.tree_util.tree_map(
+                    lambda a: jax.lax.pmean(a, data), g_body)
+            return loss, g_aux, g_body
+
+        x_spec = P(None, data) if D > 1 else P()
+        mapped = _shmap(
+            worker, mesh,
+            in_specs=(P(), P(pipe), x_spec, x_spec, P()),
+            out_specs=(P(), P(), P(pipe)))
+
+        clip = (conf.gradient_normalization
+                is _GN.CLIP_ELEMENT_WISE_ABSOLUTE_VALUE)
+        thr = float(conf.gradient_normalization_threshold)
+
+        def step(aux, body, aux_opt, body_opt, xs, ys, kd):
+            loss, g_aux, g_body = mapped(aux, body, xs, ys, kd)
+            if self._has_reg:
+                reg, (r_aux, r_body) = jax.value_and_grad(
+                    self._reg_score, argnums=(0, 1))(aux, body)
+                g_aux = jax.tree_util.tree_map(
+                    lambda a, b: a + b, g_aux, r_aux)
+                g_body = jax.tree_util.tree_map(
+                    lambda a, b: a + b, g_body, r_body)
+                loss = loss + reg
+            if clip:
+                g_aux, g_body = jax.tree_util.tree_map(
+                    lambda g: jnp.clip(g, -thr, thr), (g_aux, g_body))
+            new_aux, new_aux_opt = {}, {}
+            for name in self._aux_names:
+                upd, st = self.optim.txs[name].update(
+                    g_aux[name], aux_opt[name], aux[name])
+                new_aux[name] = optax.apply_updates(aux[name], upd)
+                new_aux_opt[name] = jax.tree_util.tree_map(
+                    jax.lax.with_sharding_constraint,
+                    st, self._aux_opt_sh[name])
+            new_body, new_body_opt = [], []
+            for j, tx in enumerate(self._body_tx):
+                upd, st = tx.update(g_body[j], body_opt[j], body[j])
+                nb = optax.apply_updates(body[j], upd)
+                new_body.append(jax.tree_util.tree_map(
+                    lambda a: jax.lax.with_sharding_constraint(
+                        a, self._pipe_sh), nb))
+                new_body_opt.append(jax.tree_util.tree_map(
+                    lambda a: jax.lax.with_sharding_constraint(
+                        a, self._pipe_sh)
+                    if self._is_stacked_leaf(a) else a, st))
+            return new_aux, new_body, new_aux_opt, new_body_opt, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+    def _get_step(self, xs, ys):
+        k = (tuple(xs.shape), str(xs.dtype), tuple(ys.shape), str(ys.dtype))
+        if k not in self._step_cache:
+            self._step_cache[k] = self._build_step(
+                xs.shape, xs.dtype, ys.shape, ys.dtype)
+        return self._step_cache[k]
+
+    # ------------------------------------------------------------- train
+    def fit_batch(self, x, y):
+        """One optimizer step on a GLOBAL batch: split into ``n_micro``
+        microbatches along dim 0 (each further sharded over the data
+        axis), streamed through the stages under the tick schedule.
+        Returns the scalar score (loss + regularization) — equal to the
+        single-device Solver's at the same global batch."""
+        model = self.model
+        conf = model.conf
+        keep_int = (model.keeps_int_input(conf.network_inputs[0])
+                    if hasattr(conf, "network_inputs")
+                    else model.keeps_int_input())
+        x = as_input(x, model.dtype, keep_int)
+        y = jnp.asarray(y)
+        B = x.shape[0]
+        M, D = self.n_micro, self._n_data
+        if B % M or (B // M) % D:
+            raise ValueError(
+                f"global batch {B} must split into n_micro={M} microbatches "
+                f"of {D}-divisible size (data axis); got "
+                f"{B}/{M} = {B / M:g}")
+        xs = x.reshape((M, B // M) + x.shape[1:])
+        ys = y.reshape((M, B // M) + y.shape[1:])
+        sh = (NamedSharding(self.mesh, P(None, self.data_axis))
+              if D > 1 else self._repl_sh)
+        xs = jax.device_put(xs, sh)
+        ys = jax.device_put(ys, sh)
+        if self._stage_probe_pending:
+            self._stage_probe_pending = False
+            self._probe_stage_times(xs, ys)
+        fn = self._get_step(xs, ys)
+        kd = jax.random.key_data(model._rng.next_key())
+        out = fn(self._aux, self._body, self._aux_opt, self._body_opt,
+                 xs, ys, kd)
+        self._aux, self._body, self._aux_opt, self._body_opt, loss = out
+        self.iteration += 1
+        return loss
+
+    def fit(self, x, y, *, batch_size: int, epochs: int = 1):
+        """Minimal epoch loop over host arrays (shuffling/iterators stay
+        the caller's job — see ``train.checkpoint`` for resumable input
+        pipelines). Returns the last score."""
+        n = int(np.shape(x)[0])
+        loss = None
+        for _ in range(int(epochs)):
+            for lo in range(0, n - batch_size + 1, batch_size):
+                loss = self.fit_batch(x[lo:lo + batch_size],
+                                      y[lo:lo + batch_size])
+        return loss
+
+    def _probe_stage_times(self, xs, ys):
+        """One-off per-stage compiled fold timing; feeds the
+        ``dl4j_tpu_training_pipeline_stage_step_seconds`` gauge. The
+        pipeline's tick length is max over stages — the balance view."""
+        import time as _time
+        part = self.partition
+        host_params = jax.device_get(self.params)
+        key = jax.random.PRNGKey(0)
+        h = jax.device_get(xs)[0]
+        y0 = jax.device_get(ys)[0]
+        last = self._n_units - 1
+        for s in range(self.n_stages):
+            ids = part.stage_units[s]
+
+            def fold(p, hh, ids=ids):
+                out = hh
+                for i in ids:
+                    if i == last:
+                        return self._fold_head_loss(p, out,
+                                                    jnp.asarray(y0), key)
+                    out = self._apply_unit(i, p, out, key)
+                return out
+
+            f = jax.jit(fold)
+            out = jax.block_until_ready(f(host_params, h))
+            t0 = _time.perf_counter()
+            out = jax.block_until_ready(f(host_params, h))
+            self._stage_time_gauge.labels(str(s)).set(
+                _time.perf_counter() - t0)
+            if s < self.n_stages - 1:
+                h = out
+
+    # ------------------------------------------- checkpoint-facing views
+    def _is_stacked_leaf(self, a) -> bool:
+        shape = tuple(np.shape(a))
+        return (len(shape) >= 2
+                and shape[:2] == (self.n_stages, self._k_max))
+
+    def _unit_location(self, i):
+        part = self.partition
+        a = part.prelude[-1] + 1 if part.prelude else 0
+        span = part.n_blocks * part.period
+        if a <= i < a + span:
+            b, j = divmod(i - a, part.period)
+            s, kb = self._block_place[b]
+            return ("body", j, s, kb)
+        return ("aux",)
+
+    @property
+    def n_data_shards(self) -> int:
+        return self._n_data
+
+    @property
+    def params(self):
+        """GLOBAL name-keyed params, structurally identical to
+        ``model.params`` — the orbax/zip checkpoint view."""
+        out = {}
+        for i, (name, _, _) in enumerate(self._units):
+            loc = self._unit_location(i)
+            if loc[0] == "aux":
+                out[name] = dict(self._aux.get(name, {}))
+            else:
+                _, j, s, kb = loc
+                out[name] = {pn: a[s, kb]
+                             for pn, a in self._body[j].items()}
+        return out
+
+    @params.setter
+    def params(self, tree):
+        S, K = self.n_stages, self._k_max
+        part = self.partition
+        self._aux = {
+            name: jax.device_put(
+                jax.tree_util.tree_map(jnp.asarray, tree[name]),
+                self._repl_sh)
+            for name in self._aux_names}
+        body = []
+        for j, i0 in enumerate(part.blocks[0]):
+            stacked = {}
+            for pn, p0 in tree[self._units[i0][0]].items():
+                arr = np.zeros((S, K) + tuple(np.shape(p0)),
+                               jnp.asarray(p0).dtype)
+                for b in range(part.n_blocks):
+                    s, kb = self._block_place[b]
+                    arr[s, kb] = np.asarray(jax.device_get(
+                        tree[self._units[part.blocks[b][j]][0]][pn]))
+                stacked[pn] = jax.device_put(arr, self._pipe_sh)
+            body.append(stacked)
+        self._body = body
+
+    @property
+    def opt_state(self):
+        """GLOBAL per-layer updater state, matching ``LayerOptimizers``'s
+        ``{layer: tx_state}`` structure (the zip/orbax wire format)."""
+        out = {}
+        for name, _ in self.model.named_param_layers():
+            i = next(k for k, (n, _, _) in enumerate(self._units)
+                     if n == name)
+            loc = self._unit_location(i)
+            if loc[0] == "aux":
+                out[name] = self._aux_opt[name]
+            else:
+                _, j, s, kb = loc
+                out[name] = jax.tree_util.tree_map(
+                    lambda a: a[s, kb] if self._is_stacked_leaf(a) else a,
+                    self._body_opt[j])
+        return out
+
+    @opt_state.setter
+    def opt_state(self, tree):
+        part = self.partition
+        for name in self._aux_names:
+            self._aux_opt[name] = jax.tree_util.tree_map(
+                lambda leaf, sh: jax.device_put(jnp.asarray(leaf), sh),
+                tree[name], self._aux_opt_sh[name])
+        new_body_opt = []
+        for j, i0 in enumerate(part.blocks[0]):
+            tmpl = self._body_opt[j]
+            per_block = [tree[self._units[part.blocks[b][j]][0]]
+                         for b in range(part.n_blocks)]
+            if not self.model.params.get(self._units[i0][0]):
+                new_body_opt.append(tmpl)
+                continue
+
+            def imp(tl, *leaves):
+                if self._is_stacked_leaf(tl):
+                    arr = np.zeros(tuple(np.shape(tl)),
+                                   jnp.asarray(tl).dtype)
+                    for b, v in enumerate(leaves):
+                        s, kb = self._block_place[b]
+                        arr[s, kb] = np.asarray(jax.device_get(v))
+                    return jax.device_put(arr, self._pipe_sh)
+                return jax.device_put(jnp.asarray(leaves[0]),
+                                      self._repl_sh)
+
+            new_body_opt.append(jax.tree_util.tree_map(
+                imp, tmpl, *per_block))
+        self._body_opt = new_body_opt
+
+    @property
+    def state(self):
+        """Per-layer persistent state: validated empty at construction
+        (stateless layers only), so this is the model's empty-dict tree."""
+        return {name: {} for name in self.model.state}
+
+    @state.setter
+    def state(self, tree):
+        pass  # stateless by construction
+
+    def _validate_body_opt_roundtrip(self) -> None:
+        """The stacked body opt state must slice back into the exact
+        per-layer structure ``LayerOptimizers.init`` produces — the
+        checkpoint-interchange contract with Solver/DistributedTrainer."""
+        for j, i0 in enumerate(self.partition.blocks[0]):
+            name0 = self._units[i0][0]
+            if not self.model.params.get(name0):
+                continue
+            ref = jax.eval_shape(self._body_tx[j].init,
+                                 self.model.params[name0])
+            got = jax.tree_util.tree_map(
+                lambda a: a[0, 0] if self._is_stacked_leaf(a) else a,
+                self._body_opt[j])
+            rl, rt = jax.tree_util.tree_flatten(ref)
+            gl, gt = jax.tree_util.tree_flatten(got)
+            if rt != gt or [tuple(np.shape(v)) for v in gl] != [
+                    tuple(r.shape) for r in rl]:
+                raise ValueError(
+                    f"updater state for block layer {name0!r} does not "
+                    "round-trip through the stacked pipeline layout; use "
+                    "an elementwise updater on block layers")
+
+    # ------------------------------------------------- trainer interop
+    def sync_to_model(self) -> None:
+        """Write the trainer's params back into the host model (the
+        checkpoint/save path — global shapes, so a non-PP restore works)."""
+        self.model.params = jax.device_get(self.params)
+
+    def load_updater_state(self, host_opt) -> None:
+        """Install a host updater-state tree saved by ANY trainer (global
+        per-layer shapes — Solver, DistributedTrainer zero1 or not, or a
+        differently-staged PipelineParallelTrainer)."""
+        live = jax.tree_util.tree_leaves(self.opt_state)
+        new = jax.tree_util.tree_leaves(host_opt)
+        if len(live) != len(new):
+            raise ValueError(
+                f"updater state leaf count mismatch: checkpoint has "
+                f"{len(new)}, trainer expects {len(live)}")
+        for a, b in zip(live, new):
+            if tuple(np.shape(a)) != tuple(np.shape(b)):
+                raise ValueError(
+                    f"updater state leaf shape mismatch: {np.shape(b)} vs "
+                    f"expected {np.shape(a)} — was this saved with "
+                    "different GLOBAL shapes?")
+        self.opt_state = host_opt
+
+    def stage_param_bytes(self, *, per_device: bool = True) -> int:
+        """Trainable-param bytes resident per device (stacked block slices
+        + replicated prelude/head) — the over-one-chip proof reads this."""
+        total = 0
+        for leaf in jax.tree_util.tree_leaves((self._aux, self._body)):
+            if per_device and isinstance(leaf, jax.Array):
+                total += int(np.prod(
+                    leaf.sharding.shard_shape(leaf.shape))) * leaf.dtype.itemsize
+            else:
+                total += leaf.size * leaf.dtype.itemsize
+        return int(total)
+
+    def stats(self) -> dict:
+        return {
+            "iteration": self.iteration,
+            "schedule": self.schedule,
+            "n_stages": self.n_stages,
+            "n_micro": self.n_micro,
+            "data_shards": self._n_data,
+            "zero1": self.zero1,
+            "bubble_share": self._sched.bubble_share,
+            "resident_microbatches": self._sched.max_inflight,
+            "stage_costs": list(self.partition.stage_costs),
+            "stage_param_bytes": self.stage_param_bytes(),
+            "stage_param_bytes_global": self.stage_param_bytes(
+                per_device=False),
+        }

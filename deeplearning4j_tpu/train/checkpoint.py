@@ -103,11 +103,14 @@ class _ModelSnapshot:
         if (save_updater and dist_trainer is not None
                 and getattr(dist_trainer, "opt_state", None) is not None
                 and not getattr(dist_trainer, "_multiprocess", False)):
-            # DistributedTrainer updater state fetched at GLOBAL shape:
-            # device_get reassembles ZeRO-1 slices, so the zip artifact
-            # follows the orbax global-shape rule (PR 8) and
+            # Trainer updater state fetched at GLOBAL shape: device_get
+            # reassembles ZeRO-1 slices (DistributedTrainer) and the
+            # opt_state property un-stacks per-stage block slices
+            # (PipelineParallelTrainer), so the zip artifact follows the
+            # orbax global-shape rule (PR 8) and
             # restore_training_state(trainer=...) can re-shard it onto a
-            # RESIZED data axis (elastic resize). Multi-process meshes
+            # RESIZED data axis (elastic resize) or a different
+            # stage/schedule layout (PP <-> non-PP). Multi-process meshes
             # hold non-addressable shards — they keep the orbax path.
             self._trainer = _TrainerShim(
                 jax.device_get(dist_trainer.opt_state))

@@ -127,6 +127,10 @@ class OrbaxCheckpointer:
                 meta["zero1"] = bool(trainer.zero1)
             if hasattr(trainer, "n_data_shards"):
                 meta["data_axis"] = int(trainer.n_data_shards)
+            if hasattr(trainer, "n_stages"):  # pipeline-parallel layout
+                meta["pipeline_stages"] = int(trainer.n_stages)
+                meta["pipeline_schedule"] = str(
+                    getattr(trainer, "schedule", ""))
             model = getattr(trainer, "model", None)
             rng = getattr(model, "_rng", None)
             if rng is not None:  # resume the exact noise stream (dropout)
@@ -260,9 +264,13 @@ class OrbaxCheckpointer:
             meta = self._mgr.restore(
                 step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()),
             )["meta"] or {}
-            return (f"saved zero1={meta.get('zero1')}, "
+            hint = (f"saved zero1={meta.get('zero1')}, "
                     f"data_axis={meta.get('data_axis')}, "
                     f"iteration={meta.get('iteration')}")
+            if meta.get("pipeline_stages"):
+                hint += (f", pipeline_stages={meta['pipeline_stages']}"
+                         f" ({meta.get('pipeline_schedule')})")
+            return hint
         except Exception:
             return "saved layout metadata unavailable"
 

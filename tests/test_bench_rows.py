@@ -54,6 +54,12 @@ def test_select_rows_filters_exactly():
     sel = bench.select_rows("model_multiplex")
     assert sel == {"model_multiplex": "model_multiplex"}
     assert "model_multiplex" not in bench._CHIP_ONLY_ROWS
+    # ISSUE 20: the pipeline-bubble row (<0.35 1F1B gate at S=4/M=8)
+    # runs on the 8-virtual-device CPU fallback
+    sel = bench.select_rows("pipeline_bubble_share")
+    assert sel == {"pipeline_bubble_share": "pipeline_bubble_share"}
+    assert "pipeline_bubble_share" in bench._EXTRA_ROWS
+    assert "pipeline_bubble_share" not in bench._CHIP_ONLY_ROWS
     # every selectable row maps to a registered measurement
     for row, meas in {**bench._EXTRA_ROWS, **bench._CHIP_ONLY_ROWS}.items():
         assert meas in bench._MEASUREMENTS, (row, meas)
@@ -114,6 +120,7 @@ def test_cli_list_rows_and_unknown_row_exit():
     assert "paged_kv_occupancy" in listing["rows"]
     assert "disagg_handoff" in listing["rows"]
     assert "model_multiplex" in listing["rows"]
+    assert "pipeline_bubble_share" in listing["rows"]
     # an unknown row fails fast (exit 2, error names the row) BEFORE any
     # probe/measurement work
     bad = subprocess.run([sys.executable, _BENCH, "--rows", "nope"],

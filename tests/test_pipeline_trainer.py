@@ -1,0 +1,343 @@
+"""PipelineParallelTrainer: stage partitioning, trajectory equality
+against the single-device Solver (the tier-1 PP gate), composition with
+data parallelism + ZeRO-1, checkpoint interchange with non-PP trainers,
+and the over-one-chip memory proof (stage_param_bytes)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import (
+    Activation,
+    InputType,
+    LossFunction,
+    MultiLayerNetwork,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.train import Adam, Sgd
+from deeplearning4j_tpu.train.solver import Solver
+from deeplearning4j_tpu.parallel import PipelineParallelTrainer
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+from deeplearning4j_tpu.parallel.pipeline import partition_stages
+
+NIN, H, NOUT = 6, 12, 3
+
+
+def _chain(seed=7, n_blocks=4, h=H, updater=None, l2=0.0):
+    """pre-dense + n_blocks identical dense blocks + output head: the
+    canonical periodic chain partition_stages understands."""
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed)
+         .updater(updater if updater is not None else Sgd(0.2)))
+    if l2:
+        b = b.l2(l2)
+    b = b.list().layer(DenseLayer(n_out=h, activation=Activation.TANH))
+    for _ in range(n_blocks):
+        b = b.layer(DenseLayer(n_out=h, activation=Activation.TANH))
+    conf = (b.layer(OutputLayer(n_out=NOUT, loss=LossFunction.MCXENT))
+            .set_input_type(InputType.feed_forward(NIN))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batch(n=32, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, NIN).astype(np.float32)
+    y = np.eye(NOUT, dtype=np.float32)[rs.randint(0, NOUT, n)]
+    return x, y
+
+
+# ---------------------------------------------------------------------------
+# Stage partitioning
+# ---------------------------------------------------------------------------
+
+
+def test_partition_stages_layout():
+    m = _chain(n_blocks=6)
+    part = partition_stages(m, 4)
+    assert part.n_stages == 4
+    assert part.prelude == (0,)            # input dense pinned to stage 0
+    assert part.head == (7,)               # output layer pinned to last
+    assert part.n_blocks == 6
+    assert sum(part.blocks_per_stage) == 6
+    assert all(c >= 1 for c in part.blocks_per_stage)
+    # stage_units covers every layer exactly once, in order
+    flat = [i for units in part.stage_units for i in units]
+    assert flat == list(range(8))
+
+
+def test_partition_balances_parameter_cost():
+    m = _chain(n_blocks=8)
+    part = partition_stages(m, 4)
+    # 8 identical blocks over 4 stages: no stage may be starved, and the
+    # max/mean stage-cost ratio should stay close to even
+    assert min(part.blocks_per_stage) >= 1
+    assert 1.0 <= part.balance < 1.5
+
+
+def test_partition_rejects_aperiodic_chain():
+    m = _chain(n_blocks=1)  # pre + 1 block + head: no period covers S=4
+    with pytest.raises(ValueError):
+        partition_stages(m, 4)
+
+
+def test_partition_rejects_single_stage():
+    m = _chain(n_blocks=4)
+    with pytest.raises(ValueError):
+        partition_stages(m, 1)
+
+
+def test_graph_linear_chain_rejects_branching():
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    b = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1))
+         .graph_builder().add_inputs("in"))
+    b = b.add_layer("d1", DenseLayer(n_out=H, activation=Activation.TANH),
+                    "in")
+    b = b.add_layer("d2", DenseLayer(n_out=H, activation=Activation.TANH),
+                    "in")  # second consumer of "in": a branch
+    b = b.add_layer("out", OutputLayer(n_out=NOUT, loss=LossFunction.MCXENT),
+                    "d1")
+    conf = (b.set_outputs("out")
+            .set_input_types(InputType.feed_forward(NIN)).build())
+    g = ComputationGraph(conf).init()
+    with pytest.raises(ValueError):
+        g.linear_chain()
+
+
+def test_forward_pure_start_folds_suffix():
+    # fold layers [0, 3) via upto=, then resume from the boundary with
+    # start=3: together they must equal the full forward
+    m = _chain(n_blocks=4)
+    x, _ = _batch(8)
+    full = m.forward_pure(m.params, m.state, jnp.asarray(x),
+                          train=False, rng=None)[0]
+    h = m.forward_pure(m.params, m.state, jnp.asarray(x),
+                       train=False, rng=None, upto=3)[0]
+    resumed = m.forward_pure(m.params, m.state, h,
+                             train=False, rng=None, start=3)[0]
+    np.testing.assert_allclose(np.asarray(full), np.asarray(resumed),
+                               rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# Trajectory equality: pipelined training == single-device Solver
+# (the tier-1 PP gate) — both schedules
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_trainer_matches_solver(schedule):
+    mesh = make_mesh(devices=jax.devices()[:4], pipe=4)
+    m = _chain(n_blocks=4)
+    tr = PipelineParallelTrainer(m, mesh, n_micro=8, schedule=schedule,
+                                 stage_time_probe=False)
+    ref = Solver(_chain(n_blocks=4))
+    x, y = _batch(32)
+    for i in range(3):
+        lp = float(tr.fit_batch(x, y))
+        ls, _ = ref.fit_batch(x, y)
+        np.testing.assert_allclose(lp, float(ls), rtol=1e-5,
+                                   err_msg=f"step {i}")
+    tr.sync_to_model()
+    for name, group in ref.model.params.items():
+        for pname, pv in group.items():
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(m.params[name][pname])),
+                np.asarray(jax.device_get(pv)),
+                rtol=2e-4, atol=2e-5, err_msg=f"{name}/{pname}")
+
+
+@pytest.mark.parametrize("n_micro", [5, 2])
+def test_trainer_degenerate_microbatching(n_micro):
+    # M not a multiple of S, and M < S (fill/drain dominated): still exact
+    mesh = make_mesh(devices=jax.devices()[:4], pipe=4)
+    m = _chain(n_blocks=4, seed=11)
+    tr = PipelineParallelTrainer(m, mesh, n_micro=n_micro,
+                                 stage_time_probe=False)
+    ref = Solver(_chain(n_blocks=4, seed=11))
+    x, y = _batch(n_micro * 4, seed=2)
+    for _ in range(2):
+        lp = float(tr.fit_batch(x, y))
+        ls, _ = ref.fit_batch(x, y)
+        np.testing.assert_allclose(lp, float(ls), rtol=1e-5)
+
+
+def test_trainer_resident_microbatches_bound():
+    # acceptance: 1F1B resident activations ≤ S microbatches, and the
+    # trainer reports it (GPipe pays M for the same bubble share)
+    mesh = make_mesh(devices=jax.devices()[:4], pipe=4)
+    m = _chain(n_blocks=4)
+    tr = PipelineParallelTrainer(m, mesh, n_micro=8, schedule="1f1b",
+                                 stage_time_probe=False)
+    st = tr.stats()
+    assert st["resident_microbatches"] <= tr.n_stages
+    assert st["bubble_share"] < 0.35
+    m2 = _chain(n_blocks=4)
+    gp = PipelineParallelTrainer(m2, mesh, n_micro=8, schedule="gpipe",
+                                 stage_time_probe=False)
+    assert gp.stats()["resident_microbatches"] == 8
+    assert gp.stats()["bubble_share"] == st["bubble_share"]
+
+
+# ---------------------------------------------------------------------------
+# Composition: pipe × data mesh, ZeRO-1 inside stages
+# ---------------------------------------------------------------------------
+
+
+def test_pp_dp_zero1_matches_replicated_and_solver():
+    x, y = _batch(32, seed=5)
+    mk = lambda: _chain(n_blocks=4, seed=13, updater=Adam(0.01), l2=0.01)
+
+    mesh = make_mesh(pipe=4, data=2)
+    trz = PipelineParallelTrainer(mk(), mesh, n_micro=4, zero1=True,
+                                  stage_time_probe=False)
+    assert trz.n_data_shards == 2 and trz.zero1
+    trr = PipelineParallelTrainer(mk(), mesh, n_micro=4, zero1=False,
+                                  stage_time_probe=False)
+    ref = Solver(mk())
+    for i in range(3):
+        lz = float(trz.fit_batch(x, y))
+        lr = float(trr.fit_batch(x, y))
+        ls, _ = ref.fit_batch(x, y)
+        np.testing.assert_allclose(lz, float(ls), rtol=2e-4,
+                                   err_msg=f"zero1 step {i}")
+        np.testing.assert_allclose(lr, float(ls), rtol=2e-4,
+                                   err_msg=f"replicated step {i}")
+    # final params agree across all three trainings
+    trz.sync_to_model()
+    trr.sync_to_model()
+    for name, group in ref.model.params.items():
+        for pname, pv in group.items():
+            ref_a = np.asarray(jax.device_get(pv))
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(trz.model.params[name][pname])),
+                ref_a, rtol=2e-4, atol=2e-5, err_msg=f"z {name}/{pname}")
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(trr.model.params[name][pname])),
+                ref_a, rtol=2e-4, atol=2e-5, err_msg=f"r {name}/{pname}")
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint interchange: PP ↔ non-PP via global-shape opt_state/params
+# ---------------------------------------------------------------------------
+
+
+def test_opt_state_speaks_global_shapes():
+    mesh = make_mesh(devices=jax.devices()[:4], pipe=4)
+    m = _chain(n_blocks=4, updater=Adam(0.01))
+    tr = PipelineParallelTrainer(m, mesh, n_micro=4,
+                                 stage_time_probe=False)
+    ref = Solver(_chain(n_blocks=4, updater=Adam(0.01)))
+    got = jax.tree_util.tree_structure(tr.opt_state)
+    want = jax.tree_util.tree_structure(ref.opt_state)
+    assert got == want
+    for a, b in zip(jax.tree_util.tree_leaves(tr.opt_state),
+                    jax.tree_util.tree_leaves(ref.opt_state)):
+        assert np.shape(a) == np.shape(b)
+
+
+def test_orbax_interchange_pp_and_dp(tmp_path):
+    from deeplearning4j_tpu.parallel import DistributedTrainer
+    from deeplearning4j_tpu.train.orbax_checkpoint import OrbaxCheckpointer
+
+    x, y = _batch(32, seed=9)
+    mk = lambda: _chain(n_blocks=4, seed=17, updater=Adam(0.01))
+
+    # train 2 steps pipelined, checkpoint, restore into a data-parallel
+    # trainer, and train one more step — must equal 3 pipelined steps
+    tr = PipelineParallelTrainer(mk(), make_mesh(devices=jax.devices()[:4], pipe=4),
+                                 n_micro=4, schedule="1f1b",
+                                 stage_time_probe=False)
+    tr.fit_batch(x, y)
+    tr.fit_batch(x, y)
+    ck = OrbaxCheckpointer(str(tmp_path / "pp"), async_save=False)
+    ck.save(2, tr)
+    ck.wait()
+
+    ref = PipelineParallelTrainer(mk(), make_mesh(devices=jax.devices()[:4], pipe=4),
+                                  n_micro=4, schedule="gpipe",
+                                  stage_time_probe=False)
+    meta = ck.restore(ref)  # PP(1f1b) -> PP(gpipe): global shapes reshard
+    assert meta.get("pipeline_stages") == 4
+    l_ref = float(ref.fit_batch(x, y))
+
+    dp = DistributedTrainer(mk(), make_mesh(data=8), zero1=True)
+    ck.restore(dp)  # PP -> DP: same global tree, zero1 resharding
+    l_dp = float(dp.fit_batch(x, y))
+    np.testing.assert_allclose(l_dp, l_ref, rtol=1e-4)
+
+    # and back: checkpoint the DP trainer, restore into PP, step again
+    ck2 = OrbaxCheckpointer(str(tmp_path / "dp"), async_save=False)
+    ck2.save(3, dp)
+    ck2.wait()
+    tr2 = PipelineParallelTrainer(mk(), make_mesh(devices=jax.devices()[:4], pipe=4),
+                                  n_micro=4, stage_time_probe=False)
+    ck2.restore(tr2)
+    l_pp = float(tr2.fit_batch(x, y))
+    l_dp2 = float(dp.fit_batch(x, y))
+    np.testing.assert_allclose(l_pp, l_dp2, rtol=1e-4)
+
+
+def test_load_updater_state_rejects_mismatched_tree():
+    mesh = make_mesh(devices=jax.devices()[:4], pipe=4)
+    m = _chain(n_blocks=4, updater=Adam(0.01))
+    tr = PipelineParallelTrainer(m, mesh, n_micro=4,
+                                 stage_time_probe=False)
+    bad = Solver(_chain(n_blocks=4, updater=Sgd(0.1))).opt_state
+    with pytest.raises(ValueError):
+        tr.load_updater_state(bad)
+
+
+# ---------------------------------------------------------------------------
+# Over-one-chip proof: global params exceed a per-device budget, the
+# per-stage share fits, and the model still trains on the 8-device mesh
+# ---------------------------------------------------------------------------
+
+
+def test_over_budget_model_trains():
+    # 8 blocks of 96x96 dense on an 8-stage pipe: ~75 KiB of block params
+    # per stage vs ~600 KiB global. Budget set between the two: no single
+    # device could hold the full model under it, each stage's share fits.
+    m = _chain(n_blocks=8, h=96)
+    mesh = make_mesh(pipe=8)
+    tr = PipelineParallelTrainer(m, mesh, n_micro=8,
+                                 stage_time_probe=False)
+    per_dev = tr.stage_param_bytes()
+    total = tr.stage_param_bytes(per_device=False)
+    budget = 2 * per_dev
+    assert per_dev <= budget < total, (per_dev, budget, total)
+    x, y = _batch(32, seed=3)
+    l0 = float(tr.fit_batch(x, y))
+    l1 = l0
+    for _ in range(4):
+        l1 = float(tr.fit_batch(x, y))
+    assert np.isfinite(l1) and l1 < l0
+
+
+# ---------------------------------------------------------------------------
+# Scope errors: clear failures instead of silent wrong math
+# ---------------------------------------------------------------------------
+
+
+def test_rejects_trust_ratio_body_updater():
+    from deeplearning4j_tpu.train import Lars
+
+    m = _chain(n_blocks=4, updater=Lars(0.1))
+    mesh = make_mesh(devices=jax.devices()[:4], pipe=4)
+    with pytest.raises(ValueError, match="elementwise"):
+        PipelineParallelTrainer(m, mesh, n_micro=4,
+                                stage_time_probe=False)
+
+
+def test_rejects_batch_size_not_divisible():
+    mesh = make_mesh(devices=jax.devices()[:4], pipe=4)
+    m = _chain(n_blocks=4)
+    tr = PipelineParallelTrainer(m, mesh, n_micro=8,
+                                 stage_time_probe=False)
+    x, y = _batch(30)  # 30 % 8 != 0
+    with pytest.raises(ValueError):
+        tr.fit_batch(x, y)
